@@ -1,0 +1,434 @@
+"""Configuration system.
+
+TPU-native re-design of the reference's two-tier config:
+
+1. ``compspec.json`` — the owner/member-scoped, typed flag schema rendered by the
+   COINSTAC GUI (reference ``compspec.json:10-297``). Here it becomes a plain
+   dataclass :class:`TrainConfig` whose fields carry the same names and defaults,
+   with the GUI metadata (``source``, ``conditional``, ``group``) preserved in
+   :data:`COMPSPEC_META` so a compspec-compatible JSON schema can be emitted via
+   :func:`export_compspec`.
+2. Per-site ``inputspec.json`` simulator files (reference
+   ``datasets/test_fsl/inputspec.json:1-187``, ``datasets/icalstm/inputspec.json:1-88``)
+   — loaded by :func:`load_inputspec`, which unwraps the ``{"key": {"value": v}}``
+   envelope and returns one override dict per site.
+
+Config resolution order (mirrors ``COINNLocal`` kwargs being overridden by GUI
+``data['input']``, reference ``local.py:31-37``): dataclass defaults < programmatic
+kwargs < per-site inputspec values.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+# ---------------------------------------------------------------------------
+# Task / engine registry (reference comps/__init__.py:7-16)
+# ---------------------------------------------------------------------------
+
+
+class NNComputation:
+    """Available tasks (reference ``comps/__init__.py:7-10``)."""
+
+    TASK_FREE_SURFER = "FS-Classification"
+    TASK_ICA = "ICA-Classification"
+    # TPU-build extensions (BASELINE.json configs):
+    TASK_SMRI_3D = "sMRI-3D-Classification"
+    TASK_MULTIMODAL = "Multimodal-Classification"
+
+    ALL = (TASK_FREE_SURFER, TASK_ICA, TASK_SMRI_3D, TASK_MULTIMODAL)
+
+
+class AggEngine:
+    """Aggregation engines (reference ``comps/__init__.py:13-16``)."""
+
+    DECENTRALIZED_SGD = "dSGD"
+    RANK_DAD = "rankDAD"
+    POWER_SGD = "powerSGD"
+
+    ALL = (DECENTRALIZED_SGD, RANK_DAD, POWER_SGD)
+
+
+# ---------------------------------------------------------------------------
+# Task-specific argument blocks
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FSArgs:
+    """FreeSurfer classification parameters (reference ``compspec.json:225-250``)."""
+
+    labels_file: str = "site0_covariates.csv"
+    data_column: str = "freesurferfile"
+    labels_column: str = "isControl"
+    input_size: int = 66
+    hidden_sizes: tuple = (256, 128, 64, 32)
+    num_class: int = 2
+    dad_reduction_rank: int = 10
+    dad_num_pow_iters: int = 5
+    dad_tol: float = 1e-3
+    split_files: tuple = ()
+
+
+@dataclass
+class ICAArgs:
+    """ICA classification parameters (reference ``compspec.json:251-281``,
+    ``datasets/icalstm/inputspec.json:1-88``)."""
+
+    data_file: str = ""
+    labels_file: str = ""
+    num_class: int = 2
+    monitor_metric: str = "auc"
+    metric_direction: str = "maximize"
+    log_header: str = "Loss|AUC"
+    num_components: int = 100
+    temporal_size: int = 980
+    window_size: int = 10
+    window_stride: int = 10
+    input_size: int = 256
+    hidden_size: int = 384
+    num_layers: int = 1
+    bidirectional: bool = True
+    dad_reduction_rank: int = 10
+    dad_num_pow_iters: int = 5
+    dad_tol: float = 1e-3
+    split_files: tuple = ()
+
+
+@dataclass
+class SMRI3DArgs:
+    """3D sMRI classification parameters (TPU-build extension; BASELINE.json
+    configs: '3D-CNN sMRI (T1w volumes) federated classifier, 8 sites')."""
+
+    data_file: str = ""
+    labels_file: str = ""
+    num_class: int = 2
+    volume_shape: tuple = (64, 64, 64)
+    channels: tuple = (16, 32, 64, 128)
+    dad_reduction_rank: int = 10
+    dad_num_pow_iters: int = 5
+    dad_tol: float = 1e-3
+    split_files: tuple = ()
+
+
+@dataclass
+class MultimodalArgs:
+    """Multimodal FS+ICA transformer parameters (TPU-build extension;
+    BASELINE.json configs: 'Multimodal FS+ICA Transformer, 64-site DP-SGD')."""
+
+    num_class: int = 2
+    fs_input_size: int = 66
+    num_components: int = 100
+    temporal_size: int = 980
+    window_size: int = 10
+    window_stride: int = 10
+    embed_dim: int = 256
+    num_heads: int = 8
+    num_layers: int = 4
+    mlp_ratio: int = 4
+    dad_reduction_rank: int = 10
+    dad_num_pow_iters: int = 5
+    dad_tol: float = 1e-3
+    split_files: tuple = ()
+
+
+@dataclass
+class PretrainArgs:
+    """Pretraining arguments (reference ``compspec.json:128-148``)."""
+
+    epochs: int = 0
+    learning_rate: float = 1e-3
+    batch_size: int = 16
+    local_iterations: int = 1
+    validation_epochs: int = 1
+    pin_memory: bool = False
+    num_workers: int = 0
+    patience: int = 51
+
+
+# ---------------------------------------------------------------------------
+# The main config
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TrainConfig:
+    """Full training configuration.
+
+    Field names and defaults mirror the reference compspec
+    (``compspec.json:32-224``) plus the ``COINNLocal`` call-site kwargs
+    (``local.py:31-37``). One flat dataclass replaces the reference's
+    cache-dict-of-everything.
+    """
+
+    # --- task selection (compspec.json:32-55)
+    task_id: str = NNComputation.TASK_FREE_SURFER
+    mode: str = "train"  # train | test
+    # --- aggregation (compspec.json:56-79)
+    agg_engine: str = AggEngine.DECENTRALIZED_SGD
+    num_reducers: int = 2  # no-op on TPU (reduction is a collective); kept for parity
+    # --- training loop (compspec.json:80-224, local.py:31-37)
+    batch_size: int = 16
+    local_iterations: int = 1  # gradient accumulation steps
+    learning_rate: float = 1e-3
+    epochs: int = 101
+    pretrain: bool = False
+    pretrain_args: PretrainArgs | None = None
+    validation_epochs: int = 1
+    precision_bits: str = "32"  # payload dtype for gradient exchange: "32" | "16"
+    pin_memory: bool = False  # torch DataLoader parity no-op
+    num_workers: int = 0  # torch DataLoader parity no-op
+    patience: int = 35
+    split_ratio: tuple = (0.8, 0.1, 0.1)
+    num_folds: int | None = None  # mutually exclusive with split_ratio
+    # --- trainer extras (local.py:31-37)
+    num_class: int = 2
+    monitor_metric: str = "auc"
+    metric_direction: str = "maximize"
+    log_header: str = "loss|auc"
+    dataloader_args: dict = field(default_factory=lambda: {"train": {"drop_last": True}})
+    seed: int = 0
+    optimizer: str = "adam"  # coinstac-dinunet trains with Adam at `learning_rate`
+    # --- task args
+    fs_args: FSArgs = field(default_factory=FSArgs)
+    ica_args: ICAArgs = field(default_factory=ICAArgs)
+    smri3d_args: SMRI3DArgs = field(default_factory=SMRI3DArgs)
+    multimodal_args: MultimodalArgs = field(default_factory=MultimodalArgs)
+    # --- TPU-build extras
+    num_sites: int = 2
+    sites_per_device: int = 1  # >1 folds several simulated sites onto one chip
+
+    # -- helpers ---------------------------------------------------------
+
+    def task_args(self):
+        if self.task_id == NNComputation.TASK_FREE_SURFER:
+            return self.fs_args
+        if self.task_id == NNComputation.TASK_ICA:
+            return self.ica_args
+        if self.task_id == NNComputation.TASK_SMRI_3D:
+            return self.smri3d_args
+        if self.task_id == NNComputation.TASK_MULTIMODAL:
+            return self.multimodal_args
+        raise ValueError(f"Invalid task: {self.task_id}")
+
+    def replace(self, **kw) -> "TrainConfig":
+        return dataclasses.replace(self, **kw)
+
+    def with_overrides(self, overrides: dict) -> "TrainConfig":
+        """Apply a flat override dict (e.g. one site's inputspec values).
+
+        Unknown keys are routed into the active task-args block when they match
+        one of its fields (the reference dumps everything into one cache dict;
+        we keep the namespacing but accept the flat form).
+        """
+        # Accept compspec-style block keys ("FS-Classification_args") as well
+        # as our field names ("fs_args").
+        overrides = {_COMPSPEC_KEY_ALIASES.get(k, k): v for k, v in overrides.items()}
+        cfg = self
+        flat = {}
+        for k, v in overrides.items():
+            if k in _TRAIN_FIELDS and k not in _BLOCK_FIELDS:
+                flat[k] = _coerce(_TRAIN_FIELDS[k], v)
+        cfg = dataclasses.replace(cfg, **flat)
+
+        # Dataclass-typed blocks: a dict override merges into the block
+        # (the GUI sends plain JSON objects for type="object" fields).
+        for args_name, args_cls in _BLOCK_FIELDS.items():
+            block = getattr(cfg, args_name) or args_cls()
+            fields = {f.name: f for f in dataclasses.fields(args_cls)}
+            upd = {}
+            if isinstance(overrides.get(args_name), dict):
+                upd.update(
+                    {k: _coerce(fields[k], v) for k, v in overrides[args_name].items() if k in fields}
+                )
+            elif dataclasses.is_dataclass(overrides.get(args_name)):
+                block = overrides[args_name]
+            if args_name != "pretrain_args":
+                # flat keys route into every matching task-args block (the
+                # reference dumps everything into one cache dict)
+                upd.update(
+                    {k: _coerce(fields[k], v) for k, v in overrides.items() if k in fields}
+                )
+            if upd:
+                block = dataclasses.replace(block, **upd)
+            if block is not getattr(cfg, args_name):
+                cfg = dataclasses.replace(cfg, **{args_name: block})
+        return cfg
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+_TRAIN_FIELDS = {f.name: f for f in dataclasses.fields(TrainConfig)}
+_COMPSPEC_KEY_ALIASES = {
+    "FS-Classification_args": "fs_args",
+    "ICA-Classification_args": "ica_args",
+    "sMRI-3D-Classification_args": "smri3d_args",
+    "Multimodal-Classification_args": "multimodal_args",
+}
+#: dataclass-typed TrainConfig fields that take dict merges, not raw replacement
+_BLOCK_FIELDS = {
+    "fs_args": FSArgs,
+    "ica_args": ICAArgs,
+    "smri3d_args": SMRI3DArgs,
+    "multimodal_args": MultimodalArgs,
+    "pretrain_args": PretrainArgs,
+}
+
+
+def _coerce(f: dataclasses.Field, v: Any) -> Any:
+    """Light type coercion: lists → tuples for tuple-typed fields, GUI string
+    numbers → numbers are left as-is (the reference treats precision_bits as a
+    string select)."""
+    if isinstance(v, list) and (f.type or "").startswith("tuple"):
+        return tuple(v)
+    return v
+
+
+# ---------------------------------------------------------------------------
+# inputspec.json loading (simulator per-site overrides)
+# ---------------------------------------------------------------------------
+
+
+def load_inputspec(path: str) -> list[dict]:
+    """Load a COINSTAC simulator ``inputspec.json``.
+
+    The file is a list (one entry per site) of ``{"key": {"value": v}}``
+    envelopes (reference ``datasets/test_fsl/inputspec.json``). A single dict is
+    accepted as a 1-site spec. Returns a list of flat per-site override dicts.
+    """
+    with open(path) as fh:
+        spec = json.load(fh)
+    if isinstance(spec, dict):
+        spec = [spec]
+    out = []
+    for site in spec:
+        flat = {}
+        for k, v in site.items():
+            flat[k] = v.get("value") if isinstance(v, dict) and "value" in v else v
+        out.append(flat)
+    return out
+
+
+def resolve_site_configs(
+    base: TrainConfig, dataset_dir: str, num_sites: int | None = None
+) -> list[TrainConfig]:
+    """Build per-site configs for a ``datasets/<name>`` tree.
+
+    Reads ``<dataset_dir>/inputspec.json`` if present; site i gets entry
+    ``i % len(spec)`` (the simulator reuses the last spec when there are more
+    site dirs than spec entries).
+    """
+    spec_path = os.path.join(dataset_dir, "inputspec.json")
+    overrides: Sequence[dict] = [{}]
+    if os.path.exists(spec_path):
+        overrides = load_inputspec(spec_path)
+    n = num_sites if num_sites is not None else len(overrides)
+    return [base.with_overrides(overrides[i % len(overrides)]) for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# compspec schema export (GUI metadata parity)
+# ---------------------------------------------------------------------------
+
+#: GUI metadata for each flag: (type, source, group, order, conditional, label)
+#: — preserved from reference ``compspec.json`` so the schema can be re-emitted.
+COMPSPEC_META: dict[str, dict] = {
+    "task_id": dict(type="select", source="owner", group="NN Params", order=3,
+                    values=list(NNComputation.ALL),
+                    label="Pick a NN task:"),
+    "mode": dict(type="select", source="owner", group="NN Params", order=4,
+                 values=["train", "test"], label="NN Mode:"),
+    "agg_engine": dict(type="select", source="owner", group="NN Params", order=5,
+                       values=["dSGD", "rankDAD"],
+                       conditional=dict(variable="mode", value="train"),
+                       label="Pick aggregation engine:"),
+    "num_reducers": dict(type="number", source="owner", group="NN Params", order=6,
+                         label="Number of reducers in the aggregator(Depends on number of sites):"),
+    "batch_size": dict(type="number", source="owner", group="NN Params", order=7,
+                       label="Batch size:"),
+    "local_iterations": dict(
+        type="number", source="owner", group="NN Params", order=8,
+        label="Local gradient accumulation iterations"
+              "(effective batch size = batch size * gradient accumulation iterations)"),
+    "learning_rate": dict(type="number", source="owner", group="NN Params", order=9,
+                          conditional=dict(variable="mode", value="train"),
+                          label="Learning rate:"),
+    "epochs": dict(type="number", source="owner", group="NN Params", order=10,
+                   conditional=dict(variable="mode", value="train"), label="Epochs:"),
+    "pretrain": dict(type="boolean", source="owner", group="NN Params", order=11,
+                     label="Use the site with maximum data to pre-train locally as starting point:"),
+    "pretrain_args": dict(type="object", source="owner", group="NN Params", order=12,
+                          conditional=dict(variable="pretrain", value=True),
+                          label="Pretraining arguments:"),
+    "validation_epochs": dict(type="number", source="owner", group="NN Params", order=13,
+                              conditional=dict(variable="mode", value="train"),
+                              label="Run validation after every epochs:"),
+    "precision_bits": dict(type="select", source="owner", group="NN Params", order=14,
+                           values=["32", "16"],
+                           conditional=dict(variable="mode", value="train"),
+                           label="Floating point precision for payload:"),
+    "pin_memory": dict(type="boolean", source="member", group="NN Params", order=15,
+                       label="Pin Memory:"),
+    "num_workers": dict(type="number", source="member", group="NN Params", order=16,
+                        label="Number of workers:"),
+    "patience": dict(type="number", source="owner", group="NN Params", order=17,
+                     conditional=dict(variable="mode", value="train"),
+                     label="Early stopping patience epochs:"),
+    "split_ratio": dict(type="object", source="owner", group="NN Params", order=21,
+                        label="Data split ratio for train, validation, test in the same order:"),
+    "num_folds": dict(type="number", source="owner", group="NN Params", order=22,
+                      label="Number of folds for K-Fold Cross Validation"
+                            "(Mutually exclusive with split ratio):"),
+    "fs_args": dict(type="object", source="owner", group="Computation", order=23,
+                    conditional=dict(variable="task_id", value="FS-Classification"),
+                    label="FreeSurfer classification parameters.",
+                    compspec_key="FS-Classification_args"),
+    "ica_args": dict(type="object", source="owner", group="Computation", order=26,
+                     conditional=dict(variable="task_id", value="ICA-Classification"),
+                     label="ICA classification parameters.",
+                     compspec_key="ICA-Classification_args"),
+    "smri3d_args": dict(type="object", source="owner", group="Computation", order=27,
+                        conditional=dict(variable="task_id", value="sMRI-3D-Classification"),
+                        label="3D sMRI classification parameters.",
+                        compspec_key="sMRI-3D-Classification_args"),
+    "multimodal_args": dict(type="object", source="owner", group="Computation", order=28,
+                            conditional=dict(variable="task_id", value="Multimodal-Classification"),
+                            label="Multimodal FS+ICA transformer parameters.",
+                            compspec_key="Multimodal-Classification_args"),
+}
+
+
+def export_compspec(cfg: TrainConfig | None = None) -> dict:
+    """Emit a COINSTAC-style compspec dict (schema + defaults) for this build."""
+    cfg = cfg or TrainConfig()
+    inputs: dict[str, Any] = {}
+    for name, meta in COMPSPEC_META.items():
+        default = getattr(cfg, name)
+        if dataclasses.is_dataclass(default):
+            default = dataclasses.asdict(default)
+        entry = {"default": _jsonable(default), **{k: v for k, v in meta.items() if k != "compspec_key"}}
+        inputs[meta.get("compspec_key", name)] = entry
+    return {
+        "meta": {
+            "name": "Decentralized Deep Artificial Neural Networks on TPU",
+            "id": "dinunet-tpu",
+            "version": "v1.0.0",
+            "repository": "local",
+            "description": "TPU-native federated NN training: sites on a mesh axis, "
+                           "aggregation via XLA collectives.",
+        },
+        "computation": {"input": inputs, "output": {}, "type": "tpu-spmd"},
+    }
+
+
+def _jsonable(v):
+    if isinstance(v, tuple):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _jsonable(x) for k, x in v.items()}
+    return v

@@ -1,0 +1,81 @@
+"""Cross-site collectives — the aggregation transport.
+
+The reference ships JSON-serialized gradients from every site container to the
+remote container, which reduces them on an ``mp.Pool`` of ``num_reducers``
+processes and broadcasts the result back (reference ``local.py:26-27,49``,
+``remote.py:20-21,37``; payloads optionally cast to fp16 via ``precision_bits``,
+``compspec.json:161-176``). Here each of those becomes a single XLA collective
+over the ``site`` mesh axis: reduction rides ICI, the "broadcast back" is simply
+the collective's replicated result. ~97% of reference wall-clock was this
+transport (SURVEY.md §3.1); these primitives delete that cost class.
+
+All functions are designed for use *inside* ``shard_map``/``pjit`` with a bound
+axis name.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .mesh import SITE_AXIS
+
+# precision_bits payload casting (compspec.json:161-176). On TPU, 16-bit payload
+# means bfloat16 (fp16 is not a native TPU type); the reduction itself still
+# accumulates in fp32.
+_PAYLOAD_DTYPES = {"32": jnp.float32, "16": jnp.bfloat16, 32: jnp.float32, 16: jnp.bfloat16}
+
+
+def payload_cast(tree, precision_bits="32"):
+    """Cast a gradient pytree to the configured payload dtype before the
+    collective — the TPU equivalent of the reference's fp16 payload compression."""
+    dtype = _PAYLOAD_DTYPES[precision_bits]
+    return jax.tree.map(lambda g: g.astype(dtype), tree)
+
+
+def payload_uncast(tree, like):
+    """Restore original dtypes after the collective."""
+    return jax.tree.map(lambda g, l: g.astype(l.dtype), tree, like)
+
+
+def site_sum(tree, axis_name: str = SITE_AXIS):
+    """Sum a pytree across sites (the remote's reduce)."""
+    return jax.tree.map(lambda g: jax.lax.psum(g, axis_name), tree)
+
+
+def site_mean(tree, axis_name: str = SITE_AXIS):
+    """Unweighted mean across sites."""
+    return jax.tree.map(lambda g: jax.lax.pmean(g, axis_name), tree)
+
+
+def site_weighted_mean(tree, weight, axis_name: str = SITE_AXIS):
+    """Example-count-weighted mean across sites.
+
+    dSGD semantics: each site contributes its gradient weighted by how many
+    examples produced it (sites hold 73–120 subjects in the FS fixture —
+    heterogeneous), so the aggregate equals the pooled-data gradient. ``weight``
+    is a scalar per site (e.g. this round's example count).
+    """
+    w = jnp.asarray(weight, jnp.float32)
+    total = jax.lax.psum(w, axis_name)
+    # Guard the all-masked-round case (total==0) to keep the update finite.
+    scale = jnp.where(total > 0, w / jnp.maximum(total, 1e-12), 0.0)
+    # Accumulate in fp32 even for bf16 payloads; cast back only after the psum.
+    return jax.tree.map(
+        lambda g: jax.lax.psum(g.astype(jnp.float32) * scale, axis_name).astype(g.dtype),
+        tree,
+    )
+
+
+def site_all_gather(x, axis_name: str = SITE_AXIS, axis: int = 0, tiled: bool = False):
+    """Gather per-site values to every site (used by the low-rank engines to
+    share rank-r factors instead of full gradients)."""
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def site_index(axis_name: str = SITE_AXIS):
+    return jax.lax.axis_index(axis_name)
+
+
+def site_count(axis_name: str = SITE_AXIS):
+    return jax.lax.axis_size(axis_name)

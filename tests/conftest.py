@@ -1,0 +1,24 @@
+"""Test harness: simulate a multi-chip TPU mesh with 8 virtual CPU devices.
+
+This is the TPU-build replacement for the reference's Docker-based COINSTAC
+simulator (SURVEY.md §4): N local containers + 1 remote container on one machine
+become N virtual jax devices on a "site" mesh axis.
+
+Env vars must be set before jax initializes — hence module level, before any
+jax import.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the shell may pin JAX_PLATFORMS=axon (real TPU)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# sitecustomize may have imported jax already (axon PJRT registration), so the
+# env var alone is too late — set the config knob directly.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_enable_x64", False)

@@ -1,0 +1,117 @@
+"""Config system tests (reference parity: compspec.json + inputspec.json)."""
+
+import json
+
+from dinunet_implementations_tpu import (
+    AggEngine,
+    NNComputation,
+    TrainConfig,
+    export_compspec,
+    load_inputspec,
+)
+
+
+def test_defaults_match_reference_compspec():
+    """Defaults mirror reference compspec.json:32-224."""
+    cfg = TrainConfig()
+    assert cfg.task_id == "FS-Classification"
+    assert cfg.mode == "train"
+    assert cfg.agg_engine == "dSGD"
+    assert cfg.batch_size == 16
+    assert cfg.local_iterations == 1
+    assert cfg.learning_rate == 1e-3
+    assert cfg.epochs == 101
+    assert cfg.precision_bits == "32"
+    assert cfg.patience == 35
+    assert cfg.split_ratio == (0.8, 0.1, 0.1)
+    assert cfg.num_folds is None
+    assert cfg.fs_args.input_size == 66
+    assert cfg.fs_args.hidden_sizes == (256, 128, 64, 32)
+    assert cfg.fs_args.num_class == 2
+    assert cfg.fs_args.dad_reduction_rank == 10
+    assert cfg.fs_args.dad_num_pow_iters == 5
+    assert cfg.fs_args.dad_tol == 1e-3
+    assert cfg.ica_args.window_size == 10
+    assert cfg.ica_args.hidden_size == 384
+
+
+def test_registry_enums():
+    assert NNComputation.TASK_FREE_SURFER == "FS-Classification"
+    assert NNComputation.TASK_ICA == "ICA-Classification"
+    assert AggEngine.DECENTRALIZED_SGD == "dSGD"
+    assert AggEngine.RANK_DAD == "rankDAD"
+    assert AggEngine.POWER_SGD == "powerSGD"
+
+
+def test_with_overrides_routes_task_args():
+    cfg = TrainConfig().with_overrides(
+        {"batch_size": 32, "input_size": 100, "hidden_sizes": [64, 32], "window_size": 20}
+    )
+    assert cfg.batch_size == 32
+    assert cfg.fs_args.input_size == 100
+    assert cfg.fs_args.hidden_sizes == (64, 32)
+    assert cfg.ica_args.input_size == 100  # shared field name lands in both blocks
+    assert cfg.ica_args.window_size == 20
+
+
+def test_load_inputspec(tmp_path):
+    spec = [
+        {"labels_file": {"value": "site1_Covariate.csv"}, "input_size": {"value": 66}},
+        {"labels_file": {"value": "site2_Covariate.csv"}, "input_size": {"value": 66}},
+    ]
+    p = tmp_path / "inputspec.json"
+    p.write_text(json.dumps(spec))
+    sites = load_inputspec(str(p))
+    assert len(sites) == 2
+    assert sites[0]["labels_file"] == "site1_Covariate.csv"
+    assert sites[1]["input_size"] == 66
+
+
+def test_load_reference_fixture_inputspec():
+    """Our loader parses the reference's actual simulator spec unchanged."""
+    sites = load_inputspec("/root/reference/datasets/test_fsl/inputspec.json")
+    assert len(sites) == 5
+    for i, s in enumerate(sites):
+        assert s["data_column"] == "freesurferfile"
+        assert s["labels_column"] == "isControl"
+        assert s["input_size"] == 66
+        assert s["hidden_sizes"] == [256, 128, 64, 32]
+    cfg = TrainConfig().with_overrides(sites[0])
+    assert cfg.fs_args.labels_file == "site1_Covariate.csv"
+    assert cfg.fs_args.hidden_sizes == (256, 128, 64, 32)
+
+
+def test_export_compspec_roundtrip():
+    spec = export_compspec()
+    inputs = spec["computation"]["input"]
+    assert inputs["task_id"]["default"] == "FS-Classification"
+    assert inputs["agg_engine"]["conditional"] == {"variable": "mode", "value": "train"}
+    assert inputs["FS-Classification_args"]["default"]["dad_reduction_rank"] == 10
+    json.dumps(spec)  # must be JSON-serializable
+
+
+def test_block_dict_overrides():
+    """Review finding: dict overrides for dataclass-typed fields must merge."""
+    cfg = TrainConfig().with_overrides({"pretrain_args": {"epochs": 5}})
+    assert cfg.pretrain_args.epochs == 5
+    assert cfg.pretrain_args.patience == 51  # default preserved
+    cfg = TrainConfig().with_overrides({"fs_args": {"input_size": 99}})
+    assert cfg.fs_args.input_size == 99
+    assert cfg.fs_args.hidden_sizes == (256, 128, 64, 32)
+    cfg = TrainConfig().with_overrides({"FS-Classification_args": {"input_size": 42}})
+    assert cfg.fs_args.input_size == 42
+
+
+def test_all_tasks_have_args():
+    for task in NNComputation.ALL:
+        args = TrainConfig(task_id=task).task_args()
+        assert args.num_class == 2
+
+
+def test_resolve_site_configs_cycles():
+    import dinunet_implementations_tpu as dt
+
+    cfgs = dt.resolve_site_configs(TrainConfig(), "/root/reference/datasets/icalstm", num_sites=4)
+    # 2-entry spec cycles 0,1,0,1 — entry 1 has no data_file, entry 0 does
+    assert cfgs[0].ica_args.data_file == cfgs[2].ica_args.data_file == "HCP_AllData_sess1.npz"
+    assert cfgs[1].ica_args.hidden_size == 348
