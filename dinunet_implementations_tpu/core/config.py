@@ -238,7 +238,10 @@ class TrainConfig:
         # Dataclass-typed blocks: a dict override merges into the block
         # (the GUI sends plain JSON objects for type="object" fields).
         for args_name, args_cls in _BLOCK_FIELDS.items():
-            block = getattr(cfg, args_name) or args_cls()
+            current = getattr(cfg, args_name)
+            if current is None and args_name not in overrides:
+                continue  # don't materialize an unset optional block
+            block = current or args_cls()
             fields = {f.name: f for f in dataclasses.fields(args_cls)}
             upd = {}
             if isinstance(overrides.get(args_name), dict):
@@ -320,8 +323,8 @@ def resolve_site_configs(
     """Build per-site configs for a ``datasets/<name>`` tree.
 
     Reads ``<dataset_dir>/inputspec.json`` if present; site i gets entry
-    ``i % len(spec)`` (the simulator reuses the last spec when there are more
-    site dirs than spec entries).
+    ``i % len(spec)``, cycling through the spec entries when there are more
+    sites than entries.
     """
     spec_path = os.path.join(dataset_dir, "inputspec.json")
     overrides: Sequence[dict] = [{}]
@@ -344,7 +347,7 @@ COMPSPEC_META: dict[str, dict] = {
     "mode": dict(type="select", source="owner", group="NN Params", order=4,
                  values=["train", "test"], label="NN Mode:"),
     "agg_engine": dict(type="select", source="owner", group="NN Params", order=5,
-                       values=["dSGD", "rankDAD"],
+                       values=list(AggEngine.ALL),
                        conditional=dict(variable="mode", value="train"),
                        label="Pick aggregation engine:"),
     "num_reducers": dict(type="number", source="owner", group="NN Params", order=6,

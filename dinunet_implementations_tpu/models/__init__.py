@@ -1,0 +1,3 @@
+from .icalstm import BiLSTM, ICALstm, LSTMCell
+from .layers import BatchNorm, masked_moments
+from .msannet import MSANNet

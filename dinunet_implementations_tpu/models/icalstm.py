@@ -1,0 +1,150 @@
+"""ICALstm — the ICA-timecourse bidirectional LSTM classifier.
+
+Capability parity with reference ``comps/icalstm/models.py:5-110``:
+
+- per-window encoder ``Linear(num_comps*window → input_size) + ReLU``
+  (the reference applies it in a Python loop over the batch,
+  ``models.py:107``; here it is one batched matmul over ``[B*S]`` rows);
+- hand-rolled (bi)LSTM: per direction a cell with ``i2h: (D → 4H)``,
+  ``h2h: (H → 4H)``; ``hidden_size`` is split across directions
+  (``models.py:55-57``); the reverse direction runs over the time-flipped
+  input and hidden sequences concat on the feature dim (``models.py:60-65``);
+- mean-pool over time, then the classifier head
+  ``Dropout(0.25) → Linear(H→256) → BatchNorm1d(256) → ReLU → Linear(256→64)
+  → ReLU → Linear(64→num_cls)`` (``models.py:96-104``).
+
+**Gate math.** The reference cell has a numerical quirk
+(``models.py:31-38``): it applies ``sigmoid`` to the i/f/o pre-activations
+*twice* (``gates = preact[:, :3H].sigmoid()`` then ``sigmoid(gates[...])``),
+while ``g`` uses ``tanh`` of the raw pre-activation. ``double_sigmoid_gates``
+reproduces that bit-for-bit for parity runs; the default is standard LSTM
+gates (single sigmoid), which trains strictly better.
+
+TPU-first shape of the recurrence: the input projection for *all* timesteps is
+hoisted out of the loop into one ``[B*T, D] @ [D, 4H]`` MXU matmul; only the
+``h @ W_hh`` recurrence stays inside ``lax.scan`` (sequential by nature).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .layers import BatchNorm, TorchLinearInit, dense
+
+
+def _lstm_gates(preact, H, double_sigmoid: bool):
+    if double_sigmoid:
+        gates = jax.nn.sigmoid(preact[..., : 3 * H])
+        i = jax.nn.sigmoid(gates[..., :H])
+        f = jax.nn.sigmoid(gates[..., H : 2 * H])
+        o = jax.nn.sigmoid(gates[..., 2 * H : 3 * H])
+    else:
+        i = jax.nn.sigmoid(preact[..., :H])
+        f = jax.nn.sigmoid(preact[..., H : 2 * H])
+        o = jax.nn.sigmoid(preact[..., 2 * H : 3 * H])
+    g = jnp.tanh(preact[..., 3 * H :])
+    return i, f, o, g
+
+
+class LSTMCell(nn.Module):
+    """One direction over a full sequence: x [B, T, D] → hidden seq [B, T, H].
+
+    Reference ``comps/icalstm/models.py:5-45`` — but the Python
+    loop-over-timesteps becomes ``lax.scan`` and the i2h projection one batched
+    matmul.
+    """
+
+    hidden_size: int
+    double_sigmoid_gates: bool = False
+
+    @nn.compact
+    def __call__(self, x, h0=None):
+        B, T, D = x.shape
+        H = self.hidden_size
+        w_ih = self.param("w_ih", TorchLinearInit.kernel, (D, 4 * H))
+        b_ih = self.param("b_ih", TorchLinearInit.bias_for(D), (4 * H,))
+        w_hh = self.param("w_hh", TorchLinearInit.kernel, (H, 4 * H))
+        b_hh = self.param("b_hh", TorchLinearInit.bias_for(H), (4 * H,))
+
+        xi = x @ w_ih + b_ih  # [B, T, 4H] — all timesteps in one matmul
+        if h0 is None:
+            h0 = (jnp.zeros((B, H), x.dtype), jnp.zeros((B, H), x.dtype))
+
+        def step(carry, xt):
+            h, c = carry
+            preact = xt + h @ w_hh + b_hh
+            i, f, o, g = _lstm_gates(preact, H, self.double_sigmoid_gates)
+            c = f * c + i * g
+            h = o * jnp.tanh(c)
+            return (h, c), h
+
+        (hT, cT), hs = jax.lax.scan(step, h0, jnp.swapaxes(xi, 0, 1))
+        return jnp.swapaxes(hs, 0, 1), (hT, cT)
+
+
+class BiLSTM(nn.Module):
+    """Bidirectional wrapper (reference ``comps/icalstm/models.py:48-66``):
+    ``hidden_size`` is the *total* width, split across directions."""
+
+    hidden_size: int
+    bidirectional: bool = True
+    double_sigmoid_gates: bool = False
+
+    @nn.compact
+    def __call__(self, x, h0=None):
+        per_dir = self.hidden_size // (2 if self.bidirectional else 1)
+        fwd, (h, c) = LSTMCell(per_dir, self.double_sigmoid_gates, name="fwd")(x, h0)
+        if not self.bidirectional:
+            return fwd, (h, c)
+        rev, (hr, cr) = LSTMCell(per_dir, self.double_sigmoid_gates, name="rev")(
+            jnp.flip(x, axis=1), h0
+        )
+        return (
+            jnp.concatenate([fwd, rev], axis=2),
+            (jnp.concatenate([h, hr], 1), jnp.concatenate([c, cr], 1)),
+        )
+
+
+class ICALstm(nn.Module):
+    input_size: int = 256
+    hidden_size: int = 256
+    bidirectional: bool = True
+    num_cls: int = 2
+    num_comps: int = 53
+    window_size: int = 20
+    num_layers: int = 1  # parity field; reference builds 1 layer regardless
+    double_sigmoid_gates: bool = False
+    dropout_rate: float = 0.25
+
+    @nn.compact
+    def __call__(self, x, train: bool = True, mask=None):
+        # x: [B, S, C, W] (windows, components, timepoints-per-window)
+        B, S = x.shape[0], x.shape[1]
+        flat = x.reshape(B, S, -1)  # [B, S, C*W]
+        enc = nn.relu(
+            dense(self.input_size, fan_in=self.num_comp_window, name="encoder")(flat)
+        )
+        o, h = BiLSTM(
+            self.hidden_size,
+            self.bidirectional,
+            self.double_sigmoid_gates,
+            name="lstm",
+        )(enc)
+        o = jnp.mean(o, axis=1)  # mean-pool over windows (models.py:109)
+
+        # classifier head (models.py:96-104); per-direction width totals
+        # hidden_size when bidirectional splits evenly, else 2*(H//2).
+        o = nn.Dropout(self.dropout_rate, deterministic=not train)(o)
+        o = dense(256, fan_in=o.shape[-1], name="cls_fc1")(o)
+        o = BatchNorm(256, track_running_stats=True, name="cls_bn")(
+            o, train=train, mask=mask
+        )
+        o = nn.relu(o)
+        o = nn.relu(dense(64, fan_in=256, name="cls_fc2")(o))
+        return dense(self.num_cls, fan_in=64, name="cls_fc3")(o)
+
+    @property
+    def num_comp_window(self):
+        return self.num_comps * self.window_size

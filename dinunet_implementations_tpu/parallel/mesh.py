@@ -67,5 +67,12 @@ def host_mesh(num_sites: int, model_axis_size: int = 1) -> Mesh:
     TPU-build replacement for the reference's Docker-based COINSTAC simulator
     (SURVEY.md §4.1).
     """
-    cpus = [d for d in jax.devices() if d.platform == "cpu"] or jax.devices()
+    cpus = [d for d in jax.devices() if d.platform == "cpu"]
+    if not cpus:
+        raise RuntimeError(
+            "host_mesh needs CPU host devices; set "
+            'jax.config.update("jax_platforms", "cpu") and '
+            'jax.config.update("jax_num_cpu_devices", N) before first jax use '
+            "(see tests/conftest.py)"
+        )
     return make_site_mesh(num_sites, cpus, model_axis_size)

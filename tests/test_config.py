@@ -115,3 +115,8 @@ def test_resolve_site_configs_cycles():
     # 2-entry spec cycles 0,1,0,1 — entry 1 has no data_file, entry 0 does
     assert cfgs[0].ica_args.data_file == cfgs[2].ica_args.data_file == "HCP_AllData_sess1.npz"
     assert cfgs[1].ica_args.hidden_size == 348
+
+
+def test_with_overrides_keeps_unset_pretrain_args_none():
+    cfg = TrainConfig().with_overrides({"batch_size": 8})
+    assert cfg.pretrain_args is None

@@ -1,0 +1,187 @@
+"""Data layer tests against the reference's real fixture tree."""
+
+import numpy as np
+import pytest
+
+from dinunet_implementations_tpu.data import (
+    FreeSurferDataset,
+    FSVDataHandle,
+    ICADataHandle,
+    ICADataset,
+    build_site_dataset,
+    coerce_label,
+    plan_epoch,
+    plan_eval,
+    read_aseg_stats,
+    resolve_splits,
+    split_by_ratio,
+    kfold_splits,
+    window_timecourses,
+)
+from dinunet_implementations_tpu.data.api import SiteArrays
+
+FSL = "/root/reference/datasets/test_fsl/input"
+SITE_SIZES = {0: 73, 1: 50, 2: 100, 3: 80, 4: 120}
+
+
+def _fs_cache(site):
+    return {
+        "labels_file": f"site{site + 1}_Covariate.csv",
+        "data_column": "freesurferfile",
+        "labels_column": "isControl",
+    }
+
+
+def _fs_state(site):
+    return {"baseDirectory": f"{FSL}/local{site}/simulatorRun"}
+
+
+def test_fs_handle_lists_covariate_index():
+    h = FSVDataHandle(cache=_fs_cache(0), state=_fs_state(0))
+    files = h.list_files()
+    assert len(files) == SITE_SIZES[0]
+    assert files[0] == "subject0_aseg_stats.txt"
+
+
+@pytest.mark.parametrize("site", [0, 1])
+def test_fs_dataset_materializes(site):
+    ds = build_site_dataset(FreeSurferDataset, FSVDataHandle, _fs_cache(site), _fs_state(site))
+    assert len(ds) == SITE_SIZES[site]
+    item = ds[0]
+    assert item["inputs"].shape == (66,)
+    assert item["inputs"].max() == pytest.approx(1.0)  # per-subject max-normalized
+    assert item["labels"] in (0, 1)
+    arrs = ds.as_arrays()
+    assert arrs.inputs.shape == (SITE_SIZES[site], 66)
+    np.testing.assert_allclose(arrs.inputs[0], item["inputs"])
+    # label parity with the covariate CSV ('False'→0, 'True'→1)
+    import csv
+
+    with open(f"{_fs_state(site)['baseDirectory']}/site{site + 1}_Covariate.csv") as fh:
+        rows = list(csv.DictReader(fh))
+    expect = [int(r["isControl"].strip().lower() == "true") for r in rows]
+    np.testing.assert_array_equal(arrs.labels, expect)
+
+
+def test_coerce_label():
+    assert coerce_label("True") == 1
+    assert coerce_label(" false ") == 0
+    assert coerce_label(True) == 1
+    assert coerce_label(0) == 0
+    assert coerce_label("1.0") == 1
+
+
+def test_ica_windowing_matches_reference_loop():
+    """Vectorized windowing == the reference's nested python loop
+    (comps/icalstm/__init__.py:27-33), incl. the overlap quirk."""
+    rng = np.random.default_rng(0)
+    data = rng.normal(size=(3, 5, 40))  # N=3 subjects, C=5 comps, T=40
+    for w, stride in [(10, 10), (10, 5), (8, 6)]:
+        temporal = 40
+        got = window_timecourses(data, temporal, w, stride)
+        spc = int(temporal / w)
+        ref = np.zeros((3, spc, 5, w))
+        for i in range(3):
+            for j in range(spc):
+                ref[i, j] = data[i, :, j * stride : j * stride + w]
+        np.testing.assert_allclose(got, ref)
+
+
+def test_ica_dataset_from_synthetic_fixture(tmp_path):
+    rng = np.random.default_rng(1)
+    data = rng.normal(size=(6, 4, 20)).astype(np.float32)
+    np.save(tmp_path / "tc.npy", data)
+    with open(tmp_path / "labels.csv", "w") as fh:
+        fh.write("index,label\n")
+        for i in range(6):
+            fh.write(f"{i},{i % 2}\n")
+    cache = {
+        "data_file": "tc.npy",
+        "labels_file": "labels.csv",
+        "window_size": 5,
+        "window_stride": 5,
+        "temporal_size": 20,
+        "num_components": 4,
+    }
+    state = {"baseDirectory": str(tmp_path)}
+    ds = build_site_dataset(ICADataset, ICADataHandle, cache, state)
+    assert len(ds) == 6
+    assert ds[0]["inputs"].shape == (4, 4, 5)  # [S, C, W]
+    arrs = ds.as_arrays()
+    assert arrs.inputs.shape == (6, 4, 4, 5)
+    np.testing.assert_array_equal(arrs.labels, [0, 1, 0, 1, 0, 1])
+
+
+def test_split_by_ratio_partition():
+    s = split_by_ratio(73, [0.7, 0.15, 0.15], seed=3)
+    allix = np.concatenate([s["train"], s["validation"], s["test"]])
+    assert len(allix) == 73
+    assert len(np.unique(allix)) == 73
+    assert len(s["train"]) == int(73 * 0.7)
+
+
+def test_kfold_partition():
+    folds = kfold_splits(50, 10, seed=0)
+    assert len(folds) == 10
+    for f in folds:
+        allix = np.concatenate([f["train"], f["validation"], f["test"]])
+        assert len(np.unique(allix)) == 50
+        assert len(f["test"]) == 5
+    # every sample is in exactly one test fold across folds
+    tests = np.concatenate([f["test"] for f in folds])
+    assert len(np.unique(tests)) == 50
+
+
+def test_resolve_splits_precedence(tmp_path):
+    import json
+
+    sf = tmp_path / "split0.json"
+    sf.write_text(json.dumps({"train": [0, 1], "validation": [2], "test": [3]}))
+    out = resolve_splits(4, split_files=["split0.json"], base_dir=str(tmp_path))
+    assert out[0]["train"] == [0, 1]
+    out = resolve_splits(40, num_folds=4)
+    assert len(out) == 4
+    out = resolve_splits(40, split_ratio=[0.8, 0.1, 0.1])
+    assert len(out) == 1
+
+
+def _mk_site(n, d=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return SiteArrays(
+        rng.normal(size=(n, d)).astype(np.float32),
+        (np.arange(n) % 2).astype(np.int32),
+        np.arange(n, dtype=np.int32),
+    )
+
+
+def test_plan_epoch_wrap():
+    sites = [_mk_site(40, seed=1), _mk_site(20, seed=2), _mk_site(33, seed=3)]
+    fb = plan_epoch(sites, batch_size=16, seed=0, pad_mode="wrap")
+    assert fb.inputs.shape == (3, 2, 16, 4)  # steps = 40//16 = 2
+    assert fb.weights.min() == 1.0  # wrap: no padding
+    # site 1 (20 samples → 1 batch) recycles for step 2
+    assert (fb.indices[1] >= 0).all()
+
+
+def test_plan_eval_mask_covers_all_once():
+    sites = [_mk_site(10), _mk_site(7)]
+    fb = plan_eval(sites, batch_size=4)
+    assert fb.steps == 3
+    # site 1: 7 real samples, 5 padded
+    assert fb.weights[1].sum() == 7
+    real = fb.indices[1][fb.weights[1] > 0]
+    np.testing.assert_array_equal(np.sort(real), np.arange(7))
+    # padding never counted
+    assert (fb.indices[1][fb.weights[1] == 0] == -1).all()
+
+
+def test_plan_epoch_empty_site_masked():
+    sites = [_mk_site(40), _mk_site(5)]  # site 1 < batch_size → 0 train batches
+    fb = plan_epoch(sites, batch_size=16, pad_mode="wrap")
+    assert fb.weights[1].sum() == 0  # contributes nothing, zero-weighted
+    assert fb.weights[0].sum() == 32
+
+
+def test_kfold_rejects_k1():
+    with pytest.raises(ValueError):
+        kfold_splits(10, 1)

@@ -1,0 +1,187 @@
+"""Model tests, incl. numerical parity against the reference torch modules.
+
+The reference model files import only torch, so we load them straight from
+/root/reference via importlib (read-only; bypasses the package __init__ which
+needs the coinstac_dinunet dependency). We then copy torch weights into our
+flax modules and require output parity — the strongest check that the
+re-design preserves reference semantics.
+"""
+
+import importlib.util
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from dinunet_implementations_tpu.models import ICALstm, LSTMCell, MSANNet
+
+
+def _load_ref(name, path):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+ref_fs = _load_ref("ref_fs_models", "/root/reference/comps/fs/models.py")
+ref_ica = _load_ref("ref_ica_models", "/root/reference/comps/icalstm/models.py")
+
+
+def t2j(t):
+    return jnp.asarray(t.detach().numpy())
+
+
+# ---------------------------------------------------------------------------
+# MSANNet
+# ---------------------------------------------------------------------------
+
+
+def _msannet_params_from_torch(tm):
+    params = {}
+    for i, layer in enumerate(tm.layers):
+        lin, bn = layer[0], layer[1]
+        params[f"linear_{i}"] = {"kernel": t2j(lin.weight).T}
+        params[f"bn_{i}"] = {"scale": t2j(bn.weight), "bias": t2j(bn.bias)}
+    params["fc_out"] = {"kernel": t2j(tm.fc_out.weight).T, "bias": t2j(tm.fc_out.bias)}
+    return {"params": params}
+
+
+def test_msannet_matches_torch():
+    torch.manual_seed(0)
+    tm = ref_fs.MSANNet(in_size=66, hidden_sizes=[256, 128, 64, 32], out_size=2)
+    tm.train()  # track_running_stats=False → batch stats in any mode
+    x = torch.randn(16, 66)
+    with torch.no_grad():
+        ref_out = tm(x).numpy()
+
+    jm = MSANNet(in_size=66, hidden_sizes=(256, 128, 64, 32), out_size=2)
+    out = jm.apply(_msannet_params_from_torch(tm), jnp.asarray(x.numpy()), train=True)
+    np.testing.assert_allclose(np.asarray(out), ref_out, atol=2e-5)
+
+
+def test_msannet_mask_equals_subbatch():
+    """Masked batch-norm: padded rows must not alter real rows' outputs."""
+    jm = MSANNet(in_size=6, hidden_sizes=(8,), out_size=2)
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (10, 6))
+    params = jm.init(key, x, train=True)
+    sub = jm.apply(params, x[:7], train=True)
+    padded = jnp.concatenate([x[:7], jnp.zeros((3, 6))])
+    mask = jnp.array([1.0] * 7 + [0.0] * 3)
+    full = jm.apply(params, padded, train=True, mask=mask)
+    np.testing.assert_allclose(np.asarray(full[:7]), np.asarray(sub), atol=1e-5)
+
+
+def test_msannet_dropout_active_only_in_train():
+    jm = MSANNet(in_size=6, hidden_sizes=(8,), out_size=2, dropout_in=(0,))
+    key = jax.random.PRNGKey(1)
+    x = jax.random.normal(key, (4, 6))
+    params = jm.init({"params": key, "dropout": key}, x, train=True)
+    e1 = jm.apply(params, x, train=False)
+    e2 = jm.apply(params, x, train=False)
+    np.testing.assert_allclose(np.asarray(e1), np.asarray(e2))
+    t1 = jm.apply(params, x, train=True, rngs={"dropout": jax.random.PRNGKey(2)})
+    t2 = jm.apply(params, x, train=True, rngs={"dropout": jax.random.PRNGKey(3)})
+    assert not np.allclose(np.asarray(t1), np.asarray(t2))
+
+
+# ---------------------------------------------------------------------------
+# LSTM cell / ICALstm
+# ---------------------------------------------------------------------------
+
+
+def _lstm_cell_params_from_torch(tc):
+    return {
+        "w_ih": t2j(tc.i2h.weight).T,
+        "b_ih": t2j(tc.i2h.bias),
+        "w_hh": t2j(tc.h2h.weight).T,
+        "b_hh": t2j(tc.h2h.bias),
+    }
+
+
+@pytest.mark.parametrize("T,H,D", [(7, 12, 9)])
+def test_lstm_cell_matches_reference_double_sigmoid(T, H, D):
+    """Our double_sigmoid_gates=True reproduces the reference cell bit-for-bit
+    (incl. the i/f/o double-sigmoid quirk, comps/icalstm/models.py:31-38)."""
+    torch.manual_seed(1)
+    tc = ref_ica.LSTMCell(D, H)
+    x = torch.randn(3, T, D)
+    with torch.no_grad():
+        ref_seq, (ref_h, ref_c) = tc(x)
+
+    cell = LSTMCell(H, double_sigmoid_gates=True)
+    seq, (h, c) = cell.apply(
+        {"params": _lstm_cell_params_from_torch(tc)}, jnp.asarray(x.numpy())
+    )
+    np.testing.assert_allclose(np.asarray(seq), ref_seq.numpy(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h), ref_h.numpy(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(c), ref_c.numpy(), atol=1e-5)
+
+
+def test_lstm_cell_standard_gates_differ():
+    """Default (standard) gates intentionally differ from the quirk mode."""
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (2, 5, 6))
+    std = LSTMCell(8, double_sigmoid_gates=False)
+    params = std.init(key, x)
+    quirk = LSTMCell(8, double_sigmoid_gates=True)
+    s, _ = std.apply(params, x)
+    q, _ = quirk.apply(params, x)
+    assert not np.allclose(np.asarray(s), np.asarray(q))
+
+
+def _icalstm_params_from_torch(tm):
+    enc = tm.encoder[0]
+    p = {
+        "encoder": {"kernel": t2j(enc.weight).T, "bias": t2j(enc.bias)},
+        "lstm": {
+            "fwd": _lstm_cell_params_from_torch(tm.lstm.lstms[0]),
+            "rev": _lstm_cell_params_from_torch(tm.lstm.lstms[1]),
+        },
+        "cls_fc1": {"kernel": t2j(tm.classifier[1].weight).T, "bias": t2j(tm.classifier[1].bias)},
+        "cls_bn": {"scale": t2j(tm.classifier[2].weight), "bias": t2j(tm.classifier[2].bias)},
+        "cls_fc2": {"kernel": t2j(tm.classifier[4].weight).T, "bias": t2j(tm.classifier[4].bias)},
+        "cls_fc3": {"kernel": t2j(tm.classifier[6].weight).T, "bias": t2j(tm.classifier[6].bias)},
+    }
+    stats = {
+        "cls_bn": {
+            "mean": t2j(tm.classifier[2].running_mean),
+            "var": t2j(tm.classifier[2].running_var),
+        }
+    }
+    return {"params": p, "batch_stats": stats}
+
+
+def test_icalstm_matches_torch_eval():
+    """Full-model eval parity (dropout off, BN running stats) with the
+    double-sigmoid quirk enabled."""
+    torch.manual_seed(2)
+    tm = ref_ica.ICALstm(
+        input_size=32, hidden_size=24, bidirectional=True, num_cls=2,
+        num_comps=5, window_size=4,
+    )
+    tm.eval()
+    x = torch.randn(6, 8, 5, 4)  # [B, S, C, W]
+    with torch.no_grad():
+        ref_out, _ = tm(x)
+
+    jm = ICALstm(
+        input_size=32, hidden_size=24, bidirectional=True, num_cls=2,
+        num_comps=5, window_size=4, double_sigmoid_gates=True,
+    )
+    out = jm.apply(_icalstm_params_from_torch(tm), jnp.asarray(x.numpy()), train=False)
+    np.testing.assert_allclose(np.asarray(out), ref_out.numpy(), atol=2e-5)
+
+
+def test_icalstm_default_shapes_jit():
+    """Default config (inputspec: 100 comps, window 10, hidden 348) compiles
+    under jit with static shapes."""
+    jm = ICALstm(input_size=64, hidden_size=48, num_comps=10, window_size=5)
+    key = jax.random.PRNGKey(0)
+    x = jnp.ones((4, 6, 10, 5))
+    variables = jm.init({"params": key, "dropout": key}, x, train=True)
+    fwd = jax.jit(lambda v, xx: jm.apply(v, xx, train=False))
+    out = fwd(variables, x)
+    assert out.shape == (4, 2)
