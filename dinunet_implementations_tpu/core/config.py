@@ -239,8 +239,9 @@ class TrainConfig:
         # (the GUI sends plain JSON objects for type="object" fields).
         for args_name, args_cls in _BLOCK_FIELDS.items():
             current = getattr(cfg, args_name)
-            if current is None and args_name not in overrides:
-                continue  # don't materialize an unset optional block
+            if current is None and overrides.get(args_name) is None:
+                continue  # don't materialize an unset optional block (even on
+                # an explicit JSON null override)
             block = current or args_cls()
             fields = {f.name: f for f in dataclasses.fields(args_cls)}
             upd = {}

@@ -87,11 +87,6 @@ class FreeSurferDataset(SiteDataset):
         x = read_aseg_stats(os.path.join(self.path(), file))
         return {"inputs": x, "labels": y, "ix": ix}
 
-    def path(self, cache_key: str = "data_file") -> str:
-        # FS sample files live directly in the base directory (reference
-        # ``self.path()`` with no data_file key set).
-        return super().path(cache_key)
-
     def as_arrays(self) -> SiteArrays:
         n = len(self.indices)
         feats = [read_aseg_stats(os.path.join(self.path(), f)) for f, _ in self.indices]

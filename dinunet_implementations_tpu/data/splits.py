@@ -24,12 +24,12 @@ def split_by_ratio(n: int, ratio, seed: int = 0) -> dict:
     sample lands somewhere.
     """
     ratio = list(ratio)
-    if len(ratio) == 2:
-        ratio = ratio + [0.0]
+    test_share = len(ratio) > 2 and ratio[2] > 0
     rng = np.random.default_rng(seed)
     perm = rng.permutation(n)
     n_train = int(n * ratio[0])
-    n_val = int(n * ratio[1])
+    # with no test share, flooring remainders go to validation, not test
+    n_val = (n - n_train) if not test_share else int(n * ratio[1])
     return {
         "train": np.sort(perm[:n_train]),
         "validation": np.sort(perm[n_train : n_train + n_val]),

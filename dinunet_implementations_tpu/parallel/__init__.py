@@ -8,7 +8,9 @@ from .mesh import (
 )
 from .collectives import (
     payload_cast,
+    payload_dtype,
     payload_uncast,
+    site_weight_scale,
     site_all_gather,
     site_count,
     site_index,

@@ -26,6 +26,19 @@ from .mesh import SITE_AXIS
 _PAYLOAD_DTYPES = {"32": jnp.float32, "16": jnp.bfloat16, 32: jnp.float32, 16: jnp.bfloat16}
 
 
+def payload_dtype(precision_bits="32"):
+    """Resolve the ``precision_bits`` flag to the payload dtype."""
+    return _PAYLOAD_DTYPES[precision_bits]
+
+
+def site_weight_scale(weight, axis_name: str = SITE_AXIS):
+    """Per-site normalized weight ``w_s / Σ w`` with a zero-total guard (an
+    all-masked round yields scale 0, keeping updates finite)."""
+    w = jnp.asarray(weight, jnp.float32)
+    total = jax.lax.psum(w, axis_name)
+    return jnp.where(total > 0, w / jnp.maximum(total, 1e-12), 0.0)
+
+
 def payload_cast(tree, precision_bits="32"):
     """Cast a gradient pytree to the configured payload dtype before the
     collective — the TPU equivalent of the reference's fp16 payload compression."""
@@ -56,10 +69,7 @@ def site_weighted_mean(tree, weight, axis_name: str = SITE_AXIS):
     heterogeneous), so the aggregate equals the pooled-data gradient. ``weight``
     is a scalar per site (e.g. this round's example count).
     """
-    w = jnp.asarray(weight, jnp.float32)
-    total = jax.lax.psum(w, axis_name)
-    # Guard the all-masked-round case (total==0) to keep the update finite.
-    scale = jnp.where(total > 0, w / jnp.maximum(total, 1e-12), 0.0)
+    scale = site_weight_scale(weight, axis_name)
     # Accumulate in fp32 even for bf16 payloads; cast back only after the psum.
     return jax.tree.map(
         lambda g: jax.lax.psum(g.astype(jnp.float32) * scale, axis_name).astype(g.dtype),

@@ -185,3 +185,9 @@ def test_plan_epoch_empty_site_masked():
 def test_kfold_rejects_k1():
     with pytest.raises(ValueError):
         kfold_splits(10, 1)
+
+
+def test_split_ratio_two_way_no_test_leak():
+    s = split_by_ratio(73, [0.8, 0.2], seed=0)
+    assert len(s["test"]) == 0
+    assert len(s["train"]) + len(s["validation"]) == 73

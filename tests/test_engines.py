@@ -1,0 +1,173 @@
+"""Aggregation-engine tests (SURVEY.md §4 implication (b): parity of each
+engine against analytic expectations)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from dinunet_implementations_tpu.engines import (
+    make_engine,
+    available_engines,
+    subspace_iteration,
+)
+from dinunet_implementations_tpu.parallel import SITE_AXIS, host_mesh
+
+S = 4
+
+
+def _tree(seed, scale=1.0):
+    rng = np.random.default_rng(seed)
+    return {
+        "dense": {"kernel": jnp.asarray(rng.normal(size=(S, 12, 8)) * scale, jnp.float32),
+                  "bias": jnp.asarray(rng.normal(size=(S, 8)) * scale, jnp.float32)},
+        "head": {"kernel": jnp.asarray(rng.normal(size=(S, 8, 2)) * scale, jnp.float32)},
+    }
+
+
+def _weights():
+    return jnp.asarray([3.0, 5.0, 2.0, 7.0])
+
+
+def _pooled(tree, w):
+    w = np.asarray(w)
+
+    def f(g):
+        g = np.asarray(g)
+        return (g * w.reshape(-1, *([1] * (g.ndim - 1)))).sum(0) / w.sum()
+
+    return jax.tree.map(f, tree)
+
+
+def _run_engine(name, tree, w, **cfg):
+    mesh = host_mesh(S)
+    eng = make_engine(name, **cfg)
+    state = eng.init(jax.tree.map(lambda g: g[0], tree))
+
+    def fn(g, wv):
+        g = jax.tree.map(lambda x: x[0], g)  # shard_map gives [1, ...] per site
+        agg, st = eng.aggregate(g, state, wv[0], SITE_AXIS)
+        return jax.tree.map(lambda x: x[None], agg)
+
+    out = shard_map(
+        fn, mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P(SITE_AXIS), tree), P(SITE_AXIS)),
+        out_specs=jax.tree.map(lambda _: P(SITE_AXIS), tree),
+    )(tree, w)
+    return jax.tree.map(lambda x: np.asarray(x[0]), out)
+
+
+def test_registry():
+    assert available_engines() == ["dSGD", "powerSGD", "rankDAD"]
+    with pytest.raises(ValueError):
+        make_engine("nope")
+
+
+def test_dsgd_equals_pooled():
+    tree, w = _tree(0), _weights()
+    agg = _run_engine("dSGD", tree, w)
+    expect = _pooled(tree, w)
+    jax.tree.map(
+        lambda a, e: np.testing.assert_allclose(a, e, rtol=1e-4, atol=1e-6), agg, expect
+    )
+
+
+def test_rankdad_full_rank_equals_pooled():
+    """With rank >= min(m, n) the power iteration is exact → rankDAD == dSGD."""
+    tree, w = _tree(1), _weights()
+    agg = _run_engine("rankDAD", tree, w, dad_reduction_rank=8, dad_num_pow_iters=25,
+                      dad_tol=1e-9)
+    expect = _pooled(tree, w)
+    jax.tree.map(lambda a, e: np.testing.assert_allclose(a, e, atol=1e-4), agg, expect)
+
+
+def test_rankdad_low_rank_compresses():
+    """rank-1 compression of a rank-1 matrix is exact; of a full-rank matrix
+    it is lossy but bounded by the spectral tail."""
+    rng = np.random.default_rng(2)
+    u = rng.normal(size=(S, 12, 1)).astype(np.float32)
+    v = rng.normal(size=(S, 1, 8)).astype(np.float32)
+    tree = {"k": jnp.asarray(u @ v)}
+    w = _weights()
+    agg = _run_engine("rankDAD", tree, w, dad_reduction_rank=1, dad_num_pow_iters=10,
+                      dad_tol=1e-9)
+    expect = _pooled(tree, w)
+    np.testing.assert_allclose(agg["k"], expect["k"], atol=1e-4)
+
+
+def test_powersgd_error_feedback_converges():
+    """Error-feedback property: a single compressed round is lossy, but the
+    *time-averaged* updates converge to the true gradient — telescoping gives
+    (1/T)·Σ Ĝ_t = Ḡ + (Ḡ − M_{T+1})/T with M bounded, so error ~ 1/T."""
+    mesh = host_mesh(S)
+    tree, w = _tree(3), _weights()
+    eng = make_engine("powerSGD", dad_reduction_rank=2)
+    expect = _pooled(tree, w)
+
+    def multi_round(g, wv):
+        g0 = jax.tree.map(lambda x: x[0], g)
+        st = eng.init(g0)
+        accs = []
+        acc = jax.tree.map(jnp.zeros_like, g0)
+        for t in range(24):
+            agg, st = eng.aggregate(g0, st, wv[0], SITE_AXIS)
+            acc = jax.tree.map(lambda a, x: a + x, acc, agg)
+            if t + 1 in (4, 24):
+                accs.append(jax.tree.map(lambda a: a / (t + 1), acc))
+        return jax.tree.map(lambda x: x[None], {"t4": accs[0], "t24": accs[1]})
+
+    spec_in = jax.tree.map(lambda _: P(SITE_AXIS), tree)
+    out = shard_map(
+        multi_round, mesh=mesh,
+        in_specs=(spec_in, P(SITE_AXIS)),
+        out_specs={"t4": spec_in, "t24": spec_in},
+    )(tree, w)
+    avg4 = jax.tree.map(lambda x: np.asarray(x[0]), out["t4"])
+    avg24 = jax.tree.map(lambda x: np.asarray(x[0]), out["t24"])
+
+    def err(a):
+        return np.linalg.norm(a["dense"]["kernel"] - expect["dense"]["kernel"])
+
+    assert err(avg24) < err(avg4)  # averaging converges
+    np.testing.assert_allclose(
+        avg24["dense"]["kernel"], expect["dense"]["kernel"], atol=0.25
+    )
+    # dense (1-D) path is exact every round
+    np.testing.assert_allclose(avg24["dense"]["bias"], expect["dense"]["bias"], rtol=1e-4)
+
+
+def test_powersgd_bias_dense_exact():
+    tree, w = _tree(4), _weights()
+    agg = _run_engine("powerSGD", tree, w, dad_reduction_rank=2)
+    expect = _pooled(tree, w)
+    np.testing.assert_allclose(agg["dense"]["bias"], expect["dense"]["bias"], rtol=1e-5)
+
+
+def test_subspace_iteration_exact_on_lowrank():
+    rng = np.random.default_rng(5)
+    G = (rng.normal(size=(20, 3)) @ rng.normal(size=(3, 15))).astype(np.float32)
+    P, Q = subspace_iteration(jnp.asarray(G), 3, 20, 1e-10)
+    np.testing.assert_allclose(np.asarray(P @ Q.T), G, atol=1e-3)
+
+
+def test_subspace_iteration_tol_early_exit():
+    """A huge tol stops after the first refinement (initial delta is inf, so
+    exactly one iteration runs) — same result as num_iters=1, under jit."""
+    rng = np.random.default_rng(6)
+    G = jnp.asarray(rng.normal(size=(16, 10)).astype(np.float32))
+    P1, Q1 = jax.jit(lambda g: subspace_iteration(g, 4, 100, 1e9))(G)
+    P2, Q2 = subspace_iteration(G, 4, 1, 0.0)
+    np.testing.assert_allclose(np.asarray(P1 @ Q1.T), np.asarray(P2 @ Q2.T), atol=1e-5)
+
+
+def test_engines_precision16_still_close():
+    tree, w = _tree(7), _weights()
+    for name in ("dSGD", "rankDAD", "powerSGD"):
+        agg = _run_engine(name, tree, w, precision_bits="16", dad_reduction_rank=8,
+                          dad_num_pow_iters=20, dad_tol=1e-9)
+        expect = _pooled(tree, w)
+        np.testing.assert_allclose(
+            agg["dense"]["bias"], expect["dense"]["bias"], rtol=0.02, err_msg=name
+        )
