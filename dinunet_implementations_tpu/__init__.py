@@ -27,3 +27,17 @@ from .core.config import (
 from .parallel.mesh import MODEL_AXIS, SITE_AXIS, host_mesh, make_site_mesh
 
 __version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # Heavier subsystems are imported lazily so `import dinunet_implementations_tpu`
+    # stays light for config-only uses.
+    if name in ("FedRunner", "SiteRunner"):
+        from .runner import fed_runner
+
+        return getattr(fed_runner, name)
+    if name == "FederatedTrainer":
+        from .trainer.loop import FederatedTrainer
+
+        return FederatedTrainer
+    raise AttributeError(name)

@@ -65,10 +65,14 @@ def make_powersgd(
                     None,
                 )
             M = to_matrix(g).astype(jnp.float32) + e
-            P = jax.lax.psum((M @ q * scale).astype(pdtype), axis_name)
-            P = orthonormalize(P.astype(jnp.float32))
-            q_new = jax.lax.psum((M.T @ P * scale).astype(pdtype), axis_name).astype(
-                jnp.float32
+            # wire-compress to the payload dtype, then accumulate in fp32
+            # (policy in parallel/collectives.py: psum never runs in bf16)
+            P = jax.lax.psum(
+                (M @ q * scale).astype(pdtype).astype(jnp.float32), axis_name
+            )
+            P = orthonormalize(P)
+            q_new = jax.lax.psum(
+                (M.T @ P * scale).astype(pdtype).astype(jnp.float32), axis_name
             )
             G_hat = P @ q_new.T
             e_new = M - G_hat

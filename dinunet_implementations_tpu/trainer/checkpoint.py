@@ -1,0 +1,76 @@
+"""Checkpoint / resume.
+
+The reference's persistence is implicit: cross-round module-level ``CACHE``
+dicts plus library-side best-model files implied by ``best_val_epoch``
+(SURVEY.md §5 checkpoint/resume). Here it is explicit and complete: params +
+batch_stats + optimizer state + engine state + RNG + round counter, serialized
+with flax msgpack. ``save_best``/warm-start covers the reference's
+``pretrain`` largest-site warm start (``compspec.json:120-127``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import flax.serialization
+import jax
+import jax.numpy as jnp
+
+from .steps import TrainState
+
+
+def save_checkpoint(path: str, state: TrainState, meta: dict | None = None) -> str:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    payload = {
+        "params": state.params,
+        "batch_stats": state.batch_stats,
+        "opt_state": state.opt_state,
+        "engine_state": state.engine_state,
+        "rng": state.rng,
+        "round": state.round,
+    }
+    with open(path, "wb") as fh:
+        fh.write(flax.serialization.to_bytes(payload))
+    if meta is not None:
+        with open(path + ".meta.json", "w") as fh:
+            json.dump(meta, fh, indent=2)
+    return path
+
+
+def load_checkpoint(path: str, like: TrainState) -> TrainState:
+    """Restore into the structure of ``like`` (shapes/treedef must match)."""
+    template = {
+        "params": like.params,
+        "batch_stats": like.batch_stats,
+        "opt_state": like.opt_state,
+        "engine_state": like.engine_state,
+        "rng": like.rng,
+        "round": like.round,
+    }
+    with open(path, "rb") as fh:
+        restored = flax.serialization.from_bytes(template, fh.read())
+    return TrainState(
+        params=restored["params"],
+        batch_stats=restored["batch_stats"],
+        opt_state=restored["opt_state"],
+        engine_state=restored["engine_state"],
+        rng=jnp.asarray(restored["rng"]),
+        round=jnp.asarray(restored["round"]),
+    )
+
+
+def load_params(path: str, like_params: Any):
+    """Warm-start: load only params from a checkpoint (pretrain semantics)."""
+    with open(path, "rb") as fh:
+        raw = flax.serialization.msgpack_restore(fh.read())
+    return flax.serialization.from_state_dict(like_params, raw["params"])
+
+
+def checkpoint_meta(path: str) -> dict:
+    mpath = path + ".meta.json"
+    if os.path.exists(mpath):
+        with open(mpath) as fh:
+            return json.load(fh)
+    return {}
