@@ -27,7 +27,7 @@ CPU_BASELINE_SAMPLES_PER_SEC = 67.3
 NUM_SITES = 32
 BATCH_PER_SITE = 16
 STEPS_PER_EPOCH = 2
-TIMED_EPOCHS = 5
+TIMED_EPOCHS = 64  # large so the ~110ms tunnel round-trip amortizes
 
 
 def measure_tpu() -> float:
@@ -63,18 +63,41 @@ def measure_tpu() -> float:
     epoch_fn = make_train_epoch_fn(task, engine, opt, mesh=None, local_iterations=1)
 
     # warmup/compile (fetch a value — on the tunneled axon backend
-    # block_until_ready alone does not synchronize)
+    # block_until_ready alone does not synchronize; only a D2H fetch does)
     state, losses = epoch_fn(state, x, y, w)
     float(np.asarray(losses)[0])
 
+    # estimate the fixed host↔device round-trip so it can be subtracted
+    triv = jax.jit(lambda v: v + 1)
+    float(np.asarray(triv(jnp.zeros(()))))
+    r0 = time.time()
+    for _ in range(3):
+        float(np.asarray(triv(jnp.zeros(()))))
+    rtt = (time.time() - r0) / 3
+
+    # fuse EPOCHS_PER_DISPATCH epochs into one device program so the tunnel's
+    # per-dispatch host overhead (~35ms here) doesn't pollute the chip metric
+    E = 8
+
+    @jax.jit
+    def multi_epoch(st, x, y, w):
+        return jax.lax.fori_loop(
+            0, E, lambda i, s: epoch_fn(s, x, y, w)[0], st
+        )
+
+    state = multi_epoch(state, x, y, w)
+    float(np.asarray(state.round))  # sync after compile
+
     t0 = time.time()
-    for _ in range(TIMED_EPOCHS):
-        state, losses = epoch_fn(state, x, y, w)
-        float(np.asarray(losses)[0])  # hard sync each epoch
-    dt = time.time() - t0
+    q = max(TIMED_EPOCHS // E, 1)
+    for _ in range(q):
+        state = multi_epoch(state, x, y, w)
+    float(np.asarray(state.round))
+    dt = max(time.time() - t0 - rtt, 1e-6)
+    TIMED = q * E
 
     n_chips = 1  # the folded site axis runs on one chip
-    samples = S * steps * B * TIMED_EPOCHS
+    samples = S * steps * B * TIMED
     return samples / dt / n_chips
 
 
