@@ -48,16 +48,24 @@ def _lstm_gates(preact, H, double_sigmoid: bool):
     return i, f, o, g
 
 
+def _auto_pallas() -> bool:
+    return jax.default_backend() != "cpu"
+
+
 class LSTMCell(nn.Module):
     """One direction over a full sequence: x [B, T, D] → hidden seq [B, T, H].
 
     Reference ``comps/icalstm/models.py:5-45`` — but the Python
-    loop-over-timesteps becomes ``lax.scan`` and the i2h projection one batched
-    matmul.
+    loop-over-timesteps becomes ``lax.scan`` (or the fused Pallas recurrence
+    kernel, ops/lstm_pallas.py) and the i2h projection one batched matmul.
+
+    ``use_pallas``: None = auto (fused kernel on accelerators, scan on CPU);
+    the double-sigmoid compat mode always uses the scan path.
     """
 
     hidden_size: int
     double_sigmoid_gates: bool = False
+    use_pallas: bool | None = None
 
     @nn.compact
     def __call__(self, x, h0=None):
@@ -68,13 +76,21 @@ class LSTMCell(nn.Module):
         w_hh = self.param("w_hh", TorchLinearInit.kernel, (H, 4 * H))
         b_hh = self.param("b_hh", TorchLinearInit.bias_for(H), (4 * H,))
 
-        xi = x @ w_ih + b_ih  # [B, T, 4H] — all timesteps in one matmul
+        xi = x @ w_ih + (b_ih + b_hh)  # [B, T, 4H] — all timesteps, one matmul
         if h0 is None:
             h0 = (jnp.zeros((B, H), x.dtype), jnp.zeros((B, H), x.dtype))
 
+        use_pallas = (
+            self.use_pallas if self.use_pallas is not None else _auto_pallas()
+        ) and not self.double_sigmoid_gates
+        if use_pallas:
+            from ..ops.lstm_pallas import lstm_forward
+
+            return lstm_forward(xi, w_hh, h0[0], h0[1])
+
         def step(carry, xt):
             h, c = carry
-            preact = xt + h @ w_hh + b_hh
+            preact = xt + h @ w_hh
             i, f, o, g = _lstm_gates(preact, H, self.double_sigmoid_gates)
             c = f * c + i * g
             h = o * jnp.tanh(c)
@@ -91,16 +107,19 @@ class BiLSTM(nn.Module):
     hidden_size: int
     bidirectional: bool = True
     double_sigmoid_gates: bool = False
+    use_pallas: bool | None = None
 
     @nn.compact
     def __call__(self, x, h0=None):
         per_dir = self.hidden_size // (2 if self.bidirectional else 1)
-        fwd, (h, c) = LSTMCell(per_dir, self.double_sigmoid_gates, name="fwd")(x, h0)
+        fwd, (h, c) = LSTMCell(
+            per_dir, self.double_sigmoid_gates, self.use_pallas, name="fwd"
+        )(x, h0)
         if not self.bidirectional:
             return fwd, (h, c)
-        rev, (hr, cr) = LSTMCell(per_dir, self.double_sigmoid_gates, name="rev")(
-            jnp.flip(x, axis=1), h0
-        )
+        rev, (hr, cr) = LSTMCell(
+            per_dir, self.double_sigmoid_gates, self.use_pallas, name="rev"
+        )(jnp.flip(x, axis=1), h0)
         return (
             jnp.concatenate([fwd, rev], axis=2),
             (jnp.concatenate([h, hr], 1), jnp.concatenate([c, cr], 1)),
@@ -117,6 +136,7 @@ class ICALstm(nn.Module):
     num_layers: int = 1  # parity field; reference builds 1 layer regardless
     double_sigmoid_gates: bool = False
     dropout_rate: float = 0.25
+    use_pallas: bool | None = None  # None = auto (kernel on accelerators)
 
     @nn.compact
     def __call__(self, x, train: bool = True, mask=None):
@@ -130,6 +150,7 @@ class ICALstm(nn.Module):
             self.hidden_size,
             self.bidirectional,
             self.double_sigmoid_gates,
+            self.use_pallas,
             name="lstm",
         )(enc)
         o = jnp.mean(o, axis=1)  # mean-pool over windows (models.py:109)

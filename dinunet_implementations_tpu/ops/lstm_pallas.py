@@ -1,0 +1,276 @@
+"""Fused Pallas TPU kernel for the LSTM recurrence (forward + BPTT backward).
+
+The ICA-LSTM's hot loop (SURVEY.md §3.4) is the time recurrence: per step a
+small ``h @ W_hh`` matmul plus gate math. The XLA scan path (models/icalstm.py)
+already hoists the input projection; this kernel goes further and keeps the
+carry (h, c) and all four recurrence matrices resident in VMEM across the
+whole sequence, streaming per-step inputs/outputs HBM↔VMEM via the grid
+pipeline — no per-step HBM round trip for the carry, no per-step kernel
+launches.
+
+Layout choice: gates live in four separate ``[T, B, H]`` arrays (not one
+``[T, B, 4H]``) so every block's lane dimension is H and no slice ever crosses
+a lane boundary (Mosaic-friendly; see pallas_guide.md pitfall #2).
+
+Grid: ``(batch_tiles, T)`` — TPU grids execute sequentially, so VMEM scratch
+carries (h, c) across the T dimension; time-reversed index maps drive the
+backward kernel. The backward accumulates dW in a revisited output block.
+
+Semantics: standard LSTM gates (single sigmoid). The reference's
+double-sigmoid quirk mode stays on the XLA scan path (models/icalstm.py) —
+the kernel is the fast path for the default configuration.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+B_TILE = 128
+
+
+def _interpret() -> bool:
+    # Pallas TPU kernels run in interpreter mode on CPU (tests / simulators)
+    return jax.default_backend() == "cpu"
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(xi_i, xi_f, xi_o, xi_g, w, h0, c0, hs, cs, ai, af, ao, ag, h_s, c_s):
+    t = pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _():
+        h_s[:] = h0[:]
+        c_s[:] = c0[:]
+
+    h = h_s[:]
+    # preact_k = xi_k[t] + h @ W_k   (W resident in VMEM, [4, H, H])
+    i = jax.nn.sigmoid(xi_i[0] + jnp.dot(h, w[0], preferred_element_type=jnp.float32))
+    f = jax.nn.sigmoid(xi_f[0] + jnp.dot(h, w[1], preferred_element_type=jnp.float32))
+    o = jax.nn.sigmoid(xi_o[0] + jnp.dot(h, w[2], preferred_element_type=jnp.float32))
+    g = jnp.tanh(xi_g[0] + jnp.dot(h, w[3], preferred_element_type=jnp.float32))
+    c = f * c_s[:] + i * g
+    h = o * jnp.tanh(c)
+    h_s[:] = h
+    c_s[:] = c
+    hs[0] = h
+    cs[0] = c
+    ai[0] = i
+    af[0] = f
+    ao[0] = o
+    ag[0] = g
+
+
+def _fwd_call(xi4, w4, h0, c0):
+    T, B, H = xi4[0].shape
+    bt = min(B_TILE, B)
+    assert B % bt == 0, (
+        f"batch {B} must be a multiple of the kernel tile {bt}; "
+        "use lstm_forward(), which pads"
+    )
+    grid = (B // bt, T)
+    t_block = lambda b, t: (t, b, 0)
+    b_block = lambda b, t: (b, 0)
+    spec_t = pl.BlockSpec((1, bt, H), t_block, memory_space=pltpu.VMEM)
+    spec_b = pl.BlockSpec((bt, H), b_block, memory_space=pltpu.VMEM)
+    spec_w = pl.BlockSpec((4, H, H), lambda b, t: (0, 0, 0), memory_space=pltpu.VMEM)
+    out_shape = jax.ShapeDtypeStruct((T, B, H), jnp.float32)
+    outs = pl.pallas_call(
+        _fwd_kernel,
+        grid=grid,
+        in_specs=[spec_t] * 4 + [spec_w, spec_b, spec_b],
+        out_specs=[spec_t] * 6,
+        out_shape=[out_shape] * 6,
+        scratch_shapes=[pltpu.VMEM((bt, H), jnp.float32)] * 2,
+        interpret=_interpret(),
+    )(*xi4, w4, h0, c0)
+    return outs  # hs, cs, i, f, o, g
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_kernel(
+    T_total,
+    ai, af, ao, ag, cs, cs_prev, hs_prev, w, h0, c0, dhs, dhT, dcT,
+    dxi_i, dxi_f, dxi_o, dxi_g, dh0, dc0, dw,
+    dh_s, dc_s,
+):
+    t = pl.program_id(1)  # 0..T-1, walking time backwards: time = T-1-t
+    first_time = t == 0  # time T-1
+    last_time = t == T_total - 1  # time 0
+
+    @pl.when(first_time)
+    def _():
+        # seed the carries with the terminal-state cotangents (exact dcT/dhT);
+        # re-seeded at the start of every batch tile (per-tile state)
+        dh_s[:] = dhT[:]
+        dc_s[:] = dcT[:]
+
+    @pl.when(jnp.logical_and(first_time, pl.program_id(0) == 0))
+    def _():
+        # dW accumulates across ALL tiles and timesteps — zero it exactly once
+        dw[:] = jnp.zeros_like(dw)
+
+    i, f, o, g = ai[0], af[0], ao[0], ag[0]
+    c = cs[0]
+    c_prev = jnp.where(last_time, c0[:], cs_prev[0])
+    h_prev = jnp.where(last_time, h0[:], hs_prev[0])
+
+    tanh_c = jnp.tanh(c)
+    dh = dhs[0] + dh_s[:]
+    do = dh * tanh_c
+    dc = dh * o * (1.0 - tanh_c * tanh_c) + dc_s[:]
+    di = dc * g
+    df = dc * c_prev
+    dg = dc * i
+
+    dpi = di * i * (1.0 - i)
+    dpf = df * f * (1.0 - f)
+    dpo = do * o * (1.0 - o)
+    dpg = dg * (1.0 - g * g)
+
+    dxi_i[0] = dpi
+    dxi_f[0] = dpf
+    dxi_o[0] = dpo
+    dxi_g[0] = dpg
+
+    # dh_{t-1} = Σ_k dp_k @ W_kᵀ ; dW_k += h_{t-1}ᵀ @ dp_k
+    dh_prev = (
+        jnp.dot(dpi, w[0].T, preferred_element_type=jnp.float32)
+        + jnp.dot(dpf, w[1].T, preferred_element_type=jnp.float32)
+        + jnp.dot(dpo, w[2].T, preferred_element_type=jnp.float32)
+        + jnp.dot(dpg, w[3].T, preferred_element_type=jnp.float32)
+    )
+    dw[0] += jnp.dot(h_prev.T, dpi, preferred_element_type=jnp.float32)
+    dw[1] += jnp.dot(h_prev.T, dpf, preferred_element_type=jnp.float32)
+    dw[2] += jnp.dot(h_prev.T, dpo, preferred_element_type=jnp.float32)
+    dw[3] += jnp.dot(h_prev.T, dpg, preferred_element_type=jnp.float32)
+
+    dh_s[:] = dh_prev
+    dc_s[:] = dc * f
+
+    @pl.when(last_time)
+    def _():
+        dh0[:] = dh_s[:]
+        dc0[:] = dc_s[:]
+
+
+def _bwd_call(res, dhs, dhT, dcT):
+    w4, h0, c0, hs, cs, acts = res
+    T, B, H = hs.shape
+    bt = min(B_TILE, B)
+    grid = (B // bt, T)
+
+    rev = lambda b, t: (T - 1 - t, b, 0)
+    b_block = lambda b, t: (b, 0)
+    spec_rev = pl.BlockSpec((1, bt, H), rev, memory_space=pltpu.VMEM)
+    spec_prev = pl.BlockSpec(
+        (1, bt, H), lambda b, t: (jnp.maximum(T - 2 - t, 0), b, 0),
+        memory_space=pltpu.VMEM,
+    )
+    spec_b = pl.BlockSpec((bt, H), b_block, memory_space=pltpu.VMEM)
+    spec_w = pl.BlockSpec((4, H, H), lambda b, t: (0, 0, 0), memory_space=pltpu.VMEM)
+    t_shape = jax.ShapeDtypeStruct((T, B, H), jnp.float32)
+
+    outs = pl.pallas_call(
+        functools.partial(_bwd_kernel, T),
+        grid=grid,
+        in_specs=[spec_rev] * 4  # i, f, o, g
+        + [spec_rev, spec_prev, spec_prev, spec_w, spec_b, spec_b, spec_rev,
+           spec_b, spec_b],
+        out_specs=[spec_rev] * 4 + [spec_b, spec_b, spec_w],
+        out_shape=[t_shape] * 4
+        + [
+            jax.ShapeDtypeStruct((B, H), jnp.float32),
+            jax.ShapeDtypeStruct((B, H), jnp.float32),
+            jax.ShapeDtypeStruct((4, H, H), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((bt, H), jnp.float32)] * 2,
+        interpret=_interpret(),
+    )(*acts, cs, cs, hs, w4, h0, c0, dhs, dhT, dcT)
+    dxi = outs[:4]
+    dh0, dc0, dw = outs[4], outs[5], outs[6]
+    return dxi, dw, dh0, dc0
+
+
+# ---------------------------------------------------------------------------
+# public op with custom VJP
+# ---------------------------------------------------------------------------
+
+
+@jax.custom_vjp
+def lstm_recurrence(xi4, w4, h0, c0):
+    """Run the LSTM time recurrence.
+
+    Args:
+      xi4: tuple of four ``[T, B, H]`` input-projection arrays (i, f, o, g
+        pre-activations, i.e. ``x_t @ W_ih + b`` split per gate).
+      w4: ``[4, H, H]`` recurrent weights (i, f, o, g order).
+      h0, c0: ``[B, H]`` initial carry.
+
+    Returns: ``(hs [T, B, H], (hT, cT))``.
+    """
+    hs, cs, *_ = _fwd_call(xi4, w4, h0, c0)
+    return hs, (hs[-1], cs[-1])
+
+
+def _vjp_fwd(xi4, w4, h0, c0):
+    hs, cs, i, f, o, g = _fwd_call(xi4, w4, h0, c0)
+    # xi4 is NOT needed by the backward (dxi == dpreact); don't pin it
+    return (hs, (hs[-1], cs[-1])), (w4, h0, c0, hs, cs, (i, f, o, g))
+
+
+def _vjp_bwd(res, grads):
+    dhs, (dhT, dcT) = grads
+    dxi, dw, dh0, dc0 = _bwd_call(res, dhs, dhT, dcT)
+    return tuple(dxi), dw, dh0, dc0
+
+
+lstm_recurrence.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def lstm_forward(xi, w_hh, h0, c0):
+    """Convenience wrapper over :func:`lstm_recurrence` in model layout.
+
+    Args:
+      xi: ``[B, T, 4H]`` pre-computed input projections (i|f|o|g blocks —
+        the LSTMCell layout, ``x @ W_ih + b_ih + b_hh``).
+      w_hh: ``[H, 4H]`` recurrent weight in the same blocked layout.
+      h0, c0: ``[B, H]``.
+
+    Returns ``(hs [B, T, H], (hT, cT))``. Pads the batch to the kernel tile
+    internally and slices the padding off.
+    """
+    B, T, H4 = xi.shape
+    H = H4 // 4
+    in_dtype = xi.dtype
+    # the kernel computes in f32 (scratch/accumulators); cast at the boundary
+    xi = xi.astype(jnp.float32)
+    w_hh = w_hh.astype(jnp.float32)
+    h0 = h0.astype(jnp.float32)
+    c0 = c0.astype(jnp.float32)
+    bt = min(B_TILE, B)
+    pad = (-B) % bt
+    if pad:
+        xi = jnp.concatenate([xi, jnp.zeros((pad, T, H4), xi.dtype)], 0)
+        h0 = jnp.concatenate([h0, jnp.zeros((pad, H), h0.dtype)], 0)
+        c0 = jnp.concatenate([c0, jnp.zeros((pad, H), c0.dtype)], 0)
+    xi_t = jnp.swapaxes(xi, 0, 1)  # [T, B, 4H]
+    xi4 = tuple(xi_t[..., k * H : (k + 1) * H] for k in range(4))
+    w4 = jnp.stack([w_hh[:, k * H : (k + 1) * H] for k in range(4)])
+    hs, (hT, cT) = lstm_recurrence(xi4, w4, h0, c0)
+    hs = jnp.swapaxes(hs, 0, 1)
+    if pad:
+        hs, hT, cT = hs[:B], hT[:B], cT[:B]
+    return hs.astype(in_dtype), (hT.astype(in_dtype), cT.astype(in_dtype))
