@@ -120,6 +120,10 @@ class MultimodalArgs:
     """Multimodal FS+ICA transformer parameters (TPU-build extension;
     BASELINE.json configs: 'Multimodal FS+ICA Transformer, 64-site DP-SGD')."""
 
+    data_file: str = ""
+    labels_file: str = ""
+    data_column: str = "freesurferfile"
+    labels_column: str = "isControl"
     num_class: int = 2
     fs_input_size: int = 66
     num_components: int = 100

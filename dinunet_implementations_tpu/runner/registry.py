@@ -16,8 +16,12 @@ from ..core.config import NNComputation, TrainConfig
 from ..data.api import DataHandle, SiteDataset
 from ..data.freesurfer import FreeSurferDataset, FSVDataHandle
 from ..data.ica import ICADataHandle, ICADataset
+from ..data.multimodal import MultimodalDataHandle, MultimodalDataset
+from ..data.smri import SMRIDataHandle, SMRIDataset
+from ..models.cnn3d import SMRI3DNet
 from ..models.icalstm import ICALstm
 from ..models.msannet import MSANNet
+from ..models.transformer import MultimodalNet
 
 
 @dataclass(frozen=True)
@@ -50,12 +54,38 @@ def _build_icalstm(cfg: TrainConfig):
     )
 
 
+def _build_smri3d(cfg: TrainConfig):
+    a = cfg.smri3d_args
+    return SMRI3DNet(channels=tuple(a.channels), num_cls=a.num_class)
+
+
+def _build_multimodal(cfg: TrainConfig):
+    a = cfg.multimodal_args
+    return MultimodalNet(
+        fs_input_size=a.fs_input_size,
+        num_comps=a.num_components,
+        window_size=a.window_size,
+        embed_dim=a.embed_dim,
+        num_heads=a.num_heads,
+        num_layers=a.num_layers,
+        mlp_ratio=a.mlp_ratio,
+        num_cls=a.num_class,
+    )
+
+
 TASKS: dict[str, TaskSpec] = {
     NNComputation.TASK_FREE_SURFER: TaskSpec(
         NNComputation.TASK_FREE_SURFER, _build_msannet, FreeSurferDataset, FSVDataHandle
     ),
     NNComputation.TASK_ICA: TaskSpec(
         NNComputation.TASK_ICA, _build_icalstm, ICADataset, ICADataHandle
+    ),
+    NNComputation.TASK_SMRI_3D: TaskSpec(
+        NNComputation.TASK_SMRI_3D, _build_smri3d, SMRIDataset, SMRIDataHandle
+    ),
+    NNComputation.TASK_MULTIMODAL: TaskSpec(
+        NNComputation.TASK_MULTIMODAL, _build_multimodal,
+        MultimodalDataset, MultimodalDataHandle,
     ),
 }
 
