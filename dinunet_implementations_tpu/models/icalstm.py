@@ -49,7 +49,9 @@ def _lstm_gates(preact, H, double_sigmoid: bool):
 
 
 def _auto_pallas() -> bool:
-    return jax.default_backend() != "cpu"
+    # The fused kernel uses TPU-only pltpu.VMEM specs; any other accelerator
+    # (e.g. GPU) must fall back to the lax.scan path rather than crash.
+    return jax.default_backend() == "tpu"
 
 
 class LSTMCell(nn.Module):

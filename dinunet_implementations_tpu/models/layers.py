@@ -83,7 +83,9 @@ class TorchLinearInit:
     def kernel(key, shape, dtype=jnp.float32):
         # flax Dense kernel shape is (fan_in, fan_out)
         fan_in = shape[0]
-        bound = jnp.sqrt(1.0 / fan_in) * jnp.sqrt(3.0)  # kaiming_uniform(a=sqrt(5))
+        # torch kaiming_uniform_(a=sqrt(5)): gain = sqrt(2/(1+5)) = sqrt(1/3),
+        # bound = sqrt(3) * gain / sqrt(fan_in) = 1/sqrt(fan_in)
+        bound = jnp.sqrt(1.0 / fan_in)
         import jax
 
         return jax.random.uniform(key, shape, dtype, -bound, bound)

@@ -38,7 +38,7 @@ from .logs import (
     write_test_metrics_csv,
     zip_global_results,
 )
-from .metrics import Averages, ClassificationMetrics, is_improvement
+from .metrics import Averages, ClassificationMetrics, MulticlassMetrics, is_improvement
 from .steps import (
     FederatedTask,
     TrainState,
@@ -104,8 +104,15 @@ class FederatedTrainer:
         )
         probs = np.asarray(probs)  # [S, steps, B, C]
         loss = float(np.asarray(loss_sum).sum() / max(np.asarray(wsum).sum(), 1.0))
-        m = ClassificationMetrics()
-        m.add(probs[..., 1].reshape(-1), fb.labels.reshape(-1), fb.weights.reshape(-1))
+        if probs.shape[-1] == 2:
+            # binary: score = positive-class probability (reference semantics,
+            # AUC on prob[:,1], comps/icalstm/__init__.py:64-65)
+            m = ClassificationMetrics()
+            m.add(probs[..., 1].reshape(-1), fb.labels.reshape(-1), fb.weights.reshape(-1))
+        else:
+            m = MulticlassMetrics()
+            m.add(probs.reshape(-1, probs.shape[-1]), fb.labels.reshape(-1),
+                  fb.weights.reshape(-1))
         avg = Averages().add(loss, np.asarray(wsum).sum())
         return avg, m
 
@@ -170,6 +177,14 @@ class FederatedTrainer:
                 stop_epoch = epoch
                 break
 
+        # If the epoch count never hit a validation boundary (epochs <
+        # validation_epochs), best_state would be the untrained init — run a
+        # final validation so the trained weights compete for selection.
+        if best_metric is None and cfg.epochs > 0:
+            val_avg, val_metrics = self.evaluate(state, val_sites)
+            score = val_metrics.value(monitor) if monitor != "loss" else val_avg.avg
+            best_metric, best_epoch, best_state = score, stop_epoch, state
+
         # --- test with the best state (reference: best-epoch checkpoint)
         test_avg, test_metrics = self.evaluate(best_state, test_sites)
         monitored = test_metrics.value(monitor) if monitor != "loss" else test_avg.avg
@@ -202,14 +217,20 @@ class FederatedTrainer:
             for i, s in enumerate(train_sites)
         ]
         pre_opt = make_optimizer(self.cfg.optimizer, pa.learning_rate)
+        # Pretrain is a single-site warm start: use exact (dSGD) gradients
+        # regardless of the configured engine — rankDAD/powerSGD compression
+        # during warm-up would diverge from the reference's plain local SGD.
+        pre_engine = make_engine("dSGD", precision_bits=self.cfg.precision_bits)
         pre_epoch_fn = make_train_epoch_fn(
-            self.task, self.engine, pre_opt, self.mesh, pa.local_iterations
+            self.task, pre_engine, pre_opt, self.mesh, pa.local_iterations
         )
         pre_state = TrainState(
             params=state.params,
             batch_stats=state.batch_stats,
             opt_state=pre_opt.init(state.params),
-            engine_state=state.engine_state,
+            engine_state=jax.tree.map(
+                lambda a: jnp.stack([a] * self._num_sites), pre_engine.init(state.params)
+            ),
             rng=state.rng,
             round=state.round,
         )

@@ -45,7 +45,28 @@ class Averages:
         return [round(self.avg, 5)]
 
 
-class ClassificationMetrics:
+class _MetricValues:
+    """Shared ``value()``/``get()`` dispatch over the named scalar metrics."""
+
+    def value(self, name: str) -> float:
+        name = name.lower()
+        fns = {
+            "accuracy": self.accuracy,
+            "f1": self.f1,
+            "precision": self.precision,
+            "recall": self.recall,
+            "auc": self.auc,
+        }
+        if name not in fns:
+            raise ValueError(f"unknown metric {name!r} (have {sorted(fns)})")
+        return fns[name]()
+
+    def get(self, *names) -> list[float]:
+        names = names or ("accuracy", "f1")
+        return [round(self.value(n), 5) for n in names]
+
+
+class ClassificationMetrics(_MetricValues):
     """Binary classification metrics from accumulated scores+labels
     (reference ``new_metrics()``). ``scores`` may be hard predictions (0/1)
     or positive-class probabilities — AUC handles both (rank-based)."""
@@ -127,6 +148,78 @@ class ClassificationMetrics:
         r_pos = ranks[: len(pos)].sum()
         u = r_pos - len(pos) * (len(pos) + 1) / 2.0
         return float(u / (len(pos) * len(neg)))
+
+
+class MulticlassMetrics(_MetricValues):
+    """Metrics for ``num_class > 2`` from accumulated full probability rows.
+
+    The reference only ever evaluates binary heads (AUC on ``prob[:, 1]``,
+    ``comps/icalstm/__init__.py:64-65``), but ``num_class`` is a GUI knob —
+    this covers the configurable case instead of silently mis-scoring it:
+    accuracy from argmax, macro-averaged one-vs-rest precision/recall/F1/AUC.
+    Exposes the same ``value()/get()`` interface as ClassificationMetrics.
+    """
+
+    def __init__(self):
+        self.probs: list[np.ndarray] = []
+        self.labels: list[np.ndarray] = []
+
+    def add(self, probs, labels, weights=None):
+        probs = np.asarray(probs, np.float64).reshape(-1, np.asarray(probs).shape[-1])
+        labels = np.asarray(labels).reshape(-1)
+        if weights is not None:
+            keep = np.asarray(weights).reshape(-1) > 0
+            probs, labels = probs[keep], labels[keep]
+        self.probs.append(probs)
+        self.labels.append(labels.astype(np.int64))
+        return self
+
+    def merge(self, other: "MulticlassMetrics"):
+        self.probs += other.probs
+        self.labels += other.labels
+        return self
+
+    def _cat(self):
+        if not self.probs:
+            return np.zeros((0, 1)), np.zeros(0, np.int64)
+        return np.concatenate(self.probs), np.concatenate(self.labels)
+
+    def accuracy(self) -> float:
+        p, y = self._cat()
+        return float((p.argmax(-1) == y).mean()) if len(y) else 0.0
+
+    def _ovr(self, name: str) -> float:
+        """Macro-average a binary metric one-vs-rest over non-degenerate
+        classes. A class absent from the eval set (or, for AUC, one covering
+        the whole set) has no defined one-vs-rest score — including it as 0.0
+        would deflate the macro average and corrupt best-state selection."""
+        p, y = self._cat()
+        if not len(y):
+            return 0.0
+        vals = []
+        for c in range(p.shape[-1]):
+            pos = y == c
+            if not pos.any() or (name == "auc" and pos.all()):
+                continue
+            m = ClassificationMetrics()
+            if name == "auc":
+                m.add(p[:, c], pos.astype(np.int64))
+            else:
+                m.add((p.argmax(-1) == c).astype(np.float64), pos.astype(np.int64))
+            vals.append(m.value(name))
+        return float(np.mean(vals)) if vals else 0.0
+
+    def precision(self) -> float:
+        return self._ovr("precision")
+
+    def recall(self) -> float:
+        return self._ovr("recall")
+
+    def f1(self) -> float:
+        return self._ovr("f1")
+
+    def auc(self) -> float:
+        return self._ovr("auc")
 
     def value(self, name: str) -> float:
         name = name.lower()

@@ -32,7 +32,26 @@ def test_defaults_match_reference_compspec():
     assert cfg.fs_args.dad_num_pow_iters == 5
     assert cfg.fs_args.dad_tol == 1e-3
     assert cfg.ica_args.window_size == 10
-    assert cfg.ica_args.hidden_size == 384
+    # the workload value (datasets/icalstm/inputspec.json, both sites), not the
+    # compspec template's 384 — config, bench, and fixtures must agree
+    assert cfg.ica_args.hidden_size == 348
+    assert cfg.ica_args.seq_len == 13  # dead compspec field, kept for parity
+
+
+def test_defaults_match_reference_ica_inputspec():
+    """Pin ICA defaults against the reference's actual shipped inputspec."""
+    import json as _json
+
+    with open("/root/reference/datasets/icalstm/inputspec.json") as f:
+        spec = _json.load(f)
+    cfg = TrainConfig()
+    for site in spec:
+        assert cfg.ica_args.hidden_size == site["hidden_size"]["value"]
+        assert cfg.ica_args.input_size == site["input_size"]["value"]
+        assert cfg.ica_args.window_size == site["window_size"]["value"]
+        assert cfg.ica_args.window_stride == site["window_stride"]["value"]
+        assert cfg.ica_args.temporal_size == site["temporal_size"]["value"]
+        assert cfg.ica_args.num_components == site["num_components"]["value"]
 
 
 def test_registry_enums():

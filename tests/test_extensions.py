@@ -1,0 +1,198 @@
+"""Tests for the TPU-build extension workloads (VERDICT round-1 #3/#6):
+MultimodalNet transformer, SMRI3DNet 3D-CNN, their datasets, and full
+federated runs for both tasks through FedRunner on synthetic site trees."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dinunet_implementations_tpu import TrainConfig
+from dinunet_implementations_tpu.models.cnn3d import SMRI3DNet
+from dinunet_implementations_tpu.models.transformer import MultimodalNet
+from dinunet_implementations_tpu.runner import FedRunner
+
+
+# ---------------------------------------------------------------------------
+# model-level: forward + grad
+# ---------------------------------------------------------------------------
+
+
+def _tiny_multimodal():
+    return MultimodalNet(
+        fs_input_size=6, num_comps=3, window_size=2, embed_dim=16, num_heads=2,
+        num_layers=2, mlp_ratio=2, num_cls=2,
+    )
+
+
+def test_multimodal_forward_and_grad():
+    model = _tiny_multimodal()
+    B, S = 4, 5
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(B, 6 + S * 3 * 2)).astype(np.float32)
+    )
+    key = jax.random.PRNGKey(0)
+    variables = model.init({"params": key, "dropout": key}, x, train=True)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (B, 2)
+    assert np.isfinite(np.asarray(out)).all()
+
+    def loss(params):
+        logits = model.apply(
+            {"params": params}, x, train=True, rngs={"dropout": key}
+        )
+        return jnp.mean(jax.nn.logsumexp(logits, -1) - logits[:, 0])
+
+    grads = jax.grad(loss)(variables["params"])
+    leaves = jax.tree.leaves(grads)
+    assert all(np.isfinite(np.asarray(g)).all() for g in leaves)
+    assert any(float(jnp.abs(g).max()) > 0 for g in leaves)
+
+
+def test_multimodal_token_count_static_under_jit():
+    """CLS + 1 FS token + S ICA tokens; jit must see static shapes."""
+    model = _tiny_multimodal()
+    x = jnp.ones((2, 6 + 4 * 3 * 2))
+    key = jax.random.PRNGKey(1)
+    variables = model.init({"params": key, "dropout": key}, x, train=True)
+    assert variables["params"]["pos_embed"].shape == (1, 1 + 1 + 4, 16)
+    fwd = jax.jit(lambda v, xx: model.apply(v, xx, train=False))
+    assert fwd(variables, x).shape == (2, 2)
+
+
+def test_smri3d_forward_and_grad():
+    model = SMRI3DNet(channels=(4, 8), num_cls=2)
+    x = jnp.asarray(
+        np.random.default_rng(1).normal(size=(3, 8, 8, 8)).astype(np.float32)
+    )
+    key = jax.random.PRNGKey(0)
+    variables = model.init({"params": key, "dropout": key}, x, train=True)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (3, 2)
+
+    def loss(params):
+        logits = model.apply({"params": params}, x, train=True,
+                             rngs={"dropout": key})
+        return jnp.mean(jnp.square(logits))
+
+    grads = jax.grad(loss)(variables["params"])
+    assert all(np.isfinite(np.asarray(g)).all() for g in jax.tree.leaves(grads))
+
+
+def test_smri3d_masked_batchnorm_ignores_padding():
+    """A padding row (weight 0) must not change the batch statistics."""
+    model = SMRI3DNet(channels=(4,), num_cls=2, dropout_rate=0.0)
+    rng = np.random.default_rng(2)
+    x3 = jnp.asarray(rng.normal(size=(3, 8, 8, 8)).astype(np.float32))
+    key = jax.random.PRNGKey(0)
+    variables = model.init({"params": key, "dropout": key}, x3, train=True)
+    base = model.apply(variables, x3, train=True, mask=jnp.ones(3),
+                       rngs={"dropout": key})
+    x4 = jnp.concatenate([x3, 100.0 * jnp.ones((1, 8, 8, 8))], 0)
+    padded = model.apply(variables, x4, train=True,
+                         mask=jnp.asarray([1.0, 1.0, 1.0, 0.0]),
+                         rngs={"dropout": key})
+    np.testing.assert_allclose(np.asarray(padded[:3]), np.asarray(base), atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# task-level: federated e2e on synthetic site trees
+# ---------------------------------------------------------------------------
+
+
+def _make_smri_tree(root, n_sites=2, subjects=16, shape=(8, 8, 8), seed=11):
+    rng = np.random.default_rng(seed)
+    spec = []
+    for i in range(n_sites):
+        d = root / "input" / f"local{i}" / "simulatorRun"
+        d.mkdir(parents=True)
+        y = rng.integers(0, 2, subjects)
+        X = rng.normal(size=(subjects,) + shape).astype(np.float32)
+        X += (y[:, None, None, None] * 1.5).astype(np.float32)
+        np.savez(d / "volumes.npz", X)
+        with open(d / "labels.csv", "w") as fh:
+            fh.write("index,label\n")
+            for j in range(subjects):
+                fh.write(f"{j},{int(y[j])}\n")
+        spec.append({
+            "data_file": {"value": "volumes.npz"},
+            "labels_file": {"value": "labels.csv"},
+            "channels": {"value": [4, 8]},
+        })
+    (root / "inputspec.json").write_text(json.dumps(spec))
+
+
+def test_smri_fed_runner_end_to_end(tmp_path):
+    _make_smri_tree(tmp_path)
+    cfg = TrainConfig(
+        task_id="sMRI-3D-Classification", epochs=3, batch_size=8,
+        split_ratio=(0.6, 0.2, 0.2),
+    )
+    r = FedRunner(cfg, data_path=str(tmp_path), out_dir=str(tmp_path / "output"))
+    assert r.cfg.smri3d_args.channels == (4, 8)
+    res = r.run(verbose=False)[0]
+    assert np.isfinite(res["epoch_losses"]).all()
+    assert 0 <= res["test_metrics"][0][1] <= 1
+    log = json.load(open(
+        tmp_path / "output/remote/simulatorRun/sMRI-3D-Classification/fold_0/logs.json"
+    ))
+    assert log["agg_engine"] == "dSGD"
+
+
+def _write_aseg(path, vals):
+    with open(path, "w") as fh:
+        fh.write("name\tvalue\n")
+        for i, v in enumerate(vals):
+            fh.write(f"region{i}\t{v}\n")
+
+
+def _make_multimodal_tree(root, n_sites=2, subjects=14, fs_dim=6, comps=3,
+                          temporal=8, window=2, seed=13):
+    rng = np.random.default_rng(seed)
+    spec = []
+    for i in range(n_sites):
+        d = root / "input" / f"local{i}" / "simulatorRun"
+        d.mkdir(parents=True)
+        y = rng.integers(0, 2, subjects)
+        tc = rng.normal(size=(subjects, comps, temporal)).astype(np.float32)
+        tc += (y[:, None, None] * 1.5).astype(np.float32)
+        np.savez(d / "timecourses.npz", tc)
+        with open(d / "cov.csv", "w") as fh:
+            fh.write("freesurferfile,isControl\n")
+            for j in range(subjects):
+                f = f"sub{j}.txt"
+                _write_aseg(d / f, np.abs(rng.normal(size=fs_dim)) + 0.1 + y[j])
+                fh.write(f"{f},{str(bool(y[j])).lower()}\n")
+        spec.append({
+            "data_file": {"value": "timecourses.npz"},
+            "labels_file": {"value": "cov.csv"},
+            "fs_input_size": {"value": fs_dim},
+            "num_components": {"value": comps},
+            "temporal_size": {"value": temporal},
+            "window_size": {"value": window},
+            "window_stride": {"value": window},
+            "embed_dim": {"value": 16},
+            "num_heads": {"value": 2},
+            "num_layers": {"value": 2},
+            "mlp_ratio": {"value": 2},
+        })
+    (root / "inputspec.json").write_text(json.dumps(spec))
+
+
+def test_multimodal_fed_runner_end_to_end(tmp_path):
+    _make_multimodal_tree(tmp_path)
+    cfg = TrainConfig(
+        task_id="Multimodal-Classification", epochs=3, batch_size=8,
+        split_ratio=(0.6, 0.2, 0.2),
+    )
+    r = FedRunner(cfg, data_path=str(tmp_path), out_dir=str(tmp_path / "output"))
+    assert r.cfg.multimodal_args.embed_dim == 16
+    res = r.run(verbose=False)[0]
+    assert np.isfinite(res["epoch_losses"]).all()
+    assert 0 <= res["test_metrics"][0][1] <= 1
+    # packed vector layout: fs_dim + S*C*W with S = temporal//window
+    log = json.load(open(
+        tmp_path / "output/local0/simulatorRun/Multimodal-Classification/fold_0/logs.json"
+    ))
+    assert log["agg_engine"] == "dSGD"

@@ -185,3 +185,24 @@ def test_icalstm_default_shapes_jit():
     fwd = jax.jit(lambda v, xx: jm.apply(v, xx, train=False))
     out = fwd(variables, x)
     assert out.shape == (4, 2)
+
+
+def test_torch_linear_init_parity():
+    """ADVICE regression: TorchLinearInit.kernel must match torch's
+    kaiming_uniform_(a=sqrt(5)) bound of 1/sqrt(fan_in) — not sqrt(3/fan_in)."""
+    from dinunet_implementations_tpu.models.layers import TorchLinearInit
+
+    fan_in = 64
+    k = TorchLinearInit.kernel(jax.random.PRNGKey(0), (fan_in, 4096))
+    bound = 1.0 / np.sqrt(fan_in)
+    kmax = float(np.abs(np.asarray(k)).max())
+    assert kmax <= bound + 1e-7
+    assert kmax > 0.98 * bound  # uniform should nearly reach the bound
+    # cross-check against torch's actual nn.Linear init
+    torch.manual_seed(0)
+    tl = torch.nn.Linear(fan_in, 4096)
+    tmax = float(tl.weight.detach().abs().max())
+    assert abs(kmax - tmax) < 0.05 * bound
+    # bias bound is also 1/sqrt(fan_in)
+    b = TorchLinearInit.bias_for(fan_in)(jax.random.PRNGKey(1), (4096,))
+    assert float(np.abs(np.asarray(b)).max()) <= bound + 1e-7

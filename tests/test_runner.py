@@ -89,6 +89,83 @@ def test_site_runner_parity_signature(tmp_path):
     assert 0 <= results[0]["test_metrics"][0][1] <= 1
 
 
+# ---------------------------------------------------------------------------
+# ICA federated end-to-end (the flagship/bench workload) on a synthetic
+# multi-site tree mirroring the reference fixture layout
+# (datasets/icalstm/inputspec.json; data itself is git-ignored upstream)
+# ---------------------------------------------------------------------------
+
+
+def _make_ica_tree(root, n_sites=3, subjects=24, comps=4, temporal=20,
+                   window=5, stride=5, seed=7):
+    """Reference simulator layout: <root>/inputspec.json +
+    <root>/input/local{i}/simulatorRun/{timecourses.npz, labels.csv}."""
+    rng = np.random.default_rng(seed)
+    spec = []
+    for i in range(n_sites):
+        d = root / "input" / f"local{i}" / "simulatorRun"
+        d.mkdir(parents=True)
+        y = rng.integers(0, 2, subjects)
+        X = rng.normal(size=(subjects, comps, temporal)).astype(np.float32)
+        X += (y[:, None, None] * 2.0).astype(np.float32)  # learnable shift
+        np.savez(d / "timecourses.npz", X)
+        with open(d / "labels.csv", "w") as fh:
+            fh.write("index,label\n")
+            for j in range(subjects):
+                fh.write(f"{j},{int(y[j])}\n")
+        spec.append({
+            "data_file": {"value": "timecourses.npz"},
+            "labels_file": {"value": "labels.csv"},
+            "temporal_size": {"value": temporal},
+            "window_size": {"value": window},
+            "window_stride": {"value": stride},
+            "num_components": {"value": comps},
+            "input_size": {"value": 16},
+            "hidden_size": {"value": 12},
+            "num_class": {"value": 2},
+        })
+    (root / "inputspec.json").write_text(json.dumps(spec))
+
+
+def test_ica_fed_runner_end_to_end(tmp_path):
+    """VERDICT #4: the flagship (bench) workload federated across 3 sites —
+    trains, learns the signal, writes reference-schema outputs."""
+    _make_ica_tree(tmp_path)
+    cfg = TrainConfig(
+        task_id="ICA-Classification", epochs=8, batch_size=8, patience=10,
+        split_ratio=(0.7, 0.15, 0.15),
+    )
+    r = FedRunner(cfg, data_path=str(tmp_path), out_dir=str(tmp_path / "output"))
+    assert len(r.site_dirs) == 3
+    # per-site inputspec overrides resolved into ica_args
+    assert r.cfg.ica_args.data_file == "timecourses.npz"
+    assert r.cfg.ica_args.hidden_size == 12
+    res = r.run(verbose=False)[0]
+    loss, auc = res["test_metrics"][0]
+    assert 0 < loss < 2
+    assert auc > 0.65, f"ICA federation failed to learn (auc={auc})"
+    log = json.load(
+        open(tmp_path / "output/local1/simulatorRun/ICA-Classification/fold_0/logs.json")
+    )
+    assert log["agg_engine"] == "dSGD"
+    assert len(log["local_iter_duration"]) >= 1
+
+
+def test_ica_site_runner_reference_signature(tmp_path):
+    """Reference call shape (comps/icalstm/site_run.py:6-9): SiteRunner with
+    seed, site_index, monitor_metric='auc', batch_size — single-site ICA."""
+    _make_ica_tree(tmp_path, n_sites=2)
+    runner = SiteRunner(
+        taks_id="ICA", data_path=str(tmp_path), mode="train", seed=3,
+        site_index=1, split_ratio=[0.6, 0.2, 0.2], monitor_metric="auc",
+        log_header="Loss|AUC", batch_size=8,
+    )
+    runner.cfg = runner.cfg.replace(epochs=2)
+    results = runner.run(None, None, None, verbose=False)
+    assert len(results) == 1
+    assert 0 <= results[0]["test_metrics"][0][1] <= 1
+
+
 def test_fed_runner_kfold(tmp_path):
     cfg = TrainConfig(epochs=2, num_folds=3)
     r = FedRunner(cfg, data_path=FSL, out_dir=str(tmp_path))

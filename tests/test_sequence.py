@@ -1,0 +1,128 @@
+"""Sequence/context parallelism tests (parallel/sequence.py).
+
+VERDICT round-1 #3: ring_attention's online-softmax accumulation and
+ring_lstm's wavefront carry relay are exactly the kind of code that is wrong
+in subtle ways — these tests pin both against their dense single-device
+equivalents on a real ``model``-axis host mesh.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from dinunet_implementations_tpu.models.icalstm import LSTMCell
+from dinunet_implementations_tpu.models.transformer import dot_product_attention
+from dinunet_implementations_tpu.parallel.mesh import MODEL_AXIS, host_mesh
+from dinunet_implementations_tpu.parallel.sequence import (
+    gather_sequence,
+    ring_attention,
+    ring_lstm,
+    shard_sequence,
+)
+
+
+def _model_mesh(n):
+    return host_mesh(1, model_axis_size=n)
+
+
+def test_ring_attention_matches_dense():
+    """Exact softmax attention over the global sequence, T sharded 4 ways."""
+    rng = np.random.default_rng(0)
+    B, T, N, Hd = 2, 16, 2, 4
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(B, T, N, Hd)).astype(np.float32)) for _ in range(3)
+    )
+    dense_out = dot_product_attention(q, k, v)
+
+    mesh = _model_mesh(4)
+    ring = shard_map(
+        functools.partial(ring_attention, axis_name=MODEL_AXIS),
+        mesh=mesh,
+        in_specs=(P(None, MODEL_AXIS), P(None, MODEL_AXIS), P(None, MODEL_AXIS)),
+        out_specs=P(None, MODEL_AXIS),
+        check_vma=False,
+    )
+    out = ring(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense_out), atol=2e-5)
+
+
+def test_ring_attention_extreme_logits_stable():
+    """Online-softmax must stay finite/correct with large-magnitude scores."""
+    rng = np.random.default_rng(1)
+    B, T, N, Hd = 1, 8, 1, 4
+    q = jnp.asarray(rng.normal(size=(B, T, N, Hd)).astype(np.float32)) * 30.0
+    k = jnp.asarray(rng.normal(size=(B, T, N, Hd)).astype(np.float32)) * 30.0
+    v = jnp.asarray(rng.normal(size=(B, T, N, Hd)).astype(np.float32))
+    dense_out = dot_product_attention(q, k, v)
+    mesh = _model_mesh(2)
+    out = shard_map(
+        functools.partial(ring_attention, axis_name=MODEL_AXIS),
+        mesh=mesh,
+        in_specs=(P(None, MODEL_AXIS),) * 3,
+        out_specs=P(None, MODEL_AXIS),
+        check_vma=False,
+    )(q, k, v)
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense_out), atol=1e-4)
+
+
+def test_ring_attention_no_axis_falls_back_to_dense():
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.normal(size=(1, 4, 1, 4)).astype(np.float32))
+    out = ring_attention(q, q, q, axis_name=None)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(dot_product_attention(q, q, q)), atol=1e-6
+    )
+
+
+def test_ring_lstm_matches_scan_cell():
+    """The wavefront carry relay must reproduce the dense scan LSTM exactly:
+    per-chunk hidden sequences AND the terminal carry on every device."""
+    rng = np.random.default_rng(3)
+    B, T, D, H = 2, 12, 5, 7
+    model = LSTMCell(hidden_size=H, use_pallas=False)
+    x = jnp.asarray(rng.normal(size=(B, T, D)).astype(np.float32))
+    params = model.init(jax.random.PRNGKey(0), x)
+    dense_hs, (dense_h, dense_c) = model.apply(params, x)
+
+    n = 4
+    mesh = _model_mesh(n)
+    h0 = jnp.zeros((B, H), jnp.float32)
+    c0 = jnp.zeros((B, H), jnp.float32)
+
+    def cell_fn(x_chunk, carry):
+        return model.apply(params, x_chunk, carry)
+
+    def shard_fn(x_local, h0, c0):
+        hs, (hT, cT) = ring_lstm(cell_fn, x_local, h0, c0, axis_name=MODEL_AXIS)
+        return hs, hT, cT
+
+    hs, hT, cT = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(None, MODEL_AXIS), P(), P()),
+        out_specs=(P(None, MODEL_AXIS), P(), P()),
+        check_vma=False,
+    )(x, h0, c0)
+    np.testing.assert_allclose(np.asarray(hs), np.asarray(dense_hs), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(hT), np.asarray(dense_h), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(cT), np.asarray(dense_c), atol=1e-5)
+
+
+def test_shard_gather_roundtrip():
+    x = jnp.arange(2 * 8 * 3, dtype=jnp.float32).reshape(2, 8, 3)
+    mesh = _model_mesh(4)
+
+    def fn(x_full):
+        local = shard_sequence(x_full, MODEL_AXIS)
+        assert local.shape == (2, 2, 3)
+        return gather_sequence(local, MODEL_AXIS)
+
+    out = shard_map(
+        fn, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False
+    )(x)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))

@@ -63,6 +63,47 @@ def test_metrics_weights_mask_padding():
     assert len(s) == 1
 
 
+def test_multiclass_metrics_known_values():
+    from dinunet_implementations_tpu.trainer.metrics import MulticlassMetrics
+
+    m = MulticlassMetrics()
+    # 4 samples, 3 classes; argmax preds = [0, 1, 2, 0]; labels = [0, 1, 2, 2]
+    m.add(
+        [[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.5, 0.3, 0.2]],
+        [0, 1, 2, 2],
+    )
+    assert m.accuracy() == pytest.approx(0.75)
+    # per-class (P, R): c0 (1/2, 1), c1 (1, 1), c2 (1, 1/2) → macro P = R = 5/6
+    assert m.precision() == pytest.approx(5 / 6)
+    assert m.recall() == pytest.approx(5 / 6)
+    assert 0.0 <= m.auc() <= 1.0
+    # weights mask padding rows
+    m2 = MulticlassMetrics()
+    m2.add([[0.9, 0.1, 0.0]] * 3, [0, 0, 0], weights=[1, 0, 0])
+    p, y = m2._cat()
+    assert len(y) == 1
+
+
+def test_evaluate_multiclass_path():
+    """num_class > 2 must route through argmax-based metrics, not prob[:,1]."""
+    cfg = TrainConfig(epochs=1, batch_size=8, num_class=3, monitor_metric="accuracy")
+    model = MSANNet(in_size=6, hidden_sizes=(8,), out_size=3)
+    tr = FederatedTrainer(cfg, model, host_mesh(2))
+    sites = []
+    rng = np.random.default_rng(5)
+    for _ in range(2):
+        X = rng.normal(size=(24, 6)).astype(np.float32)
+        y = rng.integers(0, 3, size=24).astype(np.int32)
+        sites.append(SiteArrays(X, y, np.arange(24, dtype=np.int32)))
+    tr._num_sites = 2
+    state = tr.init_state(jnp.ones((8, 6)), num_sites=2)
+    avg, m = tr.evaluate(state, sites)
+    from dinunet_implementations_tpu.trainer.metrics import MulticlassMetrics
+
+    assert isinstance(m, MulticlassMetrics)
+    assert 0.0 <= m.value("accuracy") <= 1.0
+
+
 def test_is_improvement():
     assert is_improvement(0.8, None)
     assert is_improvement(0.8, 0.7, "maximize")
@@ -234,6 +275,33 @@ def test_trainer_early_stop_on_patience():
     assert res["stopped_epoch"] <= 6
 
 
+def test_final_validation_when_epochs_below_cadence():
+    """ADVICE regression: epochs < validation_epochs must still validate once,
+    so the trained (not init) state is selected and best_val_metric is set."""
+    cfg = TrainConfig(epochs=2, validation_epochs=5, batch_size=8)
+    model = MSANNet(in_size=6, hidden_sizes=(8,), out_size=2)
+    tr = FederatedTrainer(cfg, model, host_mesh(2))
+    res = tr.fit(_toy_sites(2), _toy_sites(2, n=16), _toy_sites(2, n=16), verbose=False)
+    assert res["best_val_metric"] is not None
+    assert res["best_val_epoch"] == 2
+
+
+def test_pretrain_uses_exact_gradients_with_compressed_engine():
+    """ADVICE regression: warm start must run on dSGD even when the federated
+    phase uses a compressed engine (and must not crash on engine-state shapes)."""
+    from dinunet_implementations_tpu.core.config import PretrainArgs
+
+    cfg = TrainConfig(
+        epochs=2, batch_size=8, agg_engine="powerSGD", pretrain=True,
+        pretrain_args=PretrainArgs(epochs=2, learning_rate=1e-3, batch_size=8),
+    )
+    model = MSANNet(in_size=6, hidden_sizes=(8,), out_size=2)
+    tr = FederatedTrainer(cfg, model, host_mesh(2))
+    res = tr.fit(_toy_sites(2, n=40), _toy_sites(2, n=16), _toy_sites(2, n=16),
+                 verbose=False)
+    assert np.isfinite(res["epoch_losses"]).all()
+
+
 def test_powersgd_residual_survives_epoch_boundary():
     """Review finding regression: powerSGD's per-site error-feedback residual
     must NOT be collapsed to site 0's copy between epoch_fn calls."""
@@ -257,3 +325,15 @@ def test_powersgd_residual_survives_epoch_boundary():
         s2, _ = fn(s1, X, y, w)
         e2 = np.asarray(s2.engine_state["e"]["linear_0"]["kernel"])
         assert not np.allclose(e2[0], e2[1])
+
+
+def test_multiclass_auc_skips_absent_classes():
+    """Review regression: a class missing from the eval set must not drag the
+    macro AUC toward 0 — a perfect 3-class model with class 2 absent is ~1.0."""
+    from dinunet_implementations_tpu.trainer.metrics import MulticlassMetrics
+
+    m = MulticlassMetrics()
+    m.add([[0.9, 0.05, 0.05], [0.1, 0.85, 0.05], [0.8, 0.1, 0.1],
+           [0.05, 0.9, 0.05]], [0, 1, 0, 1])
+    assert m.auc() == pytest.approx(1.0)
+    assert m.accuracy() == pytest.approx(1.0)
