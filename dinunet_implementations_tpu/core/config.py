@@ -201,6 +201,10 @@ class TrainConfig:
     monitor_metric: str = "auc"
     metric_direction: str = "maximize"
     log_header: str = "loss|auc"
+    # warm start from a saved checkpoint's params (the reference library's
+    # load-pretrained capability implied by best_val_epoch/pretrain semantics,
+    # SURVEY.md §5 checkpoint/resume); "" = train from init
+    pretrained_path: str = ""
     dataloader_args: dict = field(default_factory=lambda: {"train": {"drop_last": True}})
     seed: int = 0
     optimizer: str = "adam"  # coinstac-dinunet trains with Adam at `learning_rate`

@@ -111,18 +111,24 @@ class FedRunner:
                 mesh = None  # fold all sites onto the local device via vmap
         self.mesh = mesh
 
-    def run(self, folds=None, verbose: bool = True) -> list[dict]:
+    def run(self, folds=None, verbose: bool = True, resume: bool = False) -> list[dict]:
+        """``resume=True`` continues each fold from its last
+        validation-boundary checkpoint; ``cfg.mode == "test"`` skips training
+        and evaluates each fold's best checkpoint."""
         all_folds = load_site_splits(self.cfg, self.site_dirs, self.site_cfgs)
+        fold_ids = list(range(len(all_folds)))
         if folds is not None:
             all_folds = [all_folds[k] for k in folds]
+            fold_ids = list(folds)
         results = []
-        for k, fold in enumerate(all_folds):
+        for k, fold in zip(fold_ids, all_folds):
             trainer = FederatedTrainer(
                 self.cfg, get_task(self.cfg.task_id).build_model(self.cfg),
                 self.mesh, out_dir=self.out_dir,
             )
             res = trainer.fit(
-                fold["train"], fold["validation"], fold["test"], fold=k, verbose=verbose
+                fold["train"], fold["validation"], fold["test"], fold=k,
+                verbose=verbose, resume=resume,
             )
             results.append(res)
         return results

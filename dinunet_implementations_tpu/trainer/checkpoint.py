@@ -21,6 +21,18 @@ import jax.numpy as jnp
 from .steps import TrainState
 
 
+def _atomic_write(path: str, data):
+    """Write via temp file + os.replace so a kill mid-write never leaves a
+    truncated file at ``path`` (resume exists to survive kills)."""
+    mode = "wb" if isinstance(data, bytes) else "w"
+    tmp = path + ".tmp"
+    with open(tmp, mode) as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
 def save_checkpoint(path: str, state: TrainState, meta: dict | None = None) -> str:
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     payload = {
@@ -30,17 +42,20 @@ def save_checkpoint(path: str, state: TrainState, meta: dict | None = None) -> s
         "engine_state": state.engine_state,
         "rng": state.rng,
         "round": state.round,
+        # meta rides INSIDE the msgpack so state+meta are one atomic unit (a
+        # kill between two separate files would pair epoch-N state with
+        # epoch-(N-1) bookkeeping and resume from the wrong epoch)
+        "meta_json": json.dumps(meta or {}),
     }
-    with open(path, "wb") as fh:
-        fh.write(flax.serialization.to_bytes(payload))
-    if meta is not None:
-        with open(path + ".meta.json", "w") as fh:
-            json.dump(meta, fh, indent=2)
+    _atomic_write(path, flax.serialization.to_bytes(payload))
+    if meta is not None:  # human-readable sidecar (non-authoritative)
+        _atomic_write(path + ".meta.json", json.dumps(meta, indent=2, default=float))
     return path
 
 
-def load_checkpoint(path: str, like: TrainState) -> TrainState:
-    """Restore into the structure of ``like`` (shapes/treedef must match)."""
+def load_checkpoint(path: str, like: TrainState, with_meta: bool = False):
+    """Restore into the structure of ``like`` (shapes/treedef must match).
+    ``with_meta=True`` also returns the embedded (atomically-paired) meta."""
     template = {
         "params": like.params,
         "batch_stats": like.batch_stats,
@@ -48,10 +63,11 @@ def load_checkpoint(path: str, like: TrainState) -> TrainState:
         "engine_state": like.engine_state,
         "rng": like.rng,
         "round": like.round,
+        "meta_json": "",
     }
     with open(path, "rb") as fh:
         restored = flax.serialization.from_bytes(template, fh.read())
-    return TrainState(
+    state = TrainState(
         params=restored["params"],
         batch_stats=restored["batch_stats"],
         opt_state=restored["opt_state"],
@@ -59,6 +75,12 @@ def load_checkpoint(path: str, like: TrainState) -> TrainState:
         rng=jnp.asarray(restored["rng"]),
         round=jnp.asarray(restored["round"]),
     )
+    if with_meta:
+        meta = restored.get("meta_json")
+        if isinstance(meta, bytes):
+            meta = meta.decode()
+        return state, json.loads(meta or "{}")
+    return state
 
 
 def load_params(path: str, like_params: Any):
@@ -66,6 +88,20 @@ def load_params(path: str, like_params: Any):
     with open(path, "rb") as fh:
         raw = flax.serialization.msgpack_restore(fh.read())
     return flax.serialization.from_state_dict(like_params, raw["params"])
+
+
+def load_eval_state(path: str, like_params: Any, like_stats: Any):
+    """Inference-only restore: (params, batch_stats, meta) — no dependency on
+    optimizer/engine-state shapes, so a ``mode="test"`` run works even when
+    its site count differs from the training run's."""
+    with open(path, "rb") as fh:
+        raw = flax.serialization.msgpack_restore(fh.read())
+    params = flax.serialization.from_state_dict(like_params, raw["params"])
+    stats = flax.serialization.from_state_dict(like_stats, raw.get("batch_stats", {}))
+    meta = raw.get("meta_json") or "{}"
+    if isinstance(meta, bytes):
+        meta = meta.decode()
+    return params, stats, json.loads(meta)
 
 
 def checkpoint_meta(path: str) -> dict:

@@ -30,7 +30,12 @@ from ..core.config import TrainConfig
 from ..data.api import SiteArrays
 from ..data.batching import plan_epoch, plan_eval
 from ..engines import make_engine
-from .checkpoint import save_checkpoint
+from .checkpoint import (
+    load_checkpoint,
+    load_eval_state,
+    load_params,
+    save_checkpoint,
+)
 from .logs import (
     duration,
     fold_dir,
@@ -93,8 +98,27 @@ class FederatedTrainer:
         )
         return state, np.asarray(losses)
 
-    def evaluate(self, state, sites, batch_size=None):
-        """Pooled (remote-side) metrics across all sites."""
+    @staticmethod
+    def _new_metrics(num_class: int):
+        """Binary: score = positive-class probability (reference semantics,
+        AUC on prob[:,1], comps/icalstm/__init__.py:64-65); multiclass:
+        argmax-based macro metrics."""
+        return ClassificationMetrics() if num_class == 2 else MulticlassMetrics()
+
+    @staticmethod
+    def _add_probs(m, probs, labels, weights):
+        if isinstance(m, ClassificationMetrics):
+            m.add(probs[..., 1].reshape(-1), labels.reshape(-1), weights.reshape(-1))
+        else:
+            m.add(probs.reshape(-1, probs.shape[-1]), labels.reshape(-1),
+                  weights.reshape(-1))
+        return m
+
+    def evaluate(self, state, sites, batch_size=None, per_site: bool = False):
+        """Pooled (remote-side) metrics across all sites; with
+        ``per_site=True`` also returns each site's own (Averages, metrics) —
+        the eval step already computes per-site probs/loss sums, so per-site
+        logs (reference ``local{i}/logs.json``) come for free."""
         fb = plan_eval(sites, batch_size or self.cfg.batch_size)
         probs, loss_sum, wsum = self.eval_fn(
             state,
@@ -103,18 +127,25 @@ class FederatedTrainer:
             jnp.asarray(fb.weights),
         )
         probs = np.asarray(probs)  # [S, steps, B, C]
-        loss = float(np.asarray(loss_sum).sum() / max(np.asarray(wsum).sum(), 1.0))
-        if probs.shape[-1] == 2:
-            # binary: score = positive-class probability (reference semantics,
-            # AUC on prob[:,1], comps/icalstm/__init__.py:64-65)
-            m = ClassificationMetrics()
-            m.add(probs[..., 1].reshape(-1), fb.labels.reshape(-1), fb.weights.reshape(-1))
-        else:
-            m = MulticlassMetrics()
-            m.add(probs.reshape(-1, probs.shape[-1]), fb.labels.reshape(-1),
-                  fb.weights.reshape(-1))
-        avg = Averages().add(loss, np.asarray(wsum).sum())
-        return avg, m
+        loss_sum, wsum = np.asarray(loss_sum), np.asarray(wsum)
+        loss = float(loss_sum.sum() / max(wsum.sum(), 1.0))
+        m = self._add_probs(
+            self._new_metrics(probs.shape[-1]), probs, fb.labels, fb.weights
+        )
+        avg = Averages().add(loss, wsum.sum())
+        if not per_site:
+            return avg, m
+        site_results = []
+        for s in range(probs.shape[0]):
+            sm = self._add_probs(
+                self._new_metrics(probs.shape[-1]), probs[s], fb.labels[s],
+                fb.weights[s],
+            )
+            savg = Averages().add(
+                float(loss_sum[s] / max(wsum[s], 1.0)), wsum[s]
+            )
+            site_results.append((savg, sm))
+        return avg, m, site_results
 
     # -- the full fit ----------------------------------------------------
 
@@ -125,15 +156,37 @@ class FederatedTrainer:
         test_sites: list[SiteArrays],
         fold: int = 0,
         verbose: bool = True,
+        resume: bool = False,
     ) -> dict:
         cfg = self.cfg
+        if cfg.mode.lower() == "test":
+            # GUI mode=test (compspec.json mode field): inference only, no
+            # training — load the fold's best checkpoint and evaluate.
+            return self.test_only(test_sites, fold=fold)
         t_start = time.time()
         self._num_sites = len(train_sites)
         state = self.init_state(jnp.ones((cfg.batch_size,) + train_sites[0].inputs.shape[1:], jnp.float32))
 
-        # --- pretrain warm start on the largest site (compspec.json:120-127)
-        if cfg.pretrain and cfg.pretrain_args and cfg.pretrain_args.epochs > 0:
-            state = self._pretrain(state, train_sites, val_sites, verbose)
+        latest_path = best_path = None
+        if self.out_dir:
+            d = fold_dir(self.out_dir, "remote", cfg.task_id, fold)
+            latest_path = os.path.join(d, "checkpoint_latest.msgpack")
+            best_path = os.path.join(d, "checkpoint_best.msgpack")
+        resuming = bool(resume and latest_path and os.path.exists(latest_path))
+
+        # --- warm starts — skipped when resuming: load_checkpoint below
+        # replaces the state wholesale, so pretraining first would be pure
+        # wasted compute on every restart
+        if not resuming:
+            # params-only warm start from a saved checkpoint (fresh
+            # optimizer/engine state — pretrain-from-file semantics)
+            if cfg.pretrained_path:
+                state = state.replace(
+                    params=load_params(cfg.pretrained_path, state.params)
+                )
+            # pretrain on the largest site (compspec.json:120-127)
+            if cfg.pretrain and cfg.pretrain_args and cfg.pretrain_args.epochs > 0:
+                state = self._pretrain(state, train_sites, val_sites, verbose)
 
         best_metric = None
         best_epoch = 0
@@ -141,16 +194,48 @@ class FederatedTrainer:
         since_best = 0
         epoch_losses = []
         iter_durations = []
+        start_epoch = 1
+
+        # --- fold resume: restore trainer state + selection/duration
+        # bookkeeping from the last validation-boundary checkpoint (meta is
+        # embedded in the msgpack, atomically paired with the state)
+        if resuming:
+            state, meta = load_checkpoint(latest_path, state, with_meta=True)
+            start_epoch = int(meta.get("epoch", 0)) + 1
+            best_metric = meta.get("best_val_metric")
+            best_epoch = int(meta.get("best_val_epoch", 0))
+            since_best = int(meta.get("since_best", 0))
+            epoch_losses = list(meta.get("epoch_losses", []))
+            iter_durations = list(meta.get("iter_durations", []))
+            self._cache["time_spent_on_computation"] = list(
+                meta.get("time_spent_on_computation", [])
+            )
+            cum = list(meta.get("cumulative_total_duration", []))
+            self._cache["cumulative_total_duration"] = cum
+            # continue the cumulative wall-clock line from its stored total
+            if cum:
+                t_start = time.time() - cum[-1]
+            best_state = (
+                load_checkpoint(best_path, state)
+                if os.path.exists(best_path)
+                else state
+            )
 
         monitor = cfg.monitor_metric
         direction = cfg.metric_direction
 
         stop_epoch = cfg.epochs
-        for epoch in range(1, cfg.epochs + 1):
+        for epoch in range(start_epoch, cfg.epochs + 1):
             e_start = time.time()
             state, losses = self.run_epoch(state, train_sites, epoch)
             epoch_losses.append(float(losses.mean()))
-            iter_durations.append(time.time() - e_start)
+            # per-iteration durations (reference local_iter_duration is
+            # per-round, NB.ipynb cells 34-36). All rounds of an epoch run in
+            # ONE fused XLA dispatch here, so per-round host timing does not
+            # exist; the truthful equivalent is the epoch time amortized over
+            # its rounds.
+            rounds = max(len(losses), 1)
+            iter_durations.extend([(time.time() - e_start) / rounds] * rounds)
 
             if epoch % cfg.validation_epochs == 0:
                 val_avg, val_metrics = self.evaluate(state, val_sites)
@@ -160,6 +245,12 @@ class FederatedTrainer:
                 ):
                     best_metric, best_epoch, best_state = score, epoch, state
                     since_best = 0
+                    if best_path:  # save-on-best during training
+                        save_checkpoint(
+                            best_path, best_state,
+                            meta={"best_val_epoch": best_epoch,
+                                  "best_val_metric": best_metric, "fold": fold},
+                        )
                 else:
                     since_best += cfg.validation_epochs
                 if verbose:
@@ -169,6 +260,19 @@ class FederatedTrainer:
                         + (" *" if best_epoch == epoch else "")
                     )
                 stop = since_best >= cfg.patience
+                if latest_path:  # resume point at each validation boundary
+                    save_checkpoint(
+                        latest_path, state,
+                        meta={"epoch": epoch, "best_val_epoch": best_epoch,
+                              "best_val_metric": best_metric,
+                              "since_best": since_best, "fold": fold,
+                              "epoch_losses": epoch_losses,
+                              "iter_durations": iter_durations,
+                              "time_spent_on_computation": self._cache.get(
+                                  "time_spent_on_computation", []),
+                              "cumulative_total_duration": self._cache.get(
+                                  "cumulative_total_duration", [])},
+                    )
             else:
                 stop = False
             duration(self._cache, e_start, "time_spent_on_computation")
@@ -186,10 +290,50 @@ class FederatedTrainer:
             best_metric, best_epoch, best_state = score, stop_epoch, state
 
         # --- test with the best state (reference: best-epoch checkpoint)
-        test_avg, test_metrics = self.evaluate(best_state, test_sites)
+        results = self._test_results(best_state, test_sites, best_epoch,
+                                     best_metric, stop_epoch, epoch_losses)
+        if self.out_dir:
+            self._write_outputs(results, iter_durations, best_state, fold)
+        results["state"] = best_state
+        return results
+
+    def test_only(self, test_sites: list[SiteArrays], fold: int = 0) -> dict:
+        """``mode="test"``: load the fold's best checkpoint and evaluate —
+        reproduces the stored ``test_metrics`` without training."""
+        cfg = self.cfg
+        if not self.out_dir:
+            raise ValueError('mode="test" needs out_dir (to find the checkpoint)')
+        d = fold_dir(self.out_dir, "remote", cfg.task_id, fold)
+        ckpt = os.path.join(d, "checkpoint_best.msgpack")
+        if not os.path.exists(ckpt):
+            raise FileNotFoundError(
+                f'mode="test" but no trained checkpoint at {ckpt}'
+            )
+        self._num_sites = len(test_sites)
+        state = self.init_state(
+            jnp.ones((cfg.batch_size,) + test_sites[0].inputs.shape[1:], jnp.float32)
+        )
+        # eval needs only params + batch_stats; a full-state restore would tie
+        # mode="test" to the training run's site count via engine-state shapes
+        params, stats, meta = load_eval_state(ckpt, state.params, state.batch_stats)
+        state = state.replace(params=params, batch_stats=stats)
+        results = self._test_results(
+            state, test_sites,
+            int(meta.get("best_val_epoch", 0)), meta.get("best_val_metric"),
+            stop_epoch=0, epoch_losses=[],
+        )
+        results["state"] = state
+        return results
+
+    def _test_results(self, state, test_sites, best_epoch, best_metric,
+                      stop_epoch, epoch_losses) -> dict:
+        monitor = self.cfg.monitor_metric
+        test_avg, test_metrics, site_results = self.evaluate(
+            state, test_sites, per_site=True
+        )
         monitored = test_metrics.value(monitor) if monitor != "loss" else test_avg.avg
-        results = {
-            "agg_engine": cfg.agg_engine,
+        return {
+            "agg_engine": self.cfg.agg_engine,
             "best_val_epoch": best_epoch,
             "best_val_metric": best_metric,
             "stopped_epoch": stop_epoch,
@@ -198,13 +342,13 @@ class FederatedTrainer:
                 n: test_metrics.value(n)
                 for n in ("accuracy", "f1", "precision", "recall", "auc")
             },
+            "site_test_metrics": [
+                [[round(a.avg, 5),
+                  round(m.value(monitor) if monitor != "loss" else a.avg, 5)]]
+                for a, m in site_results
+            ],
             "epoch_losses": epoch_losses,
         }
-
-        if self.out_dir:
-            self._write_outputs(results, iter_durations, best_state, fold)
-        results["state"] = best_state
-        return results
 
     # -- internals -------------------------------------------------------
 
@@ -261,11 +405,20 @@ class FederatedTrainer:
         cfg = self.cfg
         comp = self._cache.get("time_spent_on_computation", [])
         cum = self._cache.get("cumulative_total_duration", [])
+        site_tm = results.get("site_test_metrics") or []
         for i in range(self._num_sites):
             d = fold_dir(self.out_dir, f"local{i}", cfg.task_id, fold)
+            # Each site's log carries ITS OWN test metrics (reference
+            # local.py:51-52 writes genuinely per-site logs). The duration
+            # lists are shared by design: all sites execute as one fused SPMD
+            # program, so wall-clock is common — the extra key records that.
             write_logs_json(
-                d, cfg.agg_engine, results["test_metrics"], results["best_val_epoch"],
+                d, cfg.agg_engine,
+                site_tm[i] if i < len(site_tm) else results["test_metrics"],
+                results["best_val_epoch"],
                 cum, comp, iter_durations, side="local",
+                extra={"site_index": i, "pooled_test_metrics": results["test_metrics"],
+                       "durations_shared_across_sites": True},
             )
         d = fold_dir(self.out_dir, "remote", cfg.task_id, fold)
         write_logs_json(
@@ -276,6 +429,7 @@ class FederatedTrainer:
         save_checkpoint(
             os.path.join(d, "checkpoint_best.msgpack"),
             best_state,
-            meta={"best_val_epoch": results["best_val_epoch"], "fold": fold},
+            meta={"best_val_epoch": results["best_val_epoch"],
+                  "best_val_metric": results["best_val_metric"], "fold": fold},
         )
         zip_global_results(self.out_dir)

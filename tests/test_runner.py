@@ -172,3 +172,24 @@ def test_fed_runner_kfold(tmp_path):
     results = r.run(folds=[0, 1], verbose=False)
     assert len(results) == 2
     assert os.path.isdir(tmp_path / "remote/simulatorRun/FS-Classification/fold_1")
+
+
+def test_fed_runner_mode_test_roundtrip(tmp_path):
+    """Train once, then a mode='test' run on the same output tree reproduces
+    the stored test metrics without training (compspec mode field)."""
+    cfg = TrainConfig(epochs=3, split_ratio=(0.7, 0.15, 0.15))
+    r = FedRunner(cfg, data_path=FSL, out_dir=str(tmp_path))
+    res_train = r.run(verbose=False)[0]
+
+    r2 = FedRunner(cfg.replace(mode="test"), data_path=FSL, out_dir=str(tmp_path))
+    res_test = r2.run(verbose=False)[0]
+    assert res_test["test_metrics"] == res_train["test_metrics"]
+
+
+def test_fed_runner_explicit_fold_ids_write_correct_dirs(tmp_path):
+    """run(folds=[1]) must write fold_1 (not remap to fold_0)."""
+    cfg = TrainConfig(epochs=1, num_folds=3)
+    r = FedRunner(cfg, data_path=FSL, out_dir=str(tmp_path))
+    r.run(folds=[1], verbose=False)
+    assert os.path.isdir(tmp_path / "remote/simulatorRun/FS-Classification/fold_1")
+    assert not os.path.isdir(tmp_path / "remote/simulatorRun/FS-Classification/fold_0")

@@ -337,3 +337,137 @@ def test_multiclass_auc_skips_absent_classes():
            [0.05, 0.9, 0.05]], [0, 1, 0, 1])
     assert m.auc() == pytest.approx(1.0)
     assert m.accuracy() == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint wiring: mode="test", resume, warm start (VERDICT #5)
+# ---------------------------------------------------------------------------
+
+
+def test_mode_test_reproduces_stored_metrics(tmp_path):
+    """mode='test' loads checkpoint_best and reproduces the training run's
+    stored test_metrics without training."""
+    cfg = TrainConfig(epochs=6, patience=10, batch_size=8)
+    model = MSANNet(in_size=6, hidden_sizes=(8,), out_size=2)
+    tr = FederatedTrainer(cfg, model, host_mesh(2), out_dir=str(tmp_path))
+    train, val, test = _toy_sites(2, seed=1), _toy_sites(2, n=16, seed=2), _toy_sites(2, n=16, seed=3)
+    res_train = tr.fit(train, val, test, verbose=False)
+
+    cfg_test = cfg.replace(mode="test")
+    tr2 = FederatedTrainer(cfg_test, model, host_mesh(2), out_dir=str(tmp_path))
+    res_test = tr2.fit(train, val, test, verbose=False)
+    assert res_test["test_metrics"] == res_train["test_metrics"]
+    assert res_test["best_val_epoch"] == res_train["best_val_epoch"]
+
+
+def test_mode_test_without_checkpoint_raises(tmp_path):
+    cfg = TrainConfig(mode="test", epochs=2, batch_size=8)
+    model = MSANNet(in_size=6, hidden_sizes=(8,), out_size=2)
+    tr = FederatedTrainer(cfg, model, host_mesh(2), out_dir=str(tmp_path))
+    with pytest.raises(FileNotFoundError, match="no trained checkpoint"):
+        tr.fit(_toy_sites(2), _toy_sites(2, n=16), _toy_sites(2, n=16), verbose=False)
+
+
+def test_resume_matches_uninterrupted(tmp_path):
+    """Kill a fit mid-fold, resume — same final metrics as an uninterrupted
+    run (VERDICT #5 done-criterion)."""
+    model = MSANNet(in_size=6, hidden_sizes=(8,), out_size=2)
+    train, val, test = _toy_sites(2, seed=4), _toy_sites(2, n=16, seed=5), _toy_sites(2, n=16, seed=6)
+
+    cfg_full = TrainConfig(epochs=8, patience=20, batch_size=8)
+    tr_full = FederatedTrainer(cfg_full, model, host_mesh(2), out_dir=str(tmp_path / "full"))
+    res_full = tr_full.fit(train, val, test, verbose=False)
+
+    # "killed" after 4 epochs: same seed/config, shorter run
+    cfg_half = cfg_full.replace(epochs=4)
+    tr_half = FederatedTrainer(cfg_half, model, host_mesh(2), out_dir=str(tmp_path / "resumed"))
+    tr_half.fit(train, val, test, verbose=False)
+    # resume to the full 8 epochs
+    tr_res = FederatedTrainer(cfg_full, model, host_mesh(2), out_dir=str(tmp_path / "resumed"))
+    res_res = tr_res.fit(train, val, test, verbose=False, resume=True)
+
+    assert res_res["test_metrics"] == res_full["test_metrics"]
+    assert res_res["best_val_epoch"] == res_full["best_val_epoch"]
+    assert len(res_res["epoch_losses"]) == len(res_full["epoch_losses"])
+    np.testing.assert_allclose(res_res["epoch_losses"], res_full["epoch_losses"],
+                               atol=1e-6)
+
+
+def test_pretrained_path_warm_start(tmp_path):
+    """cfg.pretrained_path loads params from a saved checkpoint (the
+    previously-dead load_params path)."""
+    model = MSANNet(in_size=6, hidden_sizes=(8,), out_size=2)
+    cfg = TrainConfig(epochs=3, batch_size=8)
+    tr = FederatedTrainer(cfg, model, host_mesh(2), out_dir=str(tmp_path))
+    res = tr.fit(_toy_sites(2, seed=7), _toy_sites(2, n=16, seed=8),
+                 _toy_sites(2, n=16, seed=9), verbose=False)
+    ckpt = str(tmp_path / "remote/simulatorRun/FS-Classification/fold_0/checkpoint_best.msgpack")
+
+    # lr=0 → params stay at the warm start; they must equal the checkpoint's
+    cfg2 = TrainConfig(epochs=1, batch_size=8, learning_rate=0.0,
+                       pretrained_path=ckpt)
+    tr2 = FederatedTrainer(cfg2, model, host_mesh(2))
+    res2 = tr2.fit(_toy_sites(2, seed=7), _toy_sites(2, n=16, seed=8),
+                   _toy_sites(2, n=16, seed=9), verbose=False)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7),
+        res2["state"].params,
+        res["state"].params,
+    )
+
+
+def test_per_site_logs_are_per_site(tmp_path):
+    """VERDICT #8: each local{i}/logs.json carries that site's own test
+    metrics, not a clone of the pooled numbers."""
+    import json as _json
+
+    cfg = TrainConfig(epochs=3, batch_size=8)
+    model = MSANNet(in_size=6, hidden_sizes=(8,), out_size=2)
+    tr = FederatedTrainer(cfg, model, host_mesh(2), out_dir=str(tmp_path))
+    # deliberately different test data per site
+    test = [_toy_sites(1, n=16, seed=20)[0], _toy_sites(1, n=16, seed=21)[0]]
+    res = tr.fit(_toy_sites(2, seed=19), _toy_sites(2, n=16, seed=22), test,
+                 verbose=False)
+    logs = [
+        _json.load(open(tmp_path / f"local{i}/simulatorRun/FS-Classification/fold_0/logs.json"))
+        for i in range(2)
+    ]
+    assert logs[0]["site_index"] == 0 and logs[1]["site_index"] == 1
+    assert logs[0]["test_metrics"] != logs[1]["test_metrics"]
+    assert logs[0]["pooled_test_metrics"] == res["test_metrics"]
+    # per-iteration durations: one entry per round, not per epoch
+    steps_per_epoch = 40 // 8  # train n=40 per site, batch 8, drop_last
+    assert len(logs[0]["local_iter_duration"]) == 3 * steps_per_epoch
+
+
+def test_mode_test_reports_best_val_metric_and_site_count_independence(tmp_path):
+    """Review regressions: mode='test' must report the stored best_val_metric
+    (meta rides inside the msgpack), and must work with a different test-site
+    count than training (eval-only restore has no engine-state shape tie)."""
+    cfg = TrainConfig(epochs=4, batch_size=8)
+    model = MSANNet(in_size=6, hidden_sizes=(8,), out_size=2)
+    tr = FederatedTrainer(cfg, model, host_mesh(2), out_dir=str(tmp_path))
+    res = tr.fit(_toy_sites(2, seed=30), _toy_sites(2, n=16, seed=31),
+                 _toy_sites(2, n=16, seed=32), verbose=False)
+    assert res["best_val_metric"] is not None
+
+    # 3 test sites (training had 2) — eval-only restore must not care
+    cfg_t = cfg.replace(mode="test")
+    tr2 = FederatedTrainer(cfg_t, model, host_mesh(3), out_dir=str(tmp_path))
+    res_t = tr2.fit(_toy_sites(3, seed=30), _toy_sites(3, n=16, seed=31),
+                    _toy_sites(3, n=16, seed=33), verbose=False)
+    assert res_t["best_val_metric"] == pytest.approx(res["best_val_metric"])
+    assert res_t["best_val_epoch"] == res["best_val_epoch"]
+
+
+def test_checkpoint_write_is_atomic_no_tmp_left(tmp_path):
+    from dinunet_implementations_tpu.trainer.checkpoint import (
+        load_checkpoint as _lc, save_checkpoint as _sc,
+    )
+    mesh = host_mesh(2)
+    _, _, _, state, fn = _setup(mesh)
+    p = _sc(str(tmp_path / "ck.msgpack"), state, meta={"epoch": 3})
+    import os as _os
+    assert not _os.path.exists(p + ".tmp")
+    restored, meta = _lc(p, state, with_meta=True)
+    assert meta["epoch"] == 3
