@@ -72,6 +72,11 @@ class FSArgs:
     dad_num_pow_iters: int = 5
     dad_tol: float = 1e-3
     split_files: tuple = ()
+    # reproduce the reference's string-label bug bit-for-bit: EVERY string
+    # maps via (s.lower() == 'true'), so "1" → 0 (comps/fs/__init__.py:25-26);
+    # default False parses numeric strings numerically (documented deviation,
+    # data/freesurfer.py coerce_label)
+    bug_compatible_labels: bool = False
 
 
 @dataclass

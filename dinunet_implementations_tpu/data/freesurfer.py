@@ -40,10 +40,20 @@ def _read_covariates(path: str, data_column: str | None):
     return index, {r[key]: r for r in rows}
 
 
-def coerce_label(y) -> int:
-    """Reference label coercion (``comps/fs/__init__.py:25-31``)."""
+def coerce_label(y, bug_compatible: bool = False) -> int:
+    """Reference label coercion (``comps/fs/__init__.py:25-31``).
+
+    DOCUMENTED DEVIATION: the reference maps *every* string through
+    ``int(y.strip().lower() == 'true')`` — so the string ``"1"`` becomes 0
+    there. Here numeric strings parse numerically (``"1"`` → 1), which is
+    strictly safer for CSVs exported with 0/1 labels; only the literal
+    true/false strings use the boolean rule. Pass ``bug_compatible=True``
+    (FSArgs.bug_compatible_labels) to reproduce the reference bit-for-bit.
+    """
     if isinstance(y, str):
         low = y.strip().lower()
+        if bug_compatible:
+            return int(low == "true")
         if low in ("true", "false"):
             return int(low == "true")
         return int(float(y))
@@ -80,7 +90,9 @@ class FreeSurferDataset(SiteDataset):
     def load_index(self, file):
         self._ensure_labels()
         y = self.labels[file][self.cache["labels_column"]]
-        self.indices.append([file, coerce_label(y)])
+        self.indices.append(
+            [file, coerce_label(y, self.cache.get("bug_compatible_labels", False))]
+        )
 
     def __getitem__(self, ix) -> dict:
         file, y = self.indices[ix]

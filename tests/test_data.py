@@ -191,3 +191,20 @@ def test_split_ratio_two_way_no_test_leak():
     s = split_by_ratio(73, [0.8, 0.2], seed=0)
     assert len(s["test"]) == 0
     assert len(s["train"]) + len(s["validation"]) == 73
+
+
+def test_label_coercion_deviation_and_bug_compat():
+    """Documented deviation (VERDICT weak #7): numeric strings parse
+    numerically by default; bug_compatible=True reproduces the reference's
+    (s.lower() == 'true') rule where "1" -> 0."""
+    from dinunet_implementations_tpu.data.freesurfer import coerce_label
+
+    assert coerce_label("true") == 1
+    assert coerce_label("False") == 0
+    assert coerce_label("1") == 1
+    assert coerce_label("0.0") == 0
+    assert coerce_label(True) == 1
+    # reference bit-compatibility mode: every string is (== 'true')
+    assert coerce_label("1", bug_compatible=True) == 0
+    assert coerce_label("true", bug_compatible=True) == 1
+    assert coerce_label("yes", bug_compatible=True) == 0

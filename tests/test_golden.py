@@ -1,0 +1,51 @@
+"""Golden-metric regression (VERDICT round-1 #2): the rebuild must reach
+reference-grade accuracy on the reference's own fixture for all three
+aggregation engines.
+
+Reference numbers: 2-site FS-Classification run, ``nnlogs.ipynb`` cell 2
+(BASELINE.md): dSGD [0.72688, 0.81404], rankDAD [0.38915, 0.85351],
+powerSGD [0.33662, 0.90702] as test [loss, AUC]. Here the full 5-site
+``datasets/test_fsl`` fixture trains to convergence (patience-based early
+stop, same compspec defaults) and must meet or beat each engine's reference
+AUC. Measured on this harness (seed 0): dSGD 0.967, rankDAD 0.914,
+powerSGD 0.984 — wall-clock ~12-26s on the 8-device CPU simulator vs the
+reference's 695-2339s per engine.
+"""
+
+import math
+import os
+
+import pytest
+
+from dinunet_implementations_tpu import TrainConfig
+from dinunet_implementations_tpu.runner import FedRunner
+
+FSL = "/root/reference/datasets/test_fsl"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(FSL), reason="reference fixture not mounted"
+)
+
+REFERENCE_AUC = {  # nnlogs.ipynb cell 2 (BASELINE.md)
+    "dSGD": 0.81404,
+    "rankDAD": 0.85351,
+    "powerSGD": 0.90702,
+}
+
+
+@pytest.mark.golden
+@pytest.mark.parametrize("engine", ["dSGD", "rankDAD", "powerSGD"])
+def test_engine_converges_to_reference_grade_auc(engine, tmp_path):
+    cfg = TrainConfig(
+        agg_engine=engine, epochs=101, patience=35,
+        split_ratio=(0.7, 0.15, 0.15), seed=0,
+    )
+    res = FedRunner(cfg, data_path=FSL, out_dir=str(tmp_path)).run(verbose=False)[0]
+    loss, auc = res["test_metrics"][0]
+    ref = REFERENCE_AUC[engine]
+    assert auc >= ref, (
+        f"{engine}: converged test AUC {auc:.4f} below the reference's "
+        f"{ref:.4f} (best_val_epoch={res['best_val_epoch']}, "
+        f"stopped={res['stopped_epoch']})"
+    )
+    assert loss > 0 and math.isfinite(loss)
