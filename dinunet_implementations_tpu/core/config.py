@@ -109,6 +109,9 @@ class ICAArgs:
     # the reference trainers (grep: no seq_len/components_file use in comps/)
     seq_len: int = 13
     components_file: str = ""
+    # TPU extension: "bfloat16" runs encoder/LSTM matmuls in bf16 with f32
+    # accumulation (~MXU-native mixed precision); "" = full f32 (parity)
+    compute_dtype: str = ""
 
 
 @dataclass

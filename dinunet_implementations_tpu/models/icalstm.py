@@ -68,6 +68,7 @@ class LSTMCell(nn.Module):
     hidden_size: int
     double_sigmoid_gates: bool = False
     use_pallas: bool | None = None
+    compute_dtype: str | None = None  # e.g. "bfloat16"; None = f32 (parity)
 
     @nn.compact
     def __call__(self, x, h0=None):
@@ -78,9 +79,22 @@ class LSTMCell(nn.Module):
         w_hh = self.param("w_hh", TorchLinearInit.kernel, (H, 4 * H))
         b_hh = self.param("b_hh", TorchLinearInit.bias_for(H), (4 * H,))
 
-        xi = x @ w_ih + (b_ih + b_hh)  # [B, T, 4H] — all timesteps, one matmul
+        cdt = jnp.dtype(self.compute_dtype) if self.compute_dtype else None
+        if cdt is not None:
+            # i2h projection for all timesteps in bf16 on the MXU (f32 accum);
+            # the [B, T, 4H] result is stored at bf16 — it is pure streaming
+            # traffic into the recurrence kernel, the largest intermediate of
+            # the model, and XLA fuses the downcast into the matmul epilogue
+            xi = (jnp.dot(
+                x.astype(cdt), w_ih.astype(cdt),
+                preferred_element_type=jnp.float32,
+            ) + (b_ih + b_hh)).astype(cdt)
+        else:
+            xi = x @ w_ih + (b_ih + b_hh)  # [B, T, 4H] — one matmul
         if h0 is None:
-            h0 = (jnp.zeros((B, H), x.dtype), jnp.zeros((B, H), x.dtype))
+            # carry is always f32: the scan body computes an f32 carry (scan
+            # requires carry-type invariance) and the kernel keeps f32 carries
+            h0 = (jnp.zeros((B, H), jnp.float32), jnp.zeros((B, H), jnp.float32))
 
         use_pallas = (
             self.use_pallas if self.use_pallas is not None else _auto_pallas()
@@ -88,11 +102,17 @@ class LSTMCell(nn.Module):
         if use_pallas:
             from ..ops.lstm_pallas import lstm_forward
 
-            return lstm_forward(xi, w_hh, h0[0], h0[1])
+            return lstm_forward(xi, w_hh, h0[0], h0[1], compute_dtype=cdt)
 
         def step(carry, xt):
             h, c = carry
-            preact = xt + h @ w_hh
+            if cdt is not None:
+                preact = xt + jnp.dot(
+                    h.astype(cdt), w_hh.astype(cdt),
+                    preferred_element_type=jnp.float32,
+                )
+            else:
+                preact = xt + h @ w_hh
             i, f, o, g = _lstm_gates(preact, H, self.double_sigmoid_gates)
             c = f * c + i * g
             h = o * jnp.tanh(c)
@@ -110,17 +130,20 @@ class BiLSTM(nn.Module):
     bidirectional: bool = True
     double_sigmoid_gates: bool = False
     use_pallas: bool | None = None
+    compute_dtype: str | None = None
 
     @nn.compact
     def __call__(self, x, h0=None):
         per_dir = self.hidden_size // (2 if self.bidirectional else 1)
         fwd, (h, c) = LSTMCell(
-            per_dir, self.double_sigmoid_gates, self.use_pallas, name="fwd"
+            per_dir, self.double_sigmoid_gates, self.use_pallas,
+            self.compute_dtype, name="fwd"
         )(x, h0)
         if not self.bidirectional:
             return fwd, (h, c)
         rev, (hr, cr) = LSTMCell(
-            per_dir, self.double_sigmoid_gates, self.use_pallas, name="rev"
+            per_dir, self.double_sigmoid_gates, self.use_pallas,
+            self.compute_dtype, name="rev"
         )(jnp.flip(x, axis=1), h0)
         return (
             jnp.concatenate([fwd, rev], axis=2),
@@ -139,23 +162,30 @@ class ICALstm(nn.Module):
     double_sigmoid_gates: bool = False
     dropout_rate: float = 0.25
     use_pallas: bool | None = None  # None = auto (kernel on accelerators)
+    compute_dtype: str | None = None  # "bfloat16" = mixed precision (f32 accum)
 
     @nn.compact
     def __call__(self, x, train: bool = True, mask=None):
         # x: [B, S, C, W] (windows, components, timepoints-per-window)
         B, S = x.shape[0], x.shape[1]
         flat = x.reshape(B, S, -1)  # [B, S, C*W]
+        cdt = jnp.dtype(self.compute_dtype) if self.compute_dtype else None
+        # under compute_dtype the encoder output stays bf16 — it feeds the
+        # per-direction i2h projections, which consume bf16 directly
         enc = nn.relu(
-            dense(self.input_size, fan_in=self.num_comp_window, name="encoder")(flat)
+            dense(self.input_size, fan_in=self.num_comp_window, name="encoder",
+                  dtype=cdt)(flat)
         )
         o, h = BiLSTM(
             self.hidden_size,
             self.bidirectional,
             self.double_sigmoid_gates,
             self.use_pallas,
+            self.compute_dtype,
             name="lstm",
         )(enc)
         o = jnp.mean(o, axis=1)  # mean-pool over windows (models.py:109)
+        o = o.astype(jnp.float32)  # classifier head + BN stay full precision
 
         # classifier head (models.py:96-104); per-direction width totals
         # hidden_size when bidirectional splits evenly, else 2*(H//2).

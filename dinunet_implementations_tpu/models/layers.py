@@ -101,12 +101,16 @@ class TorchLinearInit:
         return init
 
 
-def dense(features: int, use_bias: bool = True, name=None, fan_in: int | None = None):
-    """``nn.Dense`` with torch-style init."""
+def dense(features: int, use_bias: bool = True, name=None, fan_in: int | None = None,
+          dtype=None):
+    """``nn.Dense`` with torch-style init. ``dtype`` sets the computation
+    dtype (e.g. bf16 mixed precision); params stay f32."""
     return nn.Dense(
         features,
         use_bias=use_bias,
         name=name,
+        dtype=dtype,
+        param_dtype=jnp.float32,
         kernel_init=TorchLinearInit.kernel,
         bias_init=TorchLinearInit.bias_for(fan_in) if fan_in else nn.initializers.zeros,
     )

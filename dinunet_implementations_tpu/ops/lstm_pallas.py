@@ -14,11 +14,29 @@ a lane boundary (Mosaic-friendly; see pallas_guide.md pitfall #2).
 
 Grid: ``(batch_tiles, T)`` — TPU grids execute sequentially, so VMEM scratch
 carries (h, c) across the T dimension; time-reversed index maps drive the
-backward kernel. The backward accumulates dW in a revisited output block.
+backward kernel.
+
+Two measured design points (flagship shape, 32 vmapped sites, v5e):
+
+- **dW lives OUTSIDE the kernel.** The weight gradient is the only cross-row
+  reduction in BPTT; accumulating it in-kernel forced 4 extra outer-product
+  dots per backward step AND made the kernel's outputs non-row-wise. Instead
+  the backward kernel streams out the gate pre-activation cotangents (which
+  are the dxi outputs anyway) and dW is one XLA einsum over the saved hidden
+  sequence — a large, MXU-shaped batched matmul.
+- **vmap folds into kernel rows, not grid steps.** jax's default vmap rule
+  for ``pallas_call`` prepends a grid dimension, which executes
+  SEQUENTIALLY on a TPU core — 32 vmapped sites ran as 32 serial passes of
+  [16, H] matmuls. Both kernel entry points carry a ``custom_vmap`` rule that
+  folds the mapped axis into the batch-row dimension instead ([512, H]
+  matmuls, full MXU rows), padding rows to the kernel tile as needed. The
+  fold is valid because every kernel output is row-wise (see previous point).
 
 Semantics: standard LSTM gates (single sigmoid). The reference's
 double-sigmoid quirk mode stays on the XLA scan path (models/icalstm.py) —
 the kernel is the fast path for the default configuration.
+``compute_dtype=bfloat16`` runs the matmuls in bf16 with f32 accumulation;
+``None`` (default) is full f32, bit-comparable with the scan path.
 """
 
 from __future__ import annotations
@@ -27,6 +45,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax.custom_batching import custom_vmap
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -36,6 +55,10 @@ B_TILE = 128
 def _interpret() -> bool:
     # Pallas TPU kernels run in interpreter mode on CPU (tests / simulators)
     return jax.default_backend() == "cpu"
+
+
+def _cdt_name(compute_dtype) -> str | None:
+    return jnp.dtype(compute_dtype).name if compute_dtype is not None else None
 
 
 # ---------------------------------------------------------------------------
@@ -51,38 +74,48 @@ def _fwd_kernel(xi_i, xi_f, xi_o, xi_g, w, h0, c0, hs, cs, ai, af, ao, ag, h_s, 
         h_s[:] = h0[:]
         c_s[:] = c0[:]
 
-    h = h_s[:]
-    # preact_k = xi_k[t] + h @ W_k   (W resident in VMEM, [4, H, H])
-    i = jax.nn.sigmoid(xi_i[0] + jnp.dot(h, w[0], preferred_element_type=jnp.float32))
-    f = jax.nn.sigmoid(xi_f[0] + jnp.dot(h, w[1], preferred_element_type=jnp.float32))
-    o = jax.nn.sigmoid(xi_o[0] + jnp.dot(h, w[2], preferred_element_type=jnp.float32))
-    g = jnp.tanh(xi_g[0] + jnp.dot(h, w[3], preferred_element_type=jnp.float32))
+    h = h_s[:].astype(w.dtype)  # matmul in w's dtype (f32 or bf16), f32 accum
+    # preact_k = xi_k[t] + h @ W_k   (W resident in VMEM, [4, H, H]).
+    # xi streams may be bf16 (halved HBM traffic); gate math is f32 — the
+    # dot's preferred_element_type upcasts, xi upcasts via astype.
+    f32 = jnp.float32
+    i = jax.nn.sigmoid(xi_i[0].astype(f32) + jnp.dot(h, w[0], preferred_element_type=f32))
+    f = jax.nn.sigmoid(xi_f[0].astype(f32) + jnp.dot(h, w[1], preferred_element_type=f32))
+    o = jax.nn.sigmoid(xi_o[0].astype(f32) + jnp.dot(h, w[2], preferred_element_type=f32))
+    g = jnp.tanh(xi_g[0].astype(f32) + jnp.dot(h, w[3], preferred_element_type=f32))
     c = f * c_s[:] + i * g
     h = o * jnp.tanh(c)
-    h_s[:] = h
+    h_s[:] = h          # carries stay f32 in VMEM across the whole sequence
     c_s[:] = c
-    hs[0] = h
-    cs[0] = c
-    ai[0] = i
-    af[0] = f
-    ao[0] = o
-    ag[0] = g
+    hs[0] = h.astype(hs.dtype)   # streamed outputs may be bf16
+    cs[0] = c.astype(cs.dtype)
+    ai[0] = i.astype(ai.dtype)
+    af[0] = f.astype(af.dtype)
+    ao[0] = o.astype(ao.dtype)
+    ag[0] = g.astype(ag.dtype)
 
 
-def _fwd_call(xi4, w4, h0, c0):
+def _fwd_call(xi4, w4, h0, c0, compute_dtype=None):
     T, B, H = xi4[0].shape
     bt = min(B_TILE, B)
     assert B % bt == 0, (
         f"batch {B} must be a multiple of the kernel tile {bt}; "
         "use lstm_forward(), which pads"
     )
+    if compute_dtype is not None:
+        # mixed precision: matmuls AND the streamed [T, B, H] arrays (the
+        # kernel's bandwidth bottleneck) run at compute_dtype; the recurrence
+        # carries and all accumulation stay f32 in VMEM
+        w4 = w4.astype(compute_dtype)
+        xi4 = tuple(a.astype(compute_dtype) for a in xi4)
     grid = (B // bt, T)
     t_block = lambda b, t: (t, b, 0)
     b_block = lambda b, t: (b, 0)
     spec_t = pl.BlockSpec((1, bt, H), t_block, memory_space=pltpu.VMEM)
     spec_b = pl.BlockSpec((bt, H), b_block, memory_space=pltpu.VMEM)
     spec_w = pl.BlockSpec((4, H, H), lambda b, t: (0, 0, 0), memory_space=pltpu.VMEM)
-    out_shape = jax.ShapeDtypeStruct((T, B, H), jnp.float32)
+    stream_dtype = jnp.dtype(compute_dtype) if compute_dtype is not None else jnp.float32
+    out_shape = jax.ShapeDtypeStruct((T, B, H), stream_dtype)
     outs = pl.pallas_call(
         _fwd_kernel,
         grid=grid,
@@ -96,14 +129,14 @@ def _fwd_call(xi4, w4, h0, c0):
 
 
 # ---------------------------------------------------------------------------
-# backward
+# backward (dW is computed OUTSIDE the kernel — see module docstring)
 # ---------------------------------------------------------------------------
 
 
 def _bwd_kernel(
     T_total,
-    ai, af, ao, ag, cs, cs_prev, hs_prev, w, h0, c0, dhs, dhT, dcT,
-    dxi_i, dxi_f, dxi_o, dxi_g, dh0, dc0, dw,
+    ai, af, ao, ag, cs, cs_prev, w, c0, dhs, dhT, dcT,
+    dxi_i, dxi_f, dxi_o, dxi_g, dh0, dc0,
     dh_s, dc_s,
 ):
     t = pl.program_id(1)  # 0..T-1, walking time backwards: time = T-1-t
@@ -114,21 +147,17 @@ def _bwd_kernel(
     def _():
         # seed the carries with the terminal-state cotangents (exact dcT/dhT);
         # re-seeded at the start of every batch tile (per-tile state)
-        dh_s[:] = dhT[:]
-        dc_s[:] = dcT[:]
+        dh_s[:] = dhT[:].astype(jnp.float32)
+        dc_s[:] = dcT[:].astype(jnp.float32)
 
-    @pl.when(jnp.logical_and(first_time, pl.program_id(0) == 0))
-    def _():
-        # dW accumulates across ALL tiles and timesteps — zero it exactly once
-        dw[:] = jnp.zeros_like(dw)
-
-    i, f, o, g = ai[0], af[0], ao[0], ag[0]
-    c = cs[0]
-    c_prev = jnp.where(last_time, c0[:], cs_prev[0])
-    h_prev = jnp.where(last_time, h0[:], hs_prev[0])
+    f32 = jnp.float32
+    i, f, o, g = (ai[0].astype(f32), af[0].astype(f32),
+                  ao[0].astype(f32), ag[0].astype(f32))
+    c = cs[0].astype(f32)
+    c_prev = jnp.where(last_time, c0[:].astype(f32), cs_prev[0].astype(f32))
 
     tanh_c = jnp.tanh(c)
-    dh = dhs[0] + dh_s[:]
+    dh = dhs[0].astype(f32) + dh_s[:]
     do = dh * tanh_c
     dc = dh * o * (1.0 - tanh_c * tanh_c) + dc_s[:]
     di = dc * g
@@ -140,36 +169,35 @@ def _bwd_kernel(
     dpo = do * o * (1.0 - o)
     dpg = dg * (1.0 - g * g)
 
-    dxi_i[0] = dpi
-    dxi_f[0] = dpf
-    dxi_o[0] = dpo
-    dxi_g[0] = dpg
+    dxi_i[0] = dpi.astype(dxi_i.dtype)
+    dxi_f[0] = dpf.astype(dxi_f.dtype)
+    dxi_o[0] = dpo.astype(dxi_o.dtype)
+    dxi_g[0] = dpg.astype(dxi_g.dtype)
 
-    # dh_{t-1} = Σ_k dp_k @ W_kᵀ ; dW_k += h_{t-1}ᵀ @ dp_k
+    # dh_{t-1} = Σ_k dp_k @ W_kᵀ  (matmuls in w's dtype, f32 accumulation)
+    cdt = w.dtype
     dh_prev = (
-        jnp.dot(dpi, w[0].T, preferred_element_type=jnp.float32)
-        + jnp.dot(dpf, w[1].T, preferred_element_type=jnp.float32)
-        + jnp.dot(dpo, w[2].T, preferred_element_type=jnp.float32)
-        + jnp.dot(dpg, w[3].T, preferred_element_type=jnp.float32)
+        jnp.dot(dpi.astype(cdt), w[0].T, preferred_element_type=jnp.float32)
+        + jnp.dot(dpf.astype(cdt), w[1].T, preferred_element_type=jnp.float32)
+        + jnp.dot(dpo.astype(cdt), w[2].T, preferred_element_type=jnp.float32)
+        + jnp.dot(dpg.astype(cdt), w[3].T, preferred_element_type=jnp.float32)
     )
-    dw[0] += jnp.dot(h_prev.T, dpi, preferred_element_type=jnp.float32)
-    dw[1] += jnp.dot(h_prev.T, dpf, preferred_element_type=jnp.float32)
-    dw[2] += jnp.dot(h_prev.T, dpo, preferred_element_type=jnp.float32)
-    dw[3] += jnp.dot(h_prev.T, dpg, preferred_element_type=jnp.float32)
 
     dh_s[:] = dh_prev
     dc_s[:] = dc * f
 
     @pl.when(last_time)
     def _():
-        dh0[:] = dh_s[:]
-        dc0[:] = dc_s[:]
+        dh0[:] = dh_s[:].astype(dh0.dtype)
+        dc0[:] = dc_s[:].astype(dc0.dtype)
 
 
-def _bwd_call(res, dhs, dhT, dcT):
-    w4, h0, c0, hs, cs, acts = res
-    T, B, H = hs.shape
+def _bwd_call(acts, cs, w4, c0, dhs, dhT, dcT, compute_dtype=None):
+    T, B, H = cs.shape
     bt = min(B_TILE, B)
+    assert B % bt == 0, f"batch {B} must be a multiple of the kernel tile {bt}"
+    if compute_dtype is not None:
+        w4 = w4.astype(compute_dtype)
     grid = (B // bt, T)
 
     rev = lambda b, t: (T - 1 - t, b, 0)
@@ -181,27 +209,126 @@ def _bwd_call(res, dhs, dhT, dcT):
     )
     spec_b = pl.BlockSpec((bt, H), b_block, memory_space=pltpu.VMEM)
     spec_w = pl.BlockSpec((4, H, H), lambda b, t: (0, 0, 0), memory_space=pltpu.VMEM)
-    t_shape = jax.ShapeDtypeStruct((T, B, H), jnp.float32)
+    # dxi dtype must match the xi primal dtype (= the streamed act dtype);
+    # dh0/dc0 match the f32 h0/c0 primals
+    t_shape = jax.ShapeDtypeStruct((T, B, H), acts[0].dtype)
+    b_shape = jax.ShapeDtypeStruct((B, H), jnp.float32)
 
     outs = pl.pallas_call(
         functools.partial(_bwd_kernel, T),
         grid=grid,
         in_specs=[spec_rev] * 4  # i, f, o, g
-        + [spec_rev, spec_prev, spec_prev, spec_w, spec_b, spec_b, spec_rev,
-           spec_b, spec_b],
-        out_specs=[spec_rev] * 4 + [spec_b, spec_b, spec_w],
-        out_shape=[t_shape] * 4
-        + [
-            jax.ShapeDtypeStruct((B, H), jnp.float32),
-            jax.ShapeDtypeStruct((B, H), jnp.float32),
-            jax.ShapeDtypeStruct((4, H, H), jnp.float32),
-        ],
+        + [spec_rev, spec_prev, spec_w, spec_b, spec_rev, spec_b, spec_b],
+        out_specs=[spec_rev] * 4 + [spec_b, spec_b],
+        out_shape=[t_shape] * 4 + [b_shape, b_shape],
         scratch_shapes=[pltpu.VMEM((bt, H), jnp.float32)] * 2,
         interpret=_interpret(),
-    )(*acts, cs, cs, hs, w4, h0, c0, dhs, dhT, dcT)
-    dxi = outs[:4]
-    dh0, dc0, dw = outs[4], outs[5], outs[6]
-    return dxi, dw, dh0, dc0
+    )(*acts, cs, cs, w4, c0, dhs, dhT, dcT)
+    return outs  # dxi_i, dxi_f, dxi_o, dxi_g, dh0, dc0
+
+
+# ---------------------------------------------------------------------------
+# vmap folding: mapped axes become kernel batch rows, not serial grid steps
+# ---------------------------------------------------------------------------
+
+
+def _broadcast_unbatched(args, in_batched, axis_size):
+    return [
+        a if b else jnp.broadcast_to(a[None], (axis_size,) + a.shape)
+        for a, b in zip(args, in_batched)
+    ]
+
+
+def _fold_rows(a):
+    """[S, T, B, H] → [T, S*B, H]"""
+    S, T, B, H = a.shape
+    return jnp.moveaxis(a, 0, 1).reshape(T, S * B, H)
+
+
+def _unfold_rows(a, S, B):
+    """[T, S*B, H] → [S, T, B, H]"""
+    T, SB, H = a.shape
+    return jnp.moveaxis(a.reshape(T, S, B, H), 1, 0)
+
+
+def _pad_rows(arrs, rows, axis):
+    """Pad the row dim of each array up to a kernel-tile multiple."""
+    bt = min(B_TILE, rows)
+    pad = (-rows) % bt
+    if pad == 0:
+        return arrs, rows
+    padded = []
+    for a in arrs:
+        widths = [(0, 0)] * a.ndim
+        widths[axis] = (0, pad)
+        padded.append(jnp.pad(a, widths))
+    return padded, rows + pad
+
+
+@functools.lru_cache(maxsize=None)
+def _fwd_callable(cdt_name: str | None):
+    cdt = jnp.dtype(cdt_name) if cdt_name else None
+
+    @custom_vmap
+    def f(xi_i, xi_f, xi_o, xi_g, w4, h0, c0):
+        return tuple(_fwd_call((xi_i, xi_f, xi_o, xi_g), w4, h0, c0, cdt))
+
+    @f.def_vmap
+    def _rule(axis_size, in_batched, *args):
+        if in_batched[4]:  # per-element recurrent weights: cannot fold rows
+            batched = _broadcast_unbatched(args, in_batched, axis_size)
+            outs = jax.lax.map(lambda a: f(*a), tuple(batched))
+            return tuple(outs), (True,) * 6
+        S = axis_size
+        batched = _broadcast_unbatched(
+            args, [b or i == 4 for i, b in enumerate(in_batched)], S
+        )
+        xi4 = [_fold_rows(a) for a in batched[:4]]
+        w4 = args[4]
+        B = batched[5].shape[1]
+        h0 = batched[5].reshape(S * B, -1)
+        c0 = batched[6].reshape(S * B, -1)
+        (xi4_0, xi4_1, xi4_2, xi4_3, h0, c0), rows_p = _pad_rows(
+            [*xi4, h0, c0], S * B, axis=-2
+        )
+        outs = f(xi4_0, xi4_1, xi4_2, xi4_3, w4, h0, c0)
+        outs = [_unfold_rows(o[:, : S * B], S, B) for o in outs]
+        return tuple(outs), (True,) * 6
+
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def _bwd_callable(cdt_name: str | None):
+    cdt = jnp.dtype(cdt_name) if cdt_name else None
+
+    @custom_vmap
+    def f(ai, af, ao, ag, cs, w4, c0, dhs, dhT, dcT):
+        return tuple(_bwd_call((ai, af, ao, ag), cs, w4, c0, dhs, dhT, dcT, cdt))
+
+    @f.def_vmap
+    def _rule(axis_size, in_batched, *args):
+        if in_batched[5]:  # per-element weights
+            batched = _broadcast_unbatched(args, in_batched, axis_size)
+            outs = jax.lax.map(lambda a: f(*a), tuple(batched))
+            return tuple(outs), (True,) * 6
+        S = axis_size
+        batched = _broadcast_unbatched(
+            args, [b or i == 5 for i, b in enumerate(in_batched)], S
+        )
+        t_arrs = [_fold_rows(batched[i]) for i in (0, 1, 2, 3, 4, 7)]
+        w4 = args[5]
+        B = batched[6].shape[1]
+        b_arrs = [batched[i].reshape(S * B, -1) for i in (6, 8, 9)]
+        rows = S * B
+        (ai, af, ao, ag, cs, dhs), _ = _pad_rows(t_arrs, rows, axis=-2)
+        (c0, dhT, dcT), _ = _pad_rows(b_arrs, rows, axis=-2)
+        outs = f(ai, af, ao, ag, cs, w4, c0, dhs, dhT, dcT)
+        dxi = [_unfold_rows(o[:, :rows], S, B) for o in outs[:4]]
+        db = [o[:rows].reshape(S, B, -1) for o in outs[4:]]
+        return tuple(dxi + db), (True,) * 6
+
+    return f
 
 
 # ---------------------------------------------------------------------------
@@ -209,8 +336,8 @@ def _bwd_call(res, dhs, dhT, dcT):
 # ---------------------------------------------------------------------------
 
 
-@jax.custom_vjp
-def lstm_recurrence(xi4, w4, h0, c0):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def lstm_recurrence(xi4, w4, h0, c0, compute_dtype=None):
     """Run the LSTM time recurrence.
 
     Args:
@@ -218,29 +345,50 @@ def lstm_recurrence(xi4, w4, h0, c0):
         pre-activations, i.e. ``x_t @ W_ih + b`` split per gate).
       w4: ``[4, H, H]`` recurrent weights (i, f, o, g order).
       h0, c0: ``[B, H]`` initial carry.
+      compute_dtype: matmul operand dtype (e.g. ``jnp.bfloat16``) with f32
+        accumulation; ``None`` = full f32 (the parity mode).
 
     Returns: ``(hs [T, B, H], (hT, cT))``.
     """
-    hs, cs, *_ = _fwd_call(xi4, w4, h0, c0)
+    hs, cs, *_ = _fwd_callable(_cdt_name(compute_dtype))(*xi4, w4, h0, c0)
     return hs, (hs[-1], cs[-1])
 
 
-def _vjp_fwd(xi4, w4, h0, c0):
-    hs, cs, i, f, o, g = _fwd_call(xi4, w4, h0, c0)
+def _vjp_fwd(xi4, w4, h0, c0, compute_dtype):
+    hs, cs, i, f, o, g = _fwd_callable(_cdt_name(compute_dtype))(*xi4, w4, h0, c0)
     # xi4 is NOT needed by the backward (dxi == dpreact); don't pin it
     return (hs, (hs[-1], cs[-1])), (w4, h0, c0, hs, cs, (i, f, o, g))
 
 
-def _vjp_bwd(res, grads):
+def _vjp_bwd(compute_dtype, res, grads):
+    w4, h0, c0, hs, cs, acts = res
     dhs, (dhT, dcT) = grads
-    dxi, dw, dh0, dc0 = _bwd_call(res, dhs, dhT, dcT)
-    return tuple(dxi), dw, dh0, dc0
+    cdt_name = _cdt_name(compute_dtype)
+    dxi_i, dxi_f, dxi_o, dxi_g, dh0, dc0 = _bwd_callable(cdt_name)(
+        *acts, cs, w4, c0, dhs, dhT, dcT
+    )
+    # dW_k = Σ_t h_{t-1}ᵀ dp_k — the only cross-row reduction of BPTT, done
+    # here as one MXU-shaped einsum over the saved hidden sequence instead of
+    # per-step outer products inside the kernel (batches cleanly under vmap)
+    h_prev = jnp.concatenate([h0[None].astype(hs.dtype), hs[:-1]], 0)  # [T, B, H]
+    cdt = jnp.dtype(cdt_name) if cdt_name else h_prev.dtype
+    hp = h_prev.astype(cdt)
+    dw = jnp.stack(
+        [
+            jnp.einsum(
+                "tbh,tbg->hg", hp, dp.astype(cdt),
+                preferred_element_type=jnp.float32,
+            )
+            for dp in (dxi_i, dxi_f, dxi_o, dxi_g)
+        ]
+    )
+    return (dxi_i, dxi_f, dxi_o, dxi_g), dw, dh0, dc0
 
 
 lstm_recurrence.defvjp(_vjp_fwd, _vjp_bwd)
 
 
-def lstm_forward(xi, w_hh, h0, c0):
+def lstm_forward(xi, w_hh, h0, c0, compute_dtype=None):
     """Convenience wrapper over :func:`lstm_recurrence` in model layout.
 
     Args:
@@ -248,15 +396,22 @@ def lstm_forward(xi, w_hh, h0, c0):
         the LSTMCell layout, ``x @ W_ih + b_ih + b_hh``).
       w_hh: ``[H, 4H]`` recurrent weight in the same blocked layout.
       h0, c0: ``[B, H]``.
+      compute_dtype: matmul dtype for the recurrence (f32 accumulation);
+        ``None`` = f32 (parity mode).
 
     Returns ``(hs [B, T, H], (hT, cT))``. Pads the batch to the kernel tile
-    internally and slices the padding off.
+    and slices it back off. NOTE on lane alignment: zero-padding the hidden
+    width 174 → 256 was tried and MEASURED as an ~11% LOSS on v5e (37.8k →
+    33.7k samples/s) — the kernel is bound by streaming the [T, B, H] blocks
+    from HBM, and padding inflates that traffic 47% while Mosaic's ragged
+    lane-edge masking was already cheap. Hence H is deliberately unpadded.
     """
     B, T, H4 = xi.shape
     H = H4 // 4
     in_dtype = xi.dtype
-    # the kernel computes in f32 (scratch/accumulators); cast at the boundary
-    xi = xi.astype(jnp.float32)
+    # the kernel accumulates in f32 (scratch/accumulators); the streamed xi
+    # stays at compute_dtype (its cotangent dxi comes back at the same dtype)
+    xi = xi.astype(compute_dtype if compute_dtype is not None else jnp.float32)
     w_hh = w_hh.astype(jnp.float32)
     h0 = h0.astype(jnp.float32)
     c0 = c0.astype(jnp.float32)
@@ -269,7 +424,7 @@ def lstm_forward(xi, w_hh, h0, c0):
     xi_t = jnp.swapaxes(xi, 0, 1)  # [T, B, 4H]
     xi4 = tuple(xi_t[..., k * H : (k + 1) * H] for k in range(4))
     w4 = jnp.stack([w_hh[:, k * H : (k + 1) * H] for k in range(4)])
-    hs, (hT, cT) = lstm_recurrence(xi4, w4, h0, c0)
+    hs, (hT, cT) = lstm_recurrence(xi4, w4, h0, c0, compute_dtype)
     hs = jnp.swapaxes(hs, 0, 1)
     if pad:
         hs, hT, cT = hs[:B], hT[:B], cT[:B]

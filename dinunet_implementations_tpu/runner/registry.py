@@ -51,6 +51,7 @@ def _build_icalstm(cfg: TrainConfig):
         num_comps=a.num_components,
         window_size=a.window_size,
         num_layers=a.num_layers,
+        compute_dtype=a.compute_dtype or None,
     )
 
 

@@ -176,3 +176,45 @@ def test_lstm_recurrence_rejects_indivisible_batch():
             )
     finally:
         lstm_pallas.B_TILE = old
+
+
+def test_compute_dtype_bf16_close_to_f32():
+    """Mixed-precision mode (bf16 matmuls/streams, f32 carries+accum) must
+    track the f32 path closely — forward and gradients — incl. under vmap."""
+    S, B, T, D, H = 3, 4, 6, 5, 8
+    key = jax.random.PRNGKey(8)
+    x = jax.random.normal(key, (S, B, T, D))
+    params = _params(key, D, H)
+    f32 = LSTMCell(H, use_pallas=True)
+    b16 = LSTMCell(H, use_pallas=True, compute_dtype="bfloat16")
+
+    out_f = jax.vmap(lambda xx: f32.apply({"params": params}, xx)[0])(x)
+    out_b = jax.vmap(lambda xx: b16.apply({"params": params}, xx)[0])(x)
+    np.testing.assert_allclose(np.asarray(out_b), np.asarray(out_f), atol=0.05)
+
+    def loss(p, module):
+        hs = jax.vmap(lambda xx: module.apply({"params": p}, xx)[0])(x)
+        return jnp.sum(hs**2)
+
+    g_f = jax.grad(loss)(params, f32)
+    g_b = jax.grad(loss)(params, b16)
+    for k in params:
+        a, b = np.asarray(g_b[k], np.float32), np.asarray(g_f[k])
+        denom = max(np.abs(b).max(), 1.0)
+        assert np.abs(a - b).max() / denom < 0.06, k
+
+
+def test_scan_path_bf16_carry_types():
+    """Review regression: the lax.scan fallback with compute_dtype set must
+    not violate scan carry-type invariance (bf16 h0 vs f32 carry)."""
+    key = jax.random.PRNGKey(9)
+    x = jax.random.normal(key, (2, 4, 5))
+    params = _params(key, 5, 8)
+    hs, (hT, cT) = LSTMCell(8, use_pallas=False, compute_dtype="bfloat16").apply(
+        {"params": params}, x
+    )
+    assert np.isfinite(np.asarray(hs, np.float32)).all()
+    hs_f, _ = LSTMCell(8, use_pallas=False).apply({"params": params}, x)
+    np.testing.assert_allclose(
+        np.asarray(hs, np.float32), np.asarray(hs_f), atol=0.05
+    )
