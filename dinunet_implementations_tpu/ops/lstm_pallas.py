@@ -356,12 +356,18 @@ def lstm_recurrence(xi4, w4, h0, c0, compute_dtype=None):
 
 def _vjp_fwd(xi4, w4, h0, c0, compute_dtype):
     hs, cs, i, f, o, g = _fwd_callable(_cdt_name(compute_dtype))(*xi4, w4, h0, c0)
-    # xi4 is NOT needed by the backward (dxi == dpreact); don't pin it
-    return (hs, (hs[-1], cs[-1])), (w4, h0, c0, hs, cs, (i, f, o, g))
+    # xi4 is NOT needed by the backward (dxi == dpreact); don't pin it. Only
+    # its dtype rides along (as a zero-size array — residuals must be JAX
+    # types) so the dxi cotangents can be cast back to the primal dtype (a
+    # direct caller may pass f32 xi with bf16 compute_dtype; custom_vjp
+    # requires cotangent avals to match the primal avals exactly)
+    xi_proto = jnp.zeros((0,), xi4[0].dtype)
+    return (hs, (hs[-1], cs[-1])), (xi_proto, w4, h0, c0, hs, cs, (i, f, o, g))
 
 
 def _vjp_bwd(compute_dtype, res, grads):
-    w4, h0, c0, hs, cs, acts = res
+    xi_proto, w4, h0, c0, hs, cs, acts = res
+    xi_dtype = xi_proto.dtype
     dhs, (dhT, dcT) = grads
     cdt_name = _cdt_name(compute_dtype)
     dxi_i, dxi_f, dxi_o, dxi_g, dh0, dc0 = _bwd_callable(cdt_name)(
@@ -382,7 +388,8 @@ def _vjp_bwd(compute_dtype, res, grads):
             for dp in (dxi_i, dxi_f, dxi_o, dxi_g)
         ]
     )
-    return (dxi_i, dxi_f, dxi_o, dxi_g), dw, dh0, dc0
+    dxi = tuple(d.astype(xi_dtype) for d in (dxi_i, dxi_f, dxi_o, dxi_g))
+    return dxi, dw, dh0, dc0
 
 
 lstm_recurrence.defvjp(_vjp_fwd, _vjp_bwd)

@@ -63,10 +63,14 @@ def load_checkpoint(path: str, like: TrainState, with_meta: bool = False):
         "engine_state": like.engine_state,
         "rng": like.rng,
         "round": like.round,
-        "meta_json": "",
     }
     with open(path, "rb") as fh:
-        restored = flax.serialization.from_bytes(template, fh.read())
+        raw = flax.serialization.msgpack_restore(fh.read())
+    # meta_json restored tolerantly: checkpoints written before it existed
+    # (pre-0.2.0) must still resume rather than fail the template match
+    meta_json = raw.pop("meta_json", None)
+    restored = flax.serialization.from_state_dict(template, raw)
+    restored["meta_json"] = meta_json
     state = TrainState(
         params=restored["params"],
         batch_stats=restored["batch_stats"],

@@ -221,23 +221,6 @@ class MulticlassMetrics(_MetricValues):
     def auc(self) -> float:
         return self._ovr("auc")
 
-    def value(self, name: str) -> float:
-        name = name.lower()
-        fns = {
-            "accuracy": self.accuracy,
-            "f1": self.f1,
-            "precision": self.precision,
-            "recall": self.recall,
-            "auc": self.auc,
-        }
-        if name not in fns:
-            raise ValueError(f"unknown metric {name!r} (have {sorted(fns)})")
-        return fns[name]()
-
-    def get(self, *names) -> list[float]:
-        names = names or ("accuracy", "f1")
-        return [round(self.value(n), 5) for n in names]
-
 
 def is_improvement(new: float, best: float | None, direction: str = "maximize") -> bool:
     """``metric_direction`` semantics (``compspec.json:254-255``)."""

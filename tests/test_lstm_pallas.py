@@ -218,3 +218,27 @@ def test_scan_path_bf16_carry_types():
     np.testing.assert_allclose(
         np.asarray(hs, np.float32), np.asarray(hs_f), atol=0.05
     )
+
+
+def test_lstm_recurrence_direct_f32_xi_bf16_compute_grad():
+    """ADVICE r2 regression: a direct lstm_recurrence call with f32 xi4 and
+    compute_dtype='bfloat16' must return f32 dxi cotangents (custom_vjp
+    requires cotangent avals to match the primal avals)."""
+    from dinunet_implementations_tpu.ops.lstm_pallas import lstm_recurrence
+
+    B, T, H = 4, 5, 8
+    key = jax.random.PRNGKey(9)
+    xi4 = tuple(
+        jax.random.normal(jax.random.fold_in(key, k), (T, B, H)) for k in range(4)
+    )
+    w4 = jax.random.normal(key, (4, H, H)) * 0.2
+    h0 = jnp.zeros((B, H))
+    c0 = jnp.zeros((B, H))
+
+    def loss(xi4):
+        hs, _ = lstm_recurrence(xi4, w4, h0, c0, jnp.bfloat16)
+        return jnp.sum(hs.astype(jnp.float32) ** 2)
+
+    g = jax.grad(loss)(xi4)
+    assert all(x.dtype == jnp.float32 for x in g)
+    assert all(np.isfinite(np.asarray(x)).all() for x in g)

@@ -471,3 +471,33 @@ def test_checkpoint_write_is_atomic_no_tmp_left(tmp_path):
     assert not _os.path.exists(p + ".tmp")
     restored, meta = _lc(p, state, with_meta=True)
     assert meta["epoch"] == 3
+
+
+def test_checkpoint_load_pre_meta_format(tmp_path):
+    """ADVICE r2 regression: checkpoints written before meta_json existed
+    (pre-0.2.0) must still load instead of failing the template match."""
+    import flax.serialization
+
+    mesh = host_mesh(2)
+    _, _, _, state, fn = _setup(mesh)
+    X, y, w = _make_data(2, 2, 8)
+    state, _ = fn(state, X, y, w)
+    old_payload = {
+        "params": state.params,
+        "batch_stats": state.batch_stats,
+        "opt_state": state.opt_state,
+        "engine_state": state.engine_state,
+        "rng": state.rng,
+        "round": state.round,
+    }  # no meta_json key — the old on-disk format
+    p = str(tmp_path / "old.msgpack")
+    with open(p, "wb") as fh:
+        fh.write(flax.serialization.to_bytes(old_payload))
+    _, _, _, fresh, _ = _setup(mesh)
+    restored, meta = load_checkpoint(p, fresh, with_meta=True)
+    assert meta == {}
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        state.params,
+        restored.params,
+    )
