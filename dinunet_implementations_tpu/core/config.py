@@ -149,6 +149,8 @@ class MultimodalArgs:
     num_heads: int = 8
     num_layers: int = 4
     mlp_ratio: int = 4
+    # "" = auto: ring attention iff model_axis_size > 1; "local"/"ring" force
+    attention: str = ""
     dad_reduction_rank: int = 10
     dad_num_pow_iters: int = 5
     dad_tol: float = 1e-3
@@ -224,6 +226,12 @@ class TrainConfig:
     # --- TPU-build extras
     num_sites: int = 2
     sites_per_device: int = 1  # >1 folds several simulated sites onto one chip
+    # sequence/model parallelism (SURVEY.md §2.2 TPU extension): >1 builds a
+    # (site, model) mesh; each site's model shards its sequence axis over the
+    # model axis — ICALstm runs its BiLSTM as a ring LSTM, the multimodal
+    # transformer uses ring attention (runner/registry.py wires both). Needs
+    # num_sites × model_axis_size devices.
+    model_axis_size: int = 1
 
     # -- helpers ---------------------------------------------------------
 

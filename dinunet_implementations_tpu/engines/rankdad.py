@@ -18,7 +18,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..parallel.collectives import payload_dtype, site_weight_scale
+from ..parallel.collectives import payload_dtype, site_all_gather, site_weight_scale
 from .base import Engine, register_engine
 from .lowrank import from_matrix, is_compressible, subspace_iteration, to_matrix
 
@@ -49,8 +49,8 @@ def make_rankdad(
             # weighted mean; cast payload like the reference's precision_bits
             P_pay = P.astype(pdtype)
             Q_pay = (Q * scale).astype(pdtype)
-            P_all = jax.lax.all_gather(P_pay, axis_name)  # [S, m, r]
-            Q_all = jax.lax.all_gather(Q_pay, axis_name)  # [S, n, r]
+            P_all = site_all_gather(P_pay, axis_name)  # [S, m, r]
+            Q_all = site_all_gather(Q_pay, axis_name)  # [S, n, r]
             G_hat = jnp.einsum(
                 "smr,snr->mn",
                 P_all.astype(jnp.float32),

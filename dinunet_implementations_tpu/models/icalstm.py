@@ -124,27 +124,59 @@ class LSTMCell(nn.Module):
 
 class BiLSTM(nn.Module):
     """Bidirectional wrapper (reference ``comps/icalstm/models.py:48-66``):
-    ``hidden_size`` is the *total* width, split across directions."""
+    ``hidden_size`` is the *total* width, split across directions.
+
+    ``sequence_axis``: when set (a bound mesh axis name, normally
+    ``parallel.mesh.MODEL_AXIS``), ``x`` is this device's time chunk of a
+    sequence sharded over that axis; each direction runs as a ring LSTM
+    (parallel/sequence.py) with the carry relayed around the ring. Submodule
+    names match the dense path, so params are interchangeable.
+    """
 
     hidden_size: int
     bidirectional: bool = True
     double_sigmoid_gates: bool = False
     use_pallas: bool | None = None
     compute_dtype: str | None = None
+    sequence_axis: str | None = None
 
     @nn.compact
     def __call__(self, x, h0=None):
         per_dir = self.hidden_size // (2 if self.bidirectional else 1)
-        fwd, (h, c) = LSTMCell(
+        fwd_cell = LSTMCell(
             per_dir, self.double_sigmoid_gates, self.use_pallas,
             self.compute_dtype, name="fwd"
-        )(x, h0)
+        )
+        if self.sequence_axis is None:
+            fwd, (h, c) = fwd_cell(x, h0)
+        else:
+            from ..parallel.sequence import reverse_sequence, ring_lstm
+
+            if h0 is None:
+                z = jnp.zeros((x.shape[0], per_dir), jnp.float32)
+                h0 = (z, z)
+            fwd, (h, c) = ring_lstm(
+                lambda xc, carry: fwd_cell(xc, carry), x, h0[0], h0[1],
+                axis_name=self.sequence_axis,
+            )
         if not self.bidirectional:
             return fwd, (h, c)
-        rev, (hr, cr) = LSTMCell(
+        rev_cell = LSTMCell(
             per_dir, self.double_sigmoid_gates, self.use_pallas,
             self.compute_dtype, name="rev"
-        )(jnp.flip(x, axis=1), h0)
+        )
+        if self.sequence_axis is None:
+            rev, (hr, cr) = rev_cell(jnp.flip(x, axis=1), h0)
+        else:
+            # reverse direction = the cell over the time-reversed GLOBAL
+            # sequence; reverse_sequence re-shards it so device i holds
+            # reversed-chunk i, making the local concat line up with the dense
+            # path's (no flip-back, as the reference) hidden concat
+            rev, (hr, cr) = ring_lstm(
+                lambda xc, carry: rev_cell(xc, carry),
+                reverse_sequence(x, self.sequence_axis, axis=1),
+                h0[0], h0[1], axis_name=self.sequence_axis,
+            )
         return (
             jnp.concatenate([fwd, rev], axis=2),
             (jnp.concatenate([h, hr], 1), jnp.concatenate([c, cr], 1)),
@@ -163,12 +195,30 @@ class ICALstm(nn.Module):
     dropout_rate: float = 0.25
     use_pallas: bool | None = None  # None = auto (kernel on accelerators)
     compute_dtype: str | None = None  # "bfloat16" = mixed precision (f32 accum)
+    # Sequence parallelism (TPU extension, SURVEY.md §2.2): a bound mesh axis
+    # name (parallel.mesh.MODEL_AXIS) shards the window axis S across that
+    # axis — the encoder runs on the local chunk, the BiLSTM relays its carry
+    # ring-style, and the time mean-pool finishes with an all_gather. Callers
+    # pass the FULL [B, S, C, W] batch (replicated over the axis); the model
+    # takes its own chunk. Init outside the mesh with sequence_axis=None —
+    # param shapes/names are identical (FederatedTask.init_variables does this).
+    sequence_axis: str | None = None
 
     @nn.compact
     def __call__(self, x, train: bool = True, mask=None):
         # x: [B, S, C, W] (windows, components, timepoints-per-window)
         B, S = x.shape[0], x.shape[1]
         flat = x.reshape(B, S, -1)  # [B, S, C*W]
+        if self.sequence_axis is not None:
+            from ..parallel.sequence import shard_sequence
+
+            n = jax.lax.axis_size(self.sequence_axis)
+            if S % n:
+                raise ValueError(
+                    f"sequence parallelism needs windows ({S}) divisible by "
+                    f"the {self.sequence_axis!r} axis size ({n})"
+                )
+            flat = shard_sequence(flat, self.sequence_axis, axis=1)
         cdt = jnp.dtype(self.compute_dtype) if self.compute_dtype else None
         # under compute_dtype the encoder output stays bf16 — it feeds the
         # per-direction i2h projections, which consume bf16 directly
@@ -182,9 +232,18 @@ class ICALstm(nn.Module):
             self.double_sigmoid_gates,
             self.use_pallas,
             self.compute_dtype,
+            self.sequence_axis,
             name="lstm",
         )(enc)
-        o = jnp.mean(o, axis=1)  # mean-pool over windows (models.py:109)
+        if self.sequence_axis is not None:
+            # mean over the GLOBAL window axis: local sum, then all_gather
+            # (transpose = reduce-scatter, so chunk cotangents route back to
+            # the owning device — sound under AD, unlike a bare psum here)
+            o = jax.lax.all_gather(
+                o.sum(axis=1), self.sequence_axis
+            ).sum(axis=0) / S
+        else:
+            o = jnp.mean(o, axis=1)  # mean-pool over windows (models.py:109)
         o = o.astype(jnp.float32)  # classifier head + BN stay full precision
 
         # classifier head (models.py:96-104); per-direction width totals

@@ -62,6 +62,22 @@ class TransformerBlock(nn.Module):
     attention: str = "local"
     axis_name: str | None = None
 
+    def _dropout(self, h, train: bool):
+        if not train or self.dropout_rate == 0.0:
+            return h
+        if self.attention == "ring" and self.axis_name is not None:
+            # h is this device's token chunk; the dropout rng is replicated
+            # across the model axis, so plain nn.Dropout would draw the SAME
+            # mask for every chunk (correlated dropout, tiled over the token
+            # axis). Fold the axis index in so each chunk gets its own mask.
+            rng = jax.random.fold_in(
+                self.make_rng("dropout"), jax.lax.axis_index(self.axis_name)
+            )
+            keep = 1.0 - self.dropout_rate
+            mask = jax.random.bernoulli(rng, keep, h.shape)
+            return jnp.where(mask, h / keep, jnp.zeros_like(h))
+        return nn.Dropout(self.dropout_rate, deterministic=False)(h)
+
     @nn.compact
     def __call__(self, x, train: bool = True):
         h = nn.LayerNorm(name="ln1")(x)
@@ -69,12 +85,12 @@ class TransformerBlock(nn.Module):
             self.embed_dim, self.num_heads, self.attention, self.axis_name,
             name="attn",
         )(h)
-        x = x + nn.Dropout(self.dropout_rate, deterministic=not train)(h)
+        x = x + self._dropout(h, train)
         h = nn.LayerNorm(name="ln2")(x)
         h = dense(self.embed_dim * self.mlp_ratio, fan_in=self.embed_dim, name="mlp1")(h)
         h = nn.gelu(h)
         h = dense(self.embed_dim, fan_in=self.embed_dim * self.mlp_ratio, name="mlp2")(h)
-        return x + nn.Dropout(self.dropout_rate, deterministic=not train)(h)
+        return x + self._dropout(h, train)
 
 
 class MultimodalNet(nn.Module):
@@ -116,10 +132,29 @@ class MultimodalNet(nn.Module):
             "pos_embed", nn.initializers.normal(0.02), (1, T, self.embed_dim)
         )
         h = tokens + pos
+        ring = self.attention == "ring" and self.axis_name is not None
+        if ring:
+            # sequence parallelism: shard the token axis over the mesh axis —
+            # each device keeps its chunk through every block (attention is
+            # the only cross-chunk op, handled by ring_attention's K/V ring)
+            from ..parallel.sequence import gather_sequence, shard_sequence
+
+            n = jax.lax.axis_size(self.axis_name)
+            if T % n:
+                raise ValueError(
+                    f"ring attention needs tokens ({T}) divisible by the "
+                    f"{self.axis_name!r} axis size ({n})"
+                )
+            h = shard_sequence(h, self.axis_name, axis=1)
         for i in range(self.num_layers):
             h = TransformerBlock(
                 self.embed_dim, self.num_heads, self.mlp_ratio, self.dropout_rate,
                 self.attention, self.axis_name, name=f"block_{i}",
             )(h, train=train)
         h = nn.LayerNorm(name="ln_f")(h)
+        if ring:
+            # the CLS token lives in chunk 0; gather so every device returns
+            # identical logits (all_gather transposes to reduce-scatter — AD
+            # routes the CLS cotangent back to the owning chunk)
+            h = gather_sequence(h, self.axis_name, axis=1)
         return dense(self.num_cls, fan_in=self.embed_dim, name="head")(h[:, 0])

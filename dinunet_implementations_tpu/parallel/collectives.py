@@ -77,10 +77,23 @@ def site_weighted_mean(tree, weight, axis_name: str = SITE_AXIS):
     )
 
 
-def site_all_gather(x, axis_name: str = SITE_AXIS, axis: int = 0, tiled: bool = False):
+def site_all_gather(x, axis_name=SITE_AXIS, axis: int = 0, tiled: bool = False):
     """Gather per-site values to every site (used by the low-rank engines to
-    share rank-r factors instead of full gradients)."""
-    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+    share rank-r factors instead of full gradients).
+
+    ``axis_name`` may be a (mesh_axis, vmap_axis) tuple — the folded-sites
+    case, where several simulated sites ride one device as a vmapped block.
+    ``jax.lax.all_gather`` rejects mixed mesh/vmap axis tuples (unlike
+    ``psum``), so gather each axis in turn, innermost first, and flatten: the
+    leading dim comes out in global site order (outer*fold_size + inner),
+    matching ``jax.lax.axis_index(axes)``."""
+    if isinstance(axis_name, str):
+        return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+    assert axis == 0 and not tiled, "tuple-axis gather supports leading-dim stacking only"
+    out = x
+    for ax in reversed(tuple(axis_name)):
+        out = jax.lax.all_gather(out, ax, axis=0)
+    return out.reshape((-1,) + x.shape)
 
 
 def site_index(axis_name: str = SITE_AXIS):

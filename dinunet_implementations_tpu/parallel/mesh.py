@@ -21,6 +21,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 SITE_AXIS = "site"
 MODEL_AXIS = "model"
+# vmap axis name for sites folded onto one device (several simulated sites per
+# chip, e.g. 32 sites on 8 chips): the trainer nests a vmap over the local
+# site block inside shard_map, and cross-site collectives run over the
+# (SITE_AXIS, FOLD_AXIS) pair. Never a mesh axis.
+FOLD_AXIS = "site_fold"
 
 
 def make_site_mesh(

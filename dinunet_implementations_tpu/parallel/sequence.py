@@ -117,6 +117,24 @@ def ring_lstm(cell_fn, x_local, h0, c0, axis_name: str = MODEL_AXIS):
     return out, final
 
 
+def reverse_sequence(x_local, axis_name: str = MODEL_AXIS, axis: int = 1):
+    """Time-reverse a sequence that is sharded on ``axis_name``.
+
+    If device i holds chunk i of the global sequence, after this call device i
+    holds chunk i of the *reversed* global sequence: one ``ppermute`` swaps
+    chunk i ↔ chunk n-1-i, and a local flip reverses within the chunk. Used by
+    the ring bidirectional LSTM (the reference's reverse direction runs the
+    cell over ``torch.flip(x, (1,))``, ``comps/icalstm/models.py:60-65``).
+    Self-inverse, and its AD transpose is itself (ppermute + flip are both
+    linear and self-inverse here), so gradients route back to the owning chunk.
+    """
+    n = jax.lax.axis_size(axis_name)
+    swapped = jax.lax.ppermute(
+        x_local, axis_name, [(i, n - 1 - i) for i in range(n)]
+    )
+    return jnp.flip(swapped, axis=axis)
+
+
 def shard_sequence(x, axis_name: str = MODEL_AXIS, axis: int = 1):
     """Split a gathered [B, T, ...] array into this device's chunk."""
     n = jax.lax.axis_size(axis_name)

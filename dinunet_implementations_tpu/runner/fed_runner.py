@@ -101,12 +101,25 @@ class FedRunner:
             import jax
 
             n = len(self.site_dirs)
+            m = max(self.cfg.model_axis_size, 1)
+            k = max(self.cfg.sites_per_device, 1)
+            if n % k:
+                raise ValueError(
+                    f"sites_per_device={k} must divide the site count ({n})"
+                )
+            n_mesh = n // k  # mesh site-axis size; k sites fold per device
             devs = jax.devices()
             cpus = [d for d in devs if d.platform == "cpu"]
-            if len(devs) >= n:
-                mesh = make_site_mesh(n, devs)
-            elif len(cpus) >= n:
-                mesh = host_mesh(n)
+            if len(devs) >= n_mesh * m:
+                mesh = make_site_mesh(n_mesh, devs, model_axis_size=m)
+            elif len(cpus) >= n_mesh * m:
+                mesh = host_mesh(n_mesh, model_axis_size=m)
+            elif m > 1:
+                raise ValueError(
+                    f"model_axis_size={m} with {n_mesh} mesh sites needs "
+                    f"{n_mesh * m} devices (have {len(devs)}); sequence "
+                    "parallelism cannot fold onto one device"
+                )
             else:
                 mesh = None  # fold all sites onto the local device via vmap
         self.mesh = mesh

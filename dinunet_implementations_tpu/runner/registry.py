@@ -14,6 +14,7 @@ from typing import Callable
 
 from ..core.config import NNComputation, TrainConfig
 from ..data.api import DataHandle, SiteDataset
+from ..parallel.mesh import MODEL_AXIS
 from ..data.freesurfer import FreeSurferDataset, FSVDataHandle
 from ..data.ica import ICADataHandle, ICADataset
 from ..data.multimodal import MultimodalDataHandle, MultimodalDataset
@@ -52,6 +53,9 @@ def _build_icalstm(cfg: TrainConfig):
         window_size=a.window_size,
         num_layers=a.num_layers,
         compute_dtype=a.compute_dtype or None,
+        # model_axis_size > 1 → window axis sharded over the mesh model axis
+        # (ring LSTM; parallel/sequence.py)
+        sequence_axis=MODEL_AXIS if cfg.model_axis_size > 1 else None,
     )
 
 
@@ -62,6 +66,7 @@ def _build_smri3d(cfg: TrainConfig):
 
 def _build_multimodal(cfg: TrainConfig):
     a = cfg.multimodal_args
+    attention = a.attention or ("ring" if cfg.model_axis_size > 1 else "local")
     return MultimodalNet(
         fs_input_size=a.fs_input_size,
         num_comps=a.num_components,
@@ -71,6 +76,8 @@ def _build_multimodal(cfg: TrainConfig):
         num_layers=a.num_layers,
         mlp_ratio=a.mlp_ratio,
         num_cls=a.num_class,
+        attention=attention,
+        axis_name=MODEL_AXIS if attention == "ring" else None,
     )
 
 

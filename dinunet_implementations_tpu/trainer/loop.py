@@ -114,6 +114,26 @@ class FederatedTrainer:
                   weights.reshape(-1))
         return m
 
+    def _format_val_line(self, avg, metrics, monitor: str) -> str:
+        """Per-epoch validation readout, columns chosen by ``cfg.log_header``
+        (the reference's log display header, e.g. ``"Loss|AUC"`` —
+        ``local.py:36``, ``compspec.json:256``). Unknown names are skipped;
+        falls back to loss + the monitored metric."""
+        names = [h.strip().lower() for h in (self.cfg.log_header or "").split("|")]
+        parts = []
+        for nm in names:
+            if nm == "loss":
+                parts.append(f"val_loss={avg.avg:.4f}")
+            elif nm:
+                try:
+                    parts.append(f"val_{nm}={metrics.value(nm):.4f}")
+                except (KeyError, ValueError):
+                    pass
+        if not parts:
+            score = metrics.value(monitor) if monitor != "loss" else avg.avg
+            parts = [f"val_loss={avg.avg:.4f}", f"val_{monitor}={score:.4f}"]
+        return " ".join(parts)
+
     def evaluate(self, state, sites, batch_size=None, per_site: bool = False):
         """Pooled (remote-side) metrics across all sites; with
         ``per_site=True`` also returns each site's own (Averages, metrics) —
@@ -256,7 +276,7 @@ class FederatedTrainer:
                 if verbose:
                     print(
                         f"[fold {fold}] epoch {epoch}: train_loss={losses.mean():.4f} "
-                        f"val_loss={val_avg.avg:.4f} val_{monitor}={score:.4f}"
+                        + self._format_val_line(val_avg, val_metrics, monitor)
                         + (" *" if best_epoch == epoch else "")
                     )
                 stop = since_best >= cfg.patience

@@ -35,7 +35,19 @@ from jax.sharding import PartitionSpec as P
 
 from ..engines.base import Engine
 from ..parallel.collectives import site_weight_scale
-from ..parallel.mesh import SITE_AXIS
+from ..parallel.mesh import FOLD_AXIS, MODEL_AXIS, SITE_AXIS
+
+
+def _model_axis_of(mesh) -> str | None:
+    """The bound model/sequence axis name, when the mesh has one of size > 1.
+
+    With a ``(site, model)`` mesh the data stays partitioned over ``site``
+    only — every model-axis member sees the full per-site batch and the model
+    internally shards its sequence axis (models/icalstm.py sequence_axis,
+    models/transformer.py attention="ring")."""
+    if mesh is not None and dict(getattr(mesh, "shape", {})).get(MODEL_AXIS, 1) > 1:
+        return MODEL_AXIS
+    return None
 
 
 @flax.struct.dataclass
@@ -99,7 +111,19 @@ class FederatedTask:
         self.has_batch_stats = has_batch_stats  # resolved at init_variables
 
     def init_variables(self, rng, sample_x):
-        variables = self.model.init(
+        # init runs OUTSIDE shard_map (no mesh axis bound), so a model
+        # configured for sequence parallelism initializes via a dense twin —
+        # submodule names/shapes are identical by construction, only the
+        # collective plumbing differs
+        model = self.model
+        dense_kw = {}
+        if getattr(model, "sequence_axis", None) is not None:
+            dense_kw["sequence_axis"] = None
+        if getattr(model, "attention", None) == "ring":
+            dense_kw.update(attention="local", axis_name=None)
+        if dense_kw:
+            model = model.clone(**dense_kw)
+        variables = model.init(
             {"params": rng, "dropout": rng}, sample_x, train=True
         )
         self.has_batch_stats = "batch_stats" in variables
@@ -167,17 +191,32 @@ def make_train_epoch_fn(
       sites (BASELINE.json north star) at full MXU utilization.
     """
 
+    model_axis = _model_axis_of(mesh)
+
     def loss_fn(params, batch_stats, rng, x, y, w):
         logits, new_stats = task.apply(
             params, batch_stats, x, train=True, rng=rng, mask=w, mutable=True
         )
         loss = cross_entropy(logits, y, w)
+        if model_axis is not None:
+            # The forward runs on every model-axis member (sequence-sharded
+            # inside the model, logits replicated by its final gather), so an
+            # unmasked loss would seed the head cotangent once PER member and
+            # the later grad psum would count head grads n×. Keep member 0's
+            # loss only: its cotangent reaches every member's sequence chunk
+            # through the transposed collectives (reduce-scatter / ppermute),
+            # and the psum over the axis then assembles the exact full grad.
+            keep = (jax.lax.axis_index(model_axis) == 0).astype(loss.dtype)
+            loss = loss * keep
         return loss, new_stats
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
-    def per_site_epoch(state: TrainState, x, y, w):
-        # x: [steps, B, ...] — one site's epoch
+    def per_site_epoch(state: TrainState, x, y, w, site_axes=SITE_AXIS):
+        # x: [steps, B, ...] — one site's epoch. ``site_axes`` is the bound
+        # axis (or (mesh, vmap-fold) axis pair when several sites share one
+        # device) that cross-site collectives reduce over; axis_index over the
+        # pair linearizes to the same global site order as the data layout.
         steps = x.shape[0]
         rounds = steps // local_iterations
         L = rounds * local_iterations
@@ -185,7 +224,7 @@ def make_train_epoch_fn(
         yr = y[:L].reshape((rounds, local_iterations) + y.shape[1:])
         wr = w[:L].reshape((rounds, local_iterations) + w.shape[1:])
 
-        site_ix = jax.lax.axis_index(SITE_AXIS)
+        site_ix = jax.lax.axis_index(site_axes)
 
         def one_round(carry, batch):
             params, batch_stats, opt_state, engine_state, rng, rnd = carry
@@ -198,6 +237,11 @@ def make_train_epoch_fn(
                 xm, ym, wm, i = mb
                 key_i = jax.random.fold_in(jax.random.fold_in(sub, site_ix), i)
                 (loss, new_stats), grads = grad_fn(params, stats, key_i, xm, ym, wm)
+                if model_axis is not None:
+                    # assemble the full gradient (and un-mask the loss scalar)
+                    # from the per-member pieces — see loss_fn
+                    grads = jax.lax.psum(grads, model_axis)
+                    loss = jax.lax.psum(loss, model_axis)
                 n = wm.sum()
                 g_sum = jax.tree.map(lambda a, g: a + g * n, g_sum, grads)
                 return (g_sum, n_sum + n, new_stats), loss * n
@@ -212,19 +256,19 @@ def make_train_epoch_fn(
                 lambda g: g / jnp.maximum(n_sum, 1.0), g_sum
             )
             agg, engine_state = engine.aggregate(
-                site_grad, engine_state, n_sum, SITE_AXIS
+                site_grad, engine_state, n_sum, site_axes
             )
             updates, opt_state = optimizer.update(agg, opt_state, params)
             params = optax.apply_updates(params, updates)
             # sync-BN: example-weighted average of per-site running stats
             if task.has_batch_stats:
-                scale = site_weight_scale(n_sum, SITE_AXIS)
+                scale = site_weight_scale(n_sum, site_axes)
                 new_stats = jax.tree.map(
-                    lambda s: jax.lax.psum(s * scale, SITE_AXIS), new_stats
+                    lambda s: jax.lax.psum(s * scale, site_axes), new_stats
                 )
             # round-weighted global loss (for logs): psum of per-site sums
-            loss_round = jax.lax.psum(loss_sums.sum(), SITE_AXIS) / jnp.maximum(
-                jax.lax.psum(n_sum, SITE_AXIS), 1.0
+            loss_round = jax.lax.psum(loss_sums.sum(), site_axes) / jnp.maximum(
+                jax.lax.psum(n_sum, site_axes), 1.0
             )
             return (params, new_stats, opt_state, engine_state, rng, rnd + 1), loss_round
 
@@ -252,15 +296,24 @@ def make_train_epoch_fn(
     if mesh is not None:
 
         def shard_wrapped(st, x, y, w):
-            # strip the per-shard leading dim; engine_state is site-sharded
-            st = st.replace(
-                engine_state=jax.tree.map(lambda a: a[0], st.engine_state)
-            )
-            new_state, losses = per_site_epoch(st, x[0], y[0], w[0])
-            new_state = new_state.replace(
-                engine_state=jax.tree.map(lambda a: a[None], new_state.engine_state)
-            )
-            return new_state, losses
+            # x: [k, steps, B, ...] — this device's block of k sites. k > 1 is
+            # the folded case (cfg.sites_per_device: more simulated sites than
+            # devices); the block runs as an inner vmap with cross-site
+            # collectives spanning the (mesh site, fold) axis pair. k == 1 is
+            # the one-site-per-device case, same program.
+            new_state, losses = jax.vmap(
+                lambda s_, x_, y_, w_: per_site_epoch(
+                    s_, x_, y_, w_, site_axes=(SITE_AXIS, FOLD_AXIS)
+                ),
+                in_axes=(_state_axes(), 0, 0, 0),
+                out_axes=(0, 0),
+                axis_name=FOLD_AXIS,
+            )(st, x, y, w)
+            # collectives make every site's copy identical — keep block row 0
+            # of everything EXCEPT the per-site engine state
+            collapsed = jax.tree.map(lambda a: a[0], new_state)
+            collapsed = collapsed.replace(engine_state=new_state.engine_state)
+            return collapsed, losses[0]
 
         @jax.jit
         def epoch_fn(state: TrainState, inputs, labels, weights):
@@ -314,9 +367,10 @@ def make_eval_fn(task: FederatedTask, mesh=None):
         @jax.jit
         def eval_fn(state: TrainState, inputs, labels, weights):
             return shard_map(
-                lambda p, s, x, y, w: jax.tree.map(
-                    lambda a: a[None], per_site_eval(p, s, x[0], y[0], w[0])
-                ),
+                # inner vmap over the device's site block (k ≥ 1 folded sites)
+                lambda p, s, x, y, w: jax.vmap(
+                    per_site_eval, in_axes=(None, None, 0, 0, 0)
+                )(p, s, x, y, w),
                 mesh=mesh,
                 in_specs=(
                     jax.tree.map(lambda _: P(), state.params),
