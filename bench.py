@@ -8,12 +8,30 @@ per-site grad, dSGD example-weighted aggregation across the 32 sites, Adam
 update — i.e. what the reference needs a 32-container COINSTAC deployment
 plus a remote to do.
 
+MEASUREMENT METHODOLOGY (important — the axon tunnel is a lazy backend):
+the tunneled PJRT backend evaluates LAZILY PER FETCHED BUFFER. Fetching one
+cheap output (a round counter) materializes only that buffer's dependency
+chain and can skip nearly all of the training compute; block_until_ready
+does not synchronize either. Verified empirically on v5e: fetching
+``state.round`` after an epoch cost ~24 ms while materializing the FULL
+state cost ~570 ms, and a 3 s host sleep did not advance device work (fully
+fetch-driven). Earlier rounds' bench numbers were inflated by this. The
+honest recipe used here:
+
+1. chain N epochs (each consumes the previous state),
+2. materialize EVERY leaf of the final state (np.asarray over the tree) —
+   forcing the entire chain,
+3. report the MARGINAL epoch cost (T(N) - T(1)) / (N - 1), which cancels
+   the per-leaf tunnel round-trip latency (~100 ms/leaf) common to both.
+
 Baseline: the reference's torch ICALstm (loaded from
 /root/reference/comps/icalstm/models.py) doing fwd+bwd+Adam on one CPU site
 measured in this environment = 67.3 samples/sec (B=16, 238 ms/iter; falls back
 to this recorded constant when the live measurement is unavailable).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} (plus an
+``mfu`` field — fraction of v5e bf16 peak sustained by the model's matmul
+FLOPs at the measured throughput).
 """
 
 import json
@@ -27,7 +45,23 @@ CPU_BASELINE_SAMPLES_PER_SEC = 67.3
 NUM_SITES = 32
 BATCH_PER_SITE = 16
 STEPS_PER_EPOCH = 2
-TIMED_EPOCHS = 64  # large so the ~110ms tunnel round-trip amortizes
+TIMED_EPOCHS = 32
+
+# flagship model dims (HCP inputspec, datasets/icalstm/inputspec.json:32-43)
+WINDOWS, COMPS, WLEN = 98, 100, 10
+ENC_IN, ENC_OUT, HIDDEN = COMPS * WLEN, 256, 348
+
+V5E_BF16_PEAK_FLOPS = 197e12
+
+
+def flops_per_sample() -> float:
+    """Matmul FLOPs for one training sample (fwd ≈ enc + biLSTM + head;
+    train ≈ 3× fwd for fwd+bwd)."""
+    h = HIDDEN // 2  # per direction
+    enc = WINDOWS * ENC_IN * ENC_OUT * 2
+    lstm = WINDOWS * 2 * (ENC_OUT * 4 * h + h * 4 * h) * 2  # both directions
+    head = HIDDEN * 256 * 2 + 256 * 64 * 2 + 64 * 2 * 2
+    return 3.0 * (enc + lstm + head)
 
 
 def measure_tpu() -> float:
@@ -44,63 +78,42 @@ def measure_tpu() -> float:
         make_train_epoch_fn,
     )
 
-    # HCP inputspec shape (datasets/icalstm/inputspec.json:32-43); bf16
-    # matmuls AND streamed activations with f32 carries/accumulation
-    # (ops/lstm_pallas.py) — the kernel is HBM-bandwidth-bound, so halving
-    # the streams is the dominant win (37.8k → 74.8k samples/s on v5e)
-    model = ICALstm(input_size=256, hidden_size=348, num_comps=100,
-                    window_size=10, num_cls=2, compute_dtype="bfloat16")
+    # bf16 matmuls AND streamed activations with f32 carries/accumulation;
+    # the fused Pallas kernel keeps W_ih/W_hh resident in VMEM and streams
+    # the raw x once per step (ops/lstm_pallas.py)
+    model = ICALstm(input_size=ENC_OUT, hidden_size=HIDDEN, num_comps=COMPS,
+                    window_size=WLEN, num_cls=2, compute_dtype="bfloat16")
     task = FederatedTask(model)
     engine = make_engine("dSGD")
     opt = make_optimizer("adam", 1e-3)
 
     S, steps, B = NUM_SITES, STEPS_PER_EPOCH, BATCH_PER_SITE
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.normal(size=(S, steps, B, 98, 100, 10)).astype(np.float32))
+    x = jnp.asarray(rng.normal(size=(S, steps, B, WINDOWS, COMPS, WLEN)).astype(np.float32))
     y = jnp.asarray((rng.random((S, steps, B)) > 0.5).astype(np.int32))
     w = jnp.ones((S, steps, B), jnp.float32)
 
-    state = init_train_state(
+    state0 = init_train_state(
         task, engine, opt, jax.random.PRNGKey(0), x[0, 0], num_sites=S
     )
     epoch_fn = make_train_epoch_fn(task, engine, opt, mesh=None, local_iterations=1)
 
-    # warmup/compile (fetch a value — on the tunneled axon backend
-    # block_until_ready alone does not synchronize; only a D2H fetch does)
-    state, losses = epoch_fn(state, x, y, w)
-    float(np.asarray(losses)[0])
+    def run_epochs(n: int) -> float:
+        s = state0
+        t0 = time.time()
+        for _ in range(n):
+            s, _ = epoch_fn(s, x, y, w)
+        # materialize EVERY leaf — the only sync the lazy backend honors
+        jax.tree.map(np.asarray, s)
+        return time.time() - t0
 
-    # estimate the fixed host↔device round-trip so it can be subtracted
-    triv = jax.jit(lambda v: v + 1)
-    float(np.asarray(triv(jnp.zeros(()))))
-    r0 = time.time()
-    for _ in range(3):
-        float(np.asarray(triv(jnp.zeros(()))))
-    rtt = (time.time() - r0) / 3
-
-    # fuse EPOCHS_PER_DISPATCH epochs into one device program so the tunnel's
-    # per-dispatch host overhead (~35ms here) doesn't pollute the chip metric
-    E = 8
-
-    @jax.jit
-    def multi_epoch(st, x, y, w):
-        return jax.lax.fori_loop(
-            0, E, lambda i, s: epoch_fn(s, x, y, w)[0], st
-        )
-
-    state = multi_epoch(state, x, y, w)
-    float(np.asarray(state.round))  # sync after compile
-
-    t0 = time.time()
-    q = max(TIMED_EPOCHS // E, 1)
-    for _ in range(q):
-        state = multi_epoch(state, x, y, w)
-    float(np.asarray(state.round))
-    dt = max(time.time() - t0 - rtt, 1e-6)
-    TIMED = q * E
+    run_epochs(1)  # compile + lazy-runtime warmup
+    t1 = run_epochs(1)
+    tN = run_epochs(TIMED_EPOCHS + 1)
+    dt = max((tN - t1) / TIMED_EPOCHS, 1e-9)
 
     n_chips = 1  # the folded site axis runs on one chip
-    samples = S * steps * B * TIMED
+    samples = S * steps * B
     return samples / dt / n_chips
 
 
@@ -115,12 +128,12 @@ def measure_cpu_baseline() -> float:
     )
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    m = mod.ICALstm(input_size=256, hidden_size=348, bidirectional=True,
-                    num_cls=2, num_comps=100, window_size=10)
+    m = mod.ICALstm(input_size=ENC_OUT, hidden_size=HIDDEN, bidirectional=True,
+                    num_cls=2, num_comps=COMPS, window_size=WLEN)
     opt = torch.optim.Adam(m.parameters(), lr=1e-3)
     crit = torch.nn.CrossEntropyLoss()
     B = 16
-    x = torch.randn(B, 98, 100, 10)
+    x = torch.randn(B, WINDOWS, COMPS, WLEN)
     y = torch.randint(0, 2, (B,))
     for _ in range(2):
         opt.zero_grad(); out, _ = m(x); crit(out, y).backward(); opt.step()
@@ -144,6 +157,7 @@ def main():
         "value": round(value, 2),
         "unit": "samples/sec/chip",
         "vs_baseline": round(value / baseline, 2),
+        "mfu": round(value * flops_per_sample() / V5E_BF16_PEAK_FLOPS, 4),
     }))
 
 

@@ -232,6 +232,10 @@ class TrainConfig:
     # transformer uses ring attention (runner/registry.py wires both). Needs
     # num_sites × model_axis_size devices.
     model_axis_size: int = 1
+    # non-empty → wrap each fit() in jax.profiler.trace(profile_dir) and
+    # write a TensorBoard-compatible device trace there (SURVEY.md §5: the
+    # reference only has wall-clock duration lists; this is the TPU upgrade)
+    profile_dir: str = ""
 
     # -- helpers ---------------------------------------------------------
 

@@ -80,17 +80,6 @@ class LSTMCell(nn.Module):
         b_hh = self.param("b_hh", TorchLinearInit.bias_for(H), (4 * H,))
 
         cdt = jnp.dtype(self.compute_dtype) if self.compute_dtype else None
-        if cdt is not None:
-            # i2h projection for all timesteps in bf16 on the MXU (f32 accum);
-            # the [B, T, 4H] result is stored at bf16 — it is pure streaming
-            # traffic into the recurrence kernel, the largest intermediate of
-            # the model, and XLA fuses the downcast into the matmul epilogue
-            xi = (jnp.dot(
-                x.astype(cdt), w_ih.astype(cdt),
-                preferred_element_type=jnp.float32,
-            ) + (b_ih + b_hh)).astype(cdt)
-        else:
-            xi = x @ w_ih + (b_ih + b_hh)  # [B, T, 4H] — one matmul
         if h0 is None:
             # carry is always f32: the scan body computes an f32 carry (scan
             # requires carry-type invariance) and the kernel keeps f32 carries
@@ -100,9 +89,24 @@ class LSTMCell(nn.Module):
             self.use_pallas if self.use_pallas is not None else _auto_pallas()
         ) and not self.double_sigmoid_gates
         if use_pallas:
-            from ..ops.lstm_pallas import lstm_forward
+            # fused kernel: i2h projection runs in-kernel with W_ih resident
+            # in VMEM — streams x [T, B, D] once instead of a pre-projected
+            # [T, B, 4H] (no XLA-side xi materialization at all)
+            from ..ops.lstm_pallas import lstm_forward_fused
 
-            return lstm_forward(xi, w_hh, h0[0], h0[1], compute_dtype=cdt)
+            return lstm_forward_fused(
+                x, w_ih, b_ih + b_hh, w_hh, h0[0], h0[1], compute_dtype=cdt
+            )
+
+        if cdt is not None:
+            # scan path: hoist the i2h projection for all timesteps into one
+            # bf16 MXU matmul (f32 accum); XLA fuses the downcast epilogue
+            xi = (jnp.dot(
+                x.astype(cdt), w_ih.astype(cdt),
+                preferred_element_type=jnp.float32,
+            ) + (b_ih + b_hh)).astype(cdt)
+        else:
+            xi = x @ w_ih + (b_ih + b_hh)  # [B, T, 4H] — one matmul
 
         def step(carry, xt):
             h, c = carry

@@ -16,14 +16,24 @@ Grid: ``(batch_tiles, T)`` — TPU grids execute sequentially, so VMEM scratch
 carries (h, c) across the T dimension; time-reversed index maps drive the
 backward kernel.
 
-Two measured design points (flagship shape, 32 vmapped sites, v5e):
+Four measured design points (flagship shape, 32 vmapped sites, v5e):
 
+- **The i2h projection is fused into the forward kernel** (round 3): W_ih
+  lives in VMEM beside W_hh and the kernel streams the raw ``x [T, B, D]``
+  once — D=256 inbound values per step-row instead of the 4H=696 of a
+  pre-projected gate layout, and no ``[T, B, 4H]`` XLA materialization at
+  all. dx/dW_ih/db remain XLA einsums over the streamed dpreact cotangents.
 - **dW lives OUTSIDE the kernel.** The weight gradient is the only cross-row
   reduction in BPTT; accumulating it in-kernel forced 4 extra outer-product
   dots per backward step AND made the kernel's outputs non-row-wise. Instead
-  the backward kernel streams out the gate pre-activation cotangents (which
-  are the dxi outputs anyway) and dW is one XLA einsum over the saved hidden
-  sequence — a large, MXU-shaped batched matmul.
+  the backward kernel streams out the gate pre-activation cotangents and dW
+  is one XLA einsum over the saved hidden sequence — a large, MXU-shaped
+  batched matmul.
+- **The backward takes PRE-transposed recurrent weights.** ``w[k].T`` inside
+  the kernel re-ran a lane/sublane transpose on every one of the T grid
+  steps and made the backward ~20× slower than the forward; transposing once
+  in XLA and keeping W_hhᵀ resident removed the entire gap (round 3 — this
+  was the single largest perf bug in the build).
 - **vmap folds into kernel rows, not grid steps.** jax's default vmap rule
   for ``pallas_call`` prepends a grid dimension, which executes
   SEQUENTIALLY on a TPU core — 32 vmapped sites ran as 32 serial passes of
@@ -31,6 +41,10 @@ Two measured design points (flagship shape, 32 vmapped sites, v5e):
   folds the mapped axis into the batch-row dimension instead ([512, H]
   matmuls, full MXU rows), padding rows to the kernel tile as needed. The
   fold is valid because every kernel output is row-wise (see previous point).
+
+The terminal carry (hT, cT) is emitted from the f32 VMEM scratch — never
+quantized to the bf16 streams — because the ring LSTM (parallel/sequence.py)
+relays it across sequence chunks.
 
 Semantics: standard LSTM gates (single sigmoid). The reference's
 double-sigmoid quirk mode stays on the XLA scan path (models/icalstm.py) —
@@ -62,11 +76,18 @@ def _cdt_name(compute_dtype) -> str | None:
 
 
 # ---------------------------------------------------------------------------
-# forward
+# fused forward: the i2h projection runs IN-kernel (W_ih resident in VMEM),
+# so the kernel streams the raw input x [T, B, D] once instead of four
+# pre-projected [T, B, H] gate arrays — D=256 vs 4H=696 inbound values per
+# step-row on the flagship shape, ~2.7× less inbound HBM traffic, and the
+# [B*T, D] @ [D, 4H] XLA matmul plus its [T, B, 4H] HBM materialization
+# disappear entirely (VERDICT r2 #2).
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(xi_i, xi_f, xi_o, xi_g, w, h0, c0, hs, cs, ai, af, ao, ag, h_s, c_s):
+def _fwd_fused_kernel(
+    x, wih, b, whh, h0, c0, hs, cs, ai, af, ao, ag, hT, cT, h_s, c_s
+):
     t = pl.program_id(1)
 
     @pl.when(t == 0)
@@ -74,58 +95,72 @@ def _fwd_kernel(xi_i, xi_f, xi_o, xi_g, w, h0, c0, hs, cs, ai, af, ao, ag, h_s, 
         h_s[:] = h0[:]
         c_s[:] = c0[:]
 
-    h = h_s[:].astype(w.dtype)  # matmul in w's dtype (f32 or bf16), f32 accum
-    # preact_k = xi_k[t] + h @ W_k   (W resident in VMEM, [4, H, H]).
-    # xi streams may be bf16 (halved HBM traffic); gate math is f32 — the
-    # dot's preferred_element_type upcasts, xi upcasts via astype.
     f32 = jnp.float32
-    i = jax.nn.sigmoid(xi_i[0].astype(f32) + jnp.dot(h, w[0], preferred_element_type=f32))
-    f = jax.nn.sigmoid(xi_f[0].astype(f32) + jnp.dot(h, w[1], preferred_element_type=f32))
-    o = jax.nn.sigmoid(xi_o[0].astype(f32) + jnp.dot(h, w[2], preferred_element_type=f32))
-    g = jnp.tanh(xi_g[0].astype(f32) + jnp.dot(h, w[3], preferred_element_type=f32))
+    xt = x[0]  # [bt, D] this step's input block, at stream dtype
+    h = h_s[:].astype(whh.dtype)
+    # preact_k = x_t @ Wih_k + b_k + h @ Whh_k  (both W stacks VMEM-resident)
+    pre = [
+        jnp.dot(xt, wih[k], preferred_element_type=f32)
+        + jnp.dot(h, whh[k], preferred_element_type=f32)
+        + b[k].astype(f32)
+        for k in range(4)
+    ]
+    i = jax.nn.sigmoid(pre[0])
+    f = jax.nn.sigmoid(pre[1])
+    o = jax.nn.sigmoid(pre[2])
+    g = jnp.tanh(pre[3])
     c = f * c_s[:] + i * g
     h = o * jnp.tanh(c)
-    h_s[:] = h          # carries stay f32 in VMEM across the whole sequence
+    h_s[:] = h
     c_s[:] = c
-    hs[0] = h.astype(hs.dtype)   # streamed outputs may be bf16
+    hs[0] = h.astype(hs.dtype)
     cs[0] = c.astype(cs.dtype)
     ai[0] = i.astype(ai.dtype)
     af[0] = f.astype(af.dtype)
     ao[0] = o.astype(ao.dtype)
     ag[0] = g.astype(ag.dtype)
 
+    # terminal carry at FULL f32 (straight from VMEM scratch, not the possibly
+    # bf16 hs/cs streams): the ring-LSTM relays this carry between sequence
+    # chunks, and quantizing it at each chunk boundary would silently diverge
+    # the sharded run from the dense one (review finding, round 3)
+    @pl.when(t == pl.num_programs(1) - 1)
+    def _():
+        hT[:] = h_s[:]
+        cT[:] = c_s[:]
 
-def _fwd_call(xi4, w4, h0, c0, compute_dtype=None):
-    T, B, H = xi4[0].shape
+
+def _fwd_fused_call(x, wih4, b4, whh4, h0, c0, compute_dtype=None):
+    T, B, D = x.shape
+    H = wih4.shape[-1]
     bt = min(B_TILE, B)
     assert B % bt == 0, (
         f"batch {B} must be a multiple of the kernel tile {bt}; "
-        "use lstm_forward(), which pads"
+        "use lstm_forward_fused(), which pads"
     )
     if compute_dtype is not None:
-        # mixed precision: matmuls AND the streamed [T, B, H] arrays (the
-        # kernel's bandwidth bottleneck) run at compute_dtype; the recurrence
-        # carries and all accumulation stay f32 in VMEM
-        w4 = w4.astype(compute_dtype)
-        xi4 = tuple(a.astype(compute_dtype) for a in xi4)
+        x = x.astype(compute_dtype)
+        wih4 = wih4.astype(compute_dtype)
+        whh4 = whh4.astype(compute_dtype)
     grid = (B // bt, T)
-    t_block = lambda b, t: (t, b, 0)
-    b_block = lambda b, t: (b, 0)
-    spec_t = pl.BlockSpec((1, bt, H), t_block, memory_space=pltpu.VMEM)
-    spec_b = pl.BlockSpec((bt, H), b_block, memory_space=pltpu.VMEM)
-    spec_w = pl.BlockSpec((4, H, H), lambda b, t: (0, 0, 0), memory_space=pltpu.VMEM)
+    spec_x = pl.BlockSpec((1, bt, D), lambda b, t: (t, b, 0), memory_space=pltpu.VMEM)
+    spec_t = pl.BlockSpec((1, bt, H), lambda b, t: (t, b, 0), memory_space=pltpu.VMEM)
+    spec_b = pl.BlockSpec((bt, H), lambda b, t: (b, 0), memory_space=pltpu.VMEM)
+    spec_wih = pl.BlockSpec((4, D, H), lambda b, t: (0, 0, 0), memory_space=pltpu.VMEM)
+    spec_whh = pl.BlockSpec((4, H, H), lambda b, t: (0, 0, 0), memory_space=pltpu.VMEM)
+    spec_bias = pl.BlockSpec((4, H), lambda b, t: (0, 0), memory_space=pltpu.VMEM)
     stream_dtype = jnp.dtype(compute_dtype) if compute_dtype is not None else jnp.float32
     out_shape = jax.ShapeDtypeStruct((T, B, H), stream_dtype)
-    outs = pl.pallas_call(
-        _fwd_kernel,
+    carry_shape = jax.ShapeDtypeStruct((B, H), jnp.float32)
+    return pl.pallas_call(
+        _fwd_fused_kernel,
         grid=grid,
-        in_specs=[spec_t] * 4 + [spec_w, spec_b, spec_b],
-        out_specs=[spec_t] * 6,
-        out_shape=[out_shape] * 6,
+        in_specs=[spec_x, spec_wih, spec_bias, spec_whh, spec_b, spec_b],
+        out_specs=[spec_t] * 6 + [spec_b] * 2,
+        out_shape=[out_shape] * 6 + [carry_shape] * 2,
         scratch_shapes=[pltpu.VMEM((bt, H), jnp.float32)] * 2,
         interpret=_interpret(),
-    )(*xi4, w4, h0, c0)
-    return outs  # hs, cs, i, f, o, g
+    )(x, wih4, b4, whh4, h0, c0)
 
 
 # ---------------------------------------------------------------------------
@@ -135,7 +170,7 @@ def _fwd_call(xi4, w4, h0, c0, compute_dtype=None):
 
 def _bwd_kernel(
     T_total,
-    ai, af, ao, ag, cs, cs_prev, w, c0, dhs, dhT, dcT,
+    ai, af, ao, ag, cs, cs_prev, wT, c0, dhs, dhT, dcT,
     dxi_i, dxi_f, dxi_o, dxi_g, dh0, dc0,
     dh_s, dc_s,
 ):
@@ -174,13 +209,17 @@ def _bwd_kernel(
     dxi_o[0] = dpo.astype(dxi_o.dtype)
     dxi_g[0] = dpg.astype(dxi_g.dtype)
 
-    # dh_{t-1} = Σ_k dp_k @ W_kᵀ  (matmuls in w's dtype, f32 accumulation)
-    cdt = w.dtype
+    # dh_{t-1} = Σ_k dp_k @ W_kᵀ (matmuls in w's dtype, f32 accumulation).
+    # wT holds the PRE-transposed weights: transposing inside the kernel
+    # (w[k].T) re-ran a lane/sublane transpose on every one of the T grid
+    # steps and dominated the whole backward pass — measured ~20× slower
+    # than this resident-transpose layout on v5e.
+    cdt = wT.dtype
     dh_prev = (
-        jnp.dot(dpi.astype(cdt), w[0].T, preferred_element_type=jnp.float32)
-        + jnp.dot(dpf.astype(cdt), w[1].T, preferred_element_type=jnp.float32)
-        + jnp.dot(dpo.astype(cdt), w[2].T, preferred_element_type=jnp.float32)
-        + jnp.dot(dpg.astype(cdt), w[3].T, preferred_element_type=jnp.float32)
+        jnp.dot(dpi.astype(cdt), wT[0], preferred_element_type=jnp.float32)
+        + jnp.dot(dpf.astype(cdt), wT[1], preferred_element_type=jnp.float32)
+        + jnp.dot(dpo.astype(cdt), wT[2], preferred_element_type=jnp.float32)
+        + jnp.dot(dpg.astype(cdt), wT[3], preferred_element_type=jnp.float32)
     )
 
     dh_s[:] = dh_prev
@@ -198,6 +237,7 @@ def _bwd_call(acts, cs, w4, c0, dhs, dhT, dcT, compute_dtype=None):
     assert B % bt == 0, f"batch {B} must be a multiple of the kernel tile {bt}"
     if compute_dtype is not None:
         w4 = w4.astype(compute_dtype)
+    w4T = jnp.swapaxes(w4, 1, 2)  # transpose ONCE in XLA, resident in VMEM
     grid = (B // bt, T)
 
     rev = lambda b, t: (T - 1 - t, b, 0)
@@ -223,7 +263,7 @@ def _bwd_call(acts, cs, w4, c0, dhs, dhT, dcT, compute_dtype=None):
         out_shape=[t_shape] * 4 + [b_shape, b_shape],
         scratch_shapes=[pltpu.VMEM((bt, H), jnp.float32)] * 2,
         interpret=_interpret(),
-    )(*acts, cs, cs, w4, c0, dhs, dhT, dcT)
+    )(*acts, cs, cs, w4T, c0, dhs, dhT, dcT)
     return outs  # dxi_i, dxi_f, dxi_o, dxi_g, dh0, dc0
 
 
@@ -266,34 +306,32 @@ def _pad_rows(arrs, rows, axis):
 
 
 @functools.lru_cache(maxsize=None)
-def _fwd_callable(cdt_name: str | None):
+def _fwd_fused_callable(cdt_name: str | None):
     cdt = jnp.dtype(cdt_name) if cdt_name else None
 
     @custom_vmap
-    def f(xi_i, xi_f, xi_o, xi_g, w4, h0, c0):
-        return tuple(_fwd_call((xi_i, xi_f, xi_o, xi_g), w4, h0, c0, cdt))
+    def f(x, wih4, b4, whh4, h0, c0):
+        return tuple(_fwd_fused_call(x, wih4, b4, whh4, h0, c0, cdt))
 
     @f.def_vmap
     def _rule(axis_size, in_batched, *args):
-        if in_batched[4]:  # per-element recurrent weights: cannot fold rows
+        if any(in_batched[k] for k in (1, 2, 3)):  # per-element weights
             batched = _broadcast_unbatched(args, in_batched, axis_size)
             outs = jax.lax.map(lambda a: f(*a), tuple(batched))
-            return tuple(outs), (True,) * 6
+            return tuple(outs), (True,) * 8
         S = axis_size
         batched = _broadcast_unbatched(
-            args, [b or i == 4 for i, b in enumerate(in_batched)], S
+            args, [b or i in (1, 2, 3) for i, b in enumerate(in_batched)], S
         )
-        xi4 = [_fold_rows(a) for a in batched[:4]]
-        w4 = args[4]
-        B = batched[5].shape[1]
-        h0 = batched[5].reshape(S * B, -1)
-        c0 = batched[6].reshape(S * B, -1)
-        (xi4_0, xi4_1, xi4_2, xi4_3, h0, c0), rows_p = _pad_rows(
-            [*xi4, h0, c0], S * B, axis=-2
-        )
-        outs = f(xi4_0, xi4_1, xi4_2, xi4_3, w4, h0, c0)
-        outs = [_unfold_rows(o[:, : S * B], S, B) for o in outs]
-        return tuple(outs), (True,) * 6
+        x = _fold_rows(batched[0])  # [S, T, B, D] → [T, S*B, D]
+        B = batched[4].shape[1]
+        h0 = batched[4].reshape(S * B, -1)
+        c0 = batched[5].reshape(S * B, -1)
+        (x, h0, c0), _ = _pad_rows([x, h0, c0], S * B, axis=-2)
+        outs = f(x, args[1], args[2], args[3], h0, c0)
+        t_outs = [_unfold_rows(o[:, : S * B], S, B) for o in outs[:6]]
+        b_outs = [o[: S * B].reshape(S, B, -1) for o in outs[6:]]
+        return tuple(t_outs + b_outs), (True,) * 8
 
     return f
 
@@ -336,103 +374,101 @@ def _bwd_callable(cdt_name: str | None):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
-def lstm_recurrence(xi4, w4, h0, c0, compute_dtype=None):
-    """Run the LSTM time recurrence.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
+def lstm_recurrence_fused(x, wih4, b4, whh4, h0, c0, compute_dtype=None):
+    """Fused LSTM: i2h projection + recurrence in ONE kernel pass.
 
     Args:
-      xi4: tuple of four ``[T, B, H]`` input-projection arrays (i, f, o, g
-        pre-activations, i.e. ``x_t @ W_ih + b`` split per gate).
-      w4: ``[4, H, H]`` recurrent weights (i, f, o, g order).
-      h0, c0: ``[B, H]`` initial carry.
-      compute_dtype: matmul operand dtype (e.g. ``jnp.bfloat16``) with f32
-        accumulation; ``None`` = full f32 (the parity mode).
+      x: ``[T, B, D]`` raw per-step inputs (at compute_dtype or f32).
+      wih4: ``[4, D, H]`` f32 input-projection weights (i, f, o, g).
+      b4: ``[4, H]`` f32 combined bias (``b_ih + b_hh`` per gate).
+      whh4: ``[4, H, H]`` f32 recurrent weights.
+      h0, c0: ``[B, H]`` f32 initial carry.
 
-    Returns: ``(hs [T, B, H], (hT, cT))``.
+    Returns ``(hs [T, B, H], (hT, cT))`` — the terminal carry is always f32
+    (written straight from the kernel's f32 VMEM scratch, never quantized to
+    the stream dtype; the ring LSTM relays it between chunks). The backward
+    runs the BPTT kernel (dxi ≡ dpreact); dx / dW_ih / db / dW_hh are
+    MXU-shaped XLA einsums over the streamed cotangents.
     """
-    hs, cs, *_ = _fwd_callable(_cdt_name(compute_dtype))(*xi4, w4, h0, c0)
-    return hs, (hs[-1], cs[-1])
+    hs, cs, i, f, o, g, hT, cT = _fwd_fused_callable(_cdt_name(compute_dtype))(
+        x, wih4, b4, whh4, h0, c0
+    )
+    return hs, (hT, cT)
 
 
-def _vjp_fwd(xi4, w4, h0, c0, compute_dtype):
-    hs, cs, i, f, o, g = _fwd_callable(_cdt_name(compute_dtype))(*xi4, w4, h0, c0)
-    # xi4 is NOT needed by the backward (dxi == dpreact); don't pin it. Only
-    # its dtype rides along (as a zero-size array — residuals must be JAX
-    # types) so the dxi cotangents can be cast back to the primal dtype (a
-    # direct caller may pass f32 xi with bf16 compute_dtype; custom_vjp
-    # requires cotangent avals to match the primal avals exactly)
-    xi_proto = jnp.zeros((0,), xi4[0].dtype)
-    return (hs, (hs[-1], cs[-1])), (xi_proto, w4, h0, c0, hs, cs, (i, f, o, g))
+def _vjp_fused_fwd(x, wih4, b4, whh4, h0, c0, compute_dtype):
+    hs, cs, i, f, o, g, hT, cT = _fwd_fused_callable(_cdt_name(compute_dtype))(
+        x, wih4, b4, whh4, h0, c0
+    )
+    return (hs, (hT, cT)), (x, wih4, whh4, h0, c0, hs, cs, (i, f, o, g))
 
 
-def _vjp_bwd(compute_dtype, res, grads):
-    xi_proto, w4, h0, c0, hs, cs, acts = res
-    xi_dtype = xi_proto.dtype
+def _vjp_fused_bwd(compute_dtype, res, grads):
+    x, wih4, whh4, h0, c0, hs, cs, acts = res
     dhs, (dhT, dcT) = grads
     cdt_name = _cdt_name(compute_dtype)
-    dxi_i, dxi_f, dxi_o, dxi_g, dh0, dc0 = _bwd_callable(cdt_name)(
-        *acts, cs, w4, c0, dhs, dhT, dcT
+    dp_i, dp_f, dp_o, dp_g, dh0, dc0 = _bwd_callable(cdt_name)(
+        *acts, cs, whh4, c0, dhs, dhT, dcT
     )
-    # dW_k = Σ_t h_{t-1}ᵀ dp_k — the only cross-row reduction of BPTT, done
-    # here as one MXU-shaped einsum over the saved hidden sequence instead of
-    # per-step outer products inside the kernel (batches cleanly under vmap)
-    h_prev = jnp.concatenate([h0[None].astype(hs.dtype), hs[:-1]], 0)  # [T, B, H]
-    cdt = jnp.dtype(cdt_name) if cdt_name else h_prev.dtype
-    hp = h_prev.astype(cdt)
-    dw = jnp.stack(
-        [
-            jnp.einsum(
-                "tbh,tbg->hg", hp, dp.astype(cdt),
-                preferred_element_type=jnp.float32,
-            )
-            for dp in (dxi_i, dxi_f, dxi_o, dxi_g)
-        ]
+    cdt = jnp.dtype(cdt_name) if cdt_name else x.dtype
+    dp4 = jnp.stack([dp_i, dp_f, dp_o, dp_g])  # [4, T, B, H] at stream dtype
+    # dx = Σ_k dp_k @ Wih_kᵀ; dW_ih = Σ_t x_tᵀ dp_k; db = Σ_{t,b} dp_k
+    dx = jnp.einsum(
+        "ktbh,kdh->tbd", dp4.astype(cdt), wih4.astype(cdt),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    dwih = jnp.einsum(
+        "tbd,ktbh->kdh", x.astype(cdt), dp4.astype(cdt),
+        preferred_element_type=jnp.float32,
     )
-    dxi = tuple(d.astype(xi_dtype) for d in (dxi_i, dxi_f, dxi_o, dxi_g))
-    return dxi, dw, dh0, dc0
+    db = dp4.astype(jnp.float32).sum(axis=(1, 2))
+    h_prev = jnp.concatenate([h0[None].astype(hs.dtype), hs[:-1]], 0)
+    dwhh = jnp.einsum(
+        "tbh,ktbg->khg", h_prev.astype(cdt), dp4.astype(cdt),
+        preferred_element_type=jnp.float32,
+    )
+    return dx, dwih, db, dwhh, dh0, dc0
 
 
-lstm_recurrence.defvjp(_vjp_fwd, _vjp_bwd)
+lstm_recurrence_fused.defvjp(_vjp_fused_fwd, _vjp_fused_bwd)
 
 
-def lstm_forward(xi, w_hh, h0, c0, compute_dtype=None):
-    """Convenience wrapper over :func:`lstm_recurrence` in model layout.
+def lstm_forward_fused(x, w_ih, b, w_hh, h0, c0, compute_dtype=None):
+    """Model-layout convenience wrapper over :func:`lstm_recurrence_fused`.
 
     Args:
-      xi: ``[B, T, 4H]`` pre-computed input projections (i|f|o|g blocks —
-        the LSTMCell layout, ``x @ W_ih + b_ih + b_hh``).
-      w_hh: ``[H, 4H]`` recurrent weight in the same blocked layout.
+      x: ``[B, T, D]`` raw inputs (the encoder output — no pre-projection).
+      w_ih: ``[D, 4H]`` blocked input projection, b: ``[4H]`` combined bias,
+      w_hh: ``[H, 4H]`` blocked recurrent weight (LSTMCell layout).
       h0, c0: ``[B, H]``.
-      compute_dtype: matmul dtype for the recurrence (f32 accumulation);
-        ``None`` = f32 (parity mode).
 
-    Returns ``(hs [B, T, H], (hT, cT))``. Pads the batch to the kernel tile
-    and slices it back off. NOTE on lane alignment: zero-padding the hidden
-    width 174 → 256 was tried and MEASURED as an ~11% LOSS on v5e (37.8k →
-    33.7k samples/s) — the kernel is bound by streaming the [T, B, H] blocks
-    from HBM, and padding inflates that traffic 47% while Mosaic's ragged
-    lane-edge masking was already cheap. Hence H is deliberately unpadded.
+    Returns ``(hs [B, T, H] at x's dtype, (hT, cT) at f32)`` — the carry
+    contract is "always f32" (matches the scan path; the ring LSTM relays it
+    between chunks). Pads the batch to the kernel tile.
     """
-    B, T, H4 = xi.shape
-    H = H4 // 4
-    in_dtype = xi.dtype
-    # the kernel accumulates in f32 (scratch/accumulators); the streamed xi
-    # stays at compute_dtype (its cotangent dxi comes back at the same dtype)
-    xi = xi.astype(compute_dtype if compute_dtype is not None else jnp.float32)
+    B, T, D = x.shape
+    H = w_hh.shape[0]
+    in_dtype = x.dtype
+    x = x.astype(compute_dtype if compute_dtype is not None else jnp.float32)
+    w_ih = w_ih.astype(jnp.float32)
     w_hh = w_hh.astype(jnp.float32)
+    b = b.astype(jnp.float32)
     h0 = h0.astype(jnp.float32)
     c0 = c0.astype(jnp.float32)
     bt = min(B_TILE, B)
     pad = (-B) % bt
     if pad:
-        xi = jnp.concatenate([xi, jnp.zeros((pad, T, H4), xi.dtype)], 0)
+        x = jnp.concatenate([x, jnp.zeros((pad, T, D), x.dtype)], 0)
         h0 = jnp.concatenate([h0, jnp.zeros((pad, H), h0.dtype)], 0)
         c0 = jnp.concatenate([c0, jnp.zeros((pad, H), c0.dtype)], 0)
-    xi_t = jnp.swapaxes(xi, 0, 1)  # [T, B, 4H]
-    xi4 = tuple(xi_t[..., k * H : (k + 1) * H] for k in range(4))
-    w4 = jnp.stack([w_hh[:, k * H : (k + 1) * H] for k in range(4)])
-    hs, (hT, cT) = lstm_recurrence(xi4, w4, h0, c0, compute_dtype)
+    x_t = jnp.swapaxes(x, 0, 1)  # [T, B, D]
+    wih4 = jnp.stack([w_ih[:, k * H : (k + 1) * H] for k in range(4)])
+    b4 = jnp.stack([b[k * H : (k + 1) * H] for k in range(4)])
+    whh4 = jnp.stack([w_hh[:, k * H : (k + 1) * H] for k in range(4)])
+    hs, (hT, cT) = lstm_recurrence_fused(x_t, wih4, b4, whh4, h0, c0, compute_dtype)
     hs = jnp.swapaxes(hs, 0, 1)
     if pad:
         hs, hT, cT = hs[:B], hT[:B], cT[:B]
-    return hs.astype(in_dtype), (hT.astype(in_dtype), cT.astype(in_dtype))
+    return hs.astype(in_dtype), (hT, cT)
+

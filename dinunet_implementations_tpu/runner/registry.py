@@ -67,6 +67,13 @@ def _build_smri3d(cfg: TrainConfig):
 def _build_multimodal(cfg: TrainConfig):
     a = cfg.multimodal_args
     attention = a.attention or ("ring" if cfg.model_axis_size > 1 else "local")
+    if attention == "ring" and cfg.model_axis_size < 2:
+        # forced ring without a model axis would crash much later with an
+        # opaque "unbound axis name" trace error on the vmap-folded path
+        raise ValueError(
+            'attention="ring" needs model_axis_size >= 2 (the token axis '
+            "shards over the mesh model axis)"
+        )
     return MultimodalNet(
         fs_input_size=a.fs_input_size,
         num_comps=a.num_components,

@@ -244,62 +244,72 @@ class FederatedTrainer:
         monitor = cfg.monitor_metric
         direction = cfg.metric_direction
 
+        # opt-in device trace (SURVEY.md §5): TensorBoard-compatible profile
+        # of the whole epoch loop, one trace per fold
+        if cfg.profile_dir:
+            jax.profiler.start_trace(
+                os.path.join(cfg.profile_dir, f"fold_{fold}")
+            )
         stop_epoch = cfg.epochs
-        for epoch in range(start_epoch, cfg.epochs + 1):
-            e_start = time.time()
-            state, losses = self.run_epoch(state, train_sites, epoch)
-            epoch_losses.append(float(losses.mean()))
-            # per-iteration durations (reference local_iter_duration is
-            # per-round, NB.ipynb cells 34-36). All rounds of an epoch run in
-            # ONE fused XLA dispatch here, so per-round host timing does not
-            # exist; the truthful equivalent is the epoch time amortized over
-            # its rounds.
-            rounds = max(len(losses), 1)
-            iter_durations.extend([(time.time() - e_start) / rounds] * rounds)
+        try:
+            for epoch in range(start_epoch, cfg.epochs + 1):
+                e_start = time.time()
+                state, losses = self.run_epoch(state, train_sites, epoch)
+                epoch_losses.append(float(losses.mean()))
+                # per-iteration durations (reference local_iter_duration is
+                # per-round, NB.ipynb cells 34-36). All rounds of an epoch run in
+                # ONE fused XLA dispatch here, so per-round host timing does not
+                # exist; the truthful equivalent is the epoch time amortized over
+                # its rounds.
+                rounds = max(len(losses), 1)
+                iter_durations.extend([(time.time() - e_start) / rounds] * rounds)
 
-            if epoch % cfg.validation_epochs == 0:
-                val_avg, val_metrics = self.evaluate(state, val_sites)
-                score = val_metrics.value(monitor) if monitor != "loss" else val_avg.avg
-                if is_improvement(
-                    score, best_metric, direction if monitor != "loss" else "minimize"
-                ):
-                    best_metric, best_epoch, best_state = score, epoch, state
-                    since_best = 0
-                    if best_path:  # save-on-best during training
+                if epoch % cfg.validation_epochs == 0:
+                    val_avg, val_metrics = self.evaluate(state, val_sites)
+                    score = val_metrics.value(monitor) if monitor != "loss" else val_avg.avg
+                    if is_improvement(
+                        score, best_metric, direction if monitor != "loss" else "minimize"
+                    ):
+                        best_metric, best_epoch, best_state = score, epoch, state
+                        since_best = 0
+                        if best_path:  # save-on-best during training
+                            save_checkpoint(
+                                best_path, best_state,
+                                meta={"best_val_epoch": best_epoch,
+                                      "best_val_metric": best_metric, "fold": fold},
+                            )
+                    else:
+                        since_best += cfg.validation_epochs
+                    if verbose:
+                        print(
+                            f"[fold {fold}] epoch {epoch}: train_loss={losses.mean():.4f} "
+                            + self._format_val_line(val_avg, val_metrics, monitor)
+                            + (" *" if best_epoch == epoch else "")
+                        )
+                    stop = since_best >= cfg.patience
+                    if latest_path:  # resume point at each validation boundary
                         save_checkpoint(
-                            best_path, best_state,
-                            meta={"best_val_epoch": best_epoch,
-                                  "best_val_metric": best_metric, "fold": fold},
+                            latest_path, state,
+                            meta={"epoch": epoch, "best_val_epoch": best_epoch,
+                                  "best_val_metric": best_metric,
+                                  "since_best": since_best, "fold": fold,
+                                  "epoch_losses": epoch_losses,
+                                  "iter_durations": iter_durations,
+                                  "time_spent_on_computation": self._cache.get(
+                                      "time_spent_on_computation", []),
+                                  "cumulative_total_duration": self._cache.get(
+                                      "cumulative_total_duration", [])},
                         )
                 else:
-                    since_best += cfg.validation_epochs
-                if verbose:
-                    print(
-                        f"[fold {fold}] epoch {epoch}: train_loss={losses.mean():.4f} "
-                        + self._format_val_line(val_avg, val_metrics, monitor)
-                        + (" *" if best_epoch == epoch else "")
-                    )
-                stop = since_best >= cfg.patience
-                if latest_path:  # resume point at each validation boundary
-                    save_checkpoint(
-                        latest_path, state,
-                        meta={"epoch": epoch, "best_val_epoch": best_epoch,
-                              "best_val_metric": best_metric,
-                              "since_best": since_best, "fold": fold,
-                              "epoch_losses": epoch_losses,
-                              "iter_durations": iter_durations,
-                              "time_spent_on_computation": self._cache.get(
-                                  "time_spent_on_computation", []),
-                              "cumulative_total_duration": self._cache.get(
-                                  "cumulative_total_duration", [])},
-                    )
-            else:
-                stop = False
-            duration(self._cache, e_start, "time_spent_on_computation")
-            duration(self._cache, t_start, "cumulative_total_duration")
-            if stop:
-                stop_epoch = epoch
-                break
+                    stop = False
+                duration(self._cache, e_start, "time_spent_on_computation")
+                duration(self._cache, t_start, "cumulative_total_duration")
+                if stop:
+                    stop_epoch = epoch
+                    break
+        finally:
+            if cfg.profile_dir:
+                jax.profiler.stop_trace()
 
         # If the epoch count never hit a validation boundary (epochs <
         # validation_epochs), best_state would be the untrained init — run a

@@ -7,8 +7,6 @@ run against the one-site-per-device run and the all-on-one-device vmap run —
 all three must produce identical training (SGD, so the assert is tight).
 """
 
-import dataclasses
-
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -7,7 +7,6 @@ import numpy as np
 import pytest
 
 from dinunet_implementations_tpu.models.icalstm import ICALstm, LSTMCell
-from dinunet_implementations_tpu.ops.lstm_pallas import lstm_forward
 
 
 def _params(key, D, H):
@@ -168,11 +167,11 @@ def test_lstm_recurrence_rejects_indivisible_batch():
     old = lstm_pallas.B_TILE
     lstm_pallas.B_TILE = 8
     try:
-        H = 4
-        xi4 = tuple(jnp.ones((3, 12, H)) for _ in range(4))
+        D, H = 5, 4
         with pytest.raises(AssertionError, match="multiple of the kernel tile"):
-            lstm_pallas.lstm_recurrence(
-                xi4, jnp.ones((4, H, H)), jnp.ones((12, H)), jnp.ones((12, H))
+            lstm_pallas.lstm_recurrence_fused(
+                jnp.ones((3, 12, D)), jnp.ones((4, D, H)), jnp.ones((4, H)),
+                jnp.ones((4, H, H)), jnp.ones((12, H)), jnp.ones((12, H)),
             )
     finally:
         lstm_pallas.B_TILE = old
@@ -220,25 +219,48 @@ def test_scan_path_bf16_carry_types():
     )
 
 
-def test_lstm_recurrence_direct_f32_xi_bf16_compute_grad():
-    """ADVICE r2 regression: a direct lstm_recurrence call with f32 xi4 and
-    compute_dtype='bfloat16' must return f32 dxi cotangents (custom_vjp
-    requires cotangent avals to match the primal avals)."""
-    from dinunet_implementations_tpu.ops.lstm_pallas import lstm_recurrence
+def test_lstm_recurrence_direct_f32_x_bf16_compute_grad():
+    """ADVICE r2 regression (dtype-contract class): a direct
+    lstm_recurrence_fused call with f32 x and compute_dtype='bfloat16' must
+    return an f32 dx cotangent (custom_vjp requires cotangent avals to match
+    the primal avals)."""
+    from dinunet_implementations_tpu.ops.lstm_pallas import lstm_recurrence_fused
 
-    B, T, H = 4, 5, 8
+    B, T, D, H = 4, 5, 6, 8
     key = jax.random.PRNGKey(9)
-    xi4 = tuple(
-        jax.random.normal(jax.random.fold_in(key, k), (T, B, H)) for k in range(4)
-    )
-    w4 = jax.random.normal(key, (4, H, H)) * 0.2
+    x = jax.random.normal(key, (T, B, D))
+    wih4 = jax.random.normal(key, (4, D, H)) * 0.2
+    b4 = jnp.zeros((4, H))
+    whh4 = jax.random.normal(key, (4, H, H)) * 0.2
     h0 = jnp.zeros((B, H))
     c0 = jnp.zeros((B, H))
 
-    def loss(xi4):
-        hs, _ = lstm_recurrence(xi4, w4, h0, c0, jnp.bfloat16)
-        return jnp.sum(hs.astype(jnp.float32) ** 2)
+    def loss(x):
+        hs, (hT, cT) = lstm_recurrence_fused(x, wih4, b4, whh4, h0, c0, jnp.bfloat16)
+        return jnp.sum(hs.astype(jnp.float32) ** 2) + jnp.sum(hT + cT)
 
-    g = jax.grad(loss)(xi4)
-    assert all(x.dtype == jnp.float32 for x in g)
-    assert all(np.isfinite(np.asarray(x)).all() for x in g)
+    g = jax.grad(loss)(x)
+    assert g.dtype == jnp.float32
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_fused_terminal_carry_is_f32_even_under_bf16():
+    """Ring-relay contract: (hT, cT) come from the kernel's f32 scratch, not
+    the bf16 streams — so chunk-boundary relays never quantize the carry."""
+    from dinunet_implementations_tpu.ops.lstm_pallas import lstm_forward_fused
+
+    B, T, D, H = 4, 6, 5, 8
+    key = jax.random.PRNGKey(10)
+    x = jax.random.normal(key, (B, T, D)).astype(jnp.bfloat16)
+    p = _params(key, D, H)
+    hs, (hT, cT) = lstm_forward_fused(
+        x, p["w_ih"], p["b_ih"] + p["b_hh"], p["w_hh"],
+        jnp.zeros((B, H)), jnp.zeros((B, H)), compute_dtype=jnp.bfloat16,
+    )
+    assert hs.dtype == jnp.bfloat16
+    assert hT.dtype == jnp.float32 and cT.dtype == jnp.float32
+    # and the f32 carry is strictly more precise than the bf16 stream's last
+    # step: they agree to bf16 resolution
+    np.testing.assert_allclose(
+        np.asarray(hs[:, -1].astype(jnp.float32)), np.asarray(hT), atol=0.01
+    )

@@ -16,14 +16,12 @@ import numpy as np
 import pytest
 
 from dinunet_implementations_tpu.core.config import TrainConfig
-from dinunet_implementations_tpu.data.api import SiteArrays
 from dinunet_implementations_tpu.engines import make_engine
 from dinunet_implementations_tpu.models import ICALstm, MultimodalNet
 from dinunet_implementations_tpu.parallel.mesh import MODEL_AXIS, host_mesh
 from dinunet_implementations_tpu.runner.registry import get_task
 from dinunet_implementations_tpu.trainer import (
     FederatedTask,
-    FederatedTrainer,
     init_train_state,
     make_optimizer,
     make_train_epoch_fn,
@@ -206,6 +204,35 @@ def test_multimodal_ring_grads_match_local():
             np.asarray(a), np.asarray(b), atol=1e-5
         ),
         g_local, g_ring,
+    )
+
+
+def test_ica_ring_bf16_pallas_tracks_dense():
+    """Review-finding regression (r3): ring + compute_dtype=bf16 + the fused
+    kernel — the relayed carry must stay f32 at chunk boundaries, so the
+    sharded forward tracks the dense forward within bf16 tolerance."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    rng = np.random.default_rng(11)
+    dense = ICALstm(
+        input_size=12, hidden_size=10, num_comps=3, window_size=4, num_cls=2,
+        compute_dtype="bfloat16", use_pallas=True,
+    )
+    ring = dense.clone(sequence_axis=MODEL_AXIS)
+    x = jnp.asarray(rng.normal(size=(4, 8, 3, 4)).astype(np.float32))
+    variables = dense.clone(use_pallas=False, compute_dtype=None).init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        x, train=False,
+    )
+    out_dense = dense.apply(variables, x, train=False)
+    mesh = host_mesh(1, model_axis_size=2)
+    out_ring = shard_map(
+        lambda v, xx: ring.apply(v, xx, train=False),
+        mesh=mesh, in_specs=(P(), P()), out_specs=P(), check_vma=False,
+    )(variables, x)
+    np.testing.assert_allclose(
+        np.asarray(out_ring), np.asarray(out_dense), atol=0.05
     )
 
 
