@@ -54,6 +54,23 @@ ENC_IN, ENC_OUT, HIDDEN = COMPS * WLEN, 256, 348
 V5E_BF16_PEAK_FLOPS = 197e12
 
 
+def chain_epochs(epoch_fn, state0, x, y, w, n: int) -> float:
+    """Run ``n`` chained epochs from ``state0`` and FULLY materialize the
+    final state (np.asarray over every leaf) — the only synchronization the
+    lazy tunneled backend honors. Returns wall-clock seconds. This is the
+    shared measurement primitive for bench.py and bench_matrix.py; any
+    methodology fix belongs here, once."""
+    import jax
+    import numpy as np
+
+    s = state0
+    t0 = time.time()
+    for _ in range(n):
+        s, _ = epoch_fn(s, x, y, w)
+    jax.tree.map(np.asarray, s)
+    return time.time() - t0
+
+
 def flops_per_sample() -> float:
     """Matmul FLOPs for one training sample (fwd ≈ enc + biLSTM + head;
     train ≈ 3× fwd for fwd+bwd)."""
@@ -98,18 +115,9 @@ def measure_tpu() -> float:
     )
     epoch_fn = make_train_epoch_fn(task, engine, opt, mesh=None, local_iterations=1)
 
-    def run_epochs(n: int) -> float:
-        s = state0
-        t0 = time.time()
-        for _ in range(n):
-            s, _ = epoch_fn(s, x, y, w)
-        # materialize EVERY leaf — the only sync the lazy backend honors
-        jax.tree.map(np.asarray, s)
-        return time.time() - t0
-
-    run_epochs(1)  # compile + lazy-runtime warmup
-    t1 = run_epochs(1)
-    tN = run_epochs(TIMED_EPOCHS + 1)
+    chain_epochs(epoch_fn, state0, x, y, w, 1)  # compile + lazy-runtime warmup
+    t1 = chain_epochs(epoch_fn, state0, x, y, w, 1)
+    tN = chain_epochs(epoch_fn, state0, x, y, w, TIMED_EPOCHS + 1)
     dt = max((tN - t1) / TIMED_EPOCHS, 1e-9)
 
     n_chips = 1  # the folded site axis runs on one chip

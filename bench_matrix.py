@@ -18,11 +18,12 @@ Usage: python bench_matrix.py [--epochs N]
 
 import json
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from bench import chain_epochs
 
 from dinunet_implementations_tpu.engines import make_engine
 from dinunet_implementations_tpu.models import (
@@ -59,12 +60,7 @@ def measure(name, model, x_shape, sites, engine_name, batch, engine_kw=None,
     epoch_fn = make_train_epoch_fn(task, engine, opt, mesh=None, local_iterations=1)
 
     def run(n):
-        s = state0
-        t0 = time.time()
-        for _ in range(n):
-            s, _ = epoch_fn(s, x, y, w)
-        jax.tree.map(np.asarray, s)  # full materialization (lazy backend)
-        return time.time() - t0
+        return chain_epochs(epoch_fn, state0, x, y, w, n)
 
     run(1)
     # adaptive: grow N until the marginal compute dominates the ~0.1 s

@@ -1,0 +1,136 @@
+"""Analysis tooling: the reference's k-fold pretrain convergence study.
+
+Reference ``NB.ipynb`` cells 6-17 compare 10-fold FS-classification trained
+from scratch vs warm-started by pretraining on the largest site
+(``compspec.json:120-127``), reading per-fold ``logs.json`` /
+``test_metrics.csv`` and reporting the mean early-stop epoch (68.5 scratch
+vs 42.7 pretrained in the reference's published run) plus accuracy/F1
+boxplot data. This module reproduces that study in-repo against OUR outputs
+— including re-reading the ``logs.json`` files the runner wrote, which keeps
+the notebook-compatible log schema honest.
+
+Usage::
+
+    from dinunet_implementations_tpu.analysis import pretrain_study
+    report = pretrain_study("datasets/test_fsl", "out/study", num_folds=10)
+    print(report["summary_markdown"])
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+
+from .core.config import PretrainArgs, TrainConfig
+from .runner.fed_runner import FedRunner
+from .trainer.logs import fold_dir
+
+
+def _read_fold_logs(out_dir: str, task_id: str, num_folds: int) -> list[dict]:
+    logs = []
+    for k in range(num_folds):
+        path = os.path.join(fold_dir(out_dir, "remote", task_id, k), "logs.json")
+        with open(path) as fh:
+            logs.append(json.load(fh))
+    return logs
+
+
+def _arm_stats(logs: list[dict]) -> dict:
+    epochs = [lg["best_val_epoch"] for lg in logs]
+    aucs = [lg["test_metrics"][0][1] for lg in logs]
+    losses = [lg["test_metrics"][0][0] for lg in logs]
+    n = max(len(logs), 1)
+    return {
+        "folds": len(logs),
+        "best_val_epochs": epochs,
+        "test_aucs": aucs,
+        "test_losses": losses,
+        "mean_best_val_epoch": sum(epochs) / n,
+        "mean_test_auc": sum(aucs) / n,
+        "mean_test_loss": sum(losses) / n,
+    }
+
+
+def pretrain_study(
+    data_path: str,
+    out_dir: str,
+    num_folds: int = 10,
+    pretrain_epochs: int = 20,
+    base_cfg: TrainConfig | None = None,
+    folds: list[int] | None = None,
+    verbose: bool = False,
+) -> dict:
+    """Run both study arms and report convergence statistics.
+
+    Returns a dict with per-arm stats, the epoch speedup, and a rendered
+    ``summary_markdown``; also writes ``pretrain_study.md`` and
+    ``pretrain_study.csv`` under ``out_dir``.
+    """
+    cfg = base_cfg or TrainConfig(
+        agg_engine="dSGD", epochs=101, patience=35, seed=0
+    )
+    cfg = cfg.replace(num_folds=num_folds)
+    arms = {
+        "scratch": cfg.replace(pretrain=False),
+        "pretrained": cfg.replace(
+            pretrain=True,
+            pretrain_args=PretrainArgs(epochs=pretrain_epochs),
+        ),
+    }
+    report: dict = {"arms": {}}
+    for name, arm_cfg in arms.items():
+        arm_out = os.path.join(out_dir, name)
+        runner = FedRunner(arm_cfg, data_path=data_path, out_dir=arm_out)
+        results = runner.run(folds=folds, verbose=verbose)
+        # the reference study reads logs.json back — do the same, which also
+        # regression-checks the on-disk schema against live results
+        logs = _read_fold_logs(arm_out, runner.cfg.task_id, len(results))
+        stats = _arm_stats(logs)
+        for lg, res in zip(logs, results):
+            assert lg["best_val_epoch"] == res["best_val_epoch"], (
+                "logs.json disagrees with the in-memory result"
+            )
+        report["arms"][name] = stats
+
+    s, p = report["arms"]["scratch"], report["arms"]["pretrained"]
+    report["epoch_speedup"] = (
+        s["mean_best_val_epoch"] / p["mean_best_val_epoch"]
+        if p["mean_best_val_epoch"]
+        else float("inf")
+    )
+    report["reference"] = {
+        "mean_stop_epoch_scratch": 68.5,  # NB.ipynb cell 12
+        "mean_stop_epoch_pretrained": 42.7,  # NB.ipynb cell 14
+    }
+    lines = [
+        "# Pretrain convergence study",
+        "",
+        f"Dataset: `{data_path}` — {s['folds']} folds, "
+        f"pretrain_epochs={pretrain_epochs}",
+        "",
+        "| arm | mean best_val_epoch | mean test AUC | mean test loss |",
+        "|---|---|---|---|",
+        f"| scratch | {s['mean_best_val_epoch']:.1f} | "
+        f"{s['mean_test_auc']:.4f} | {s['mean_test_loss']:.4f} |",
+        f"| pretrained | {p['mean_best_val_epoch']:.1f} | "
+        f"{p['mean_test_auc']:.4f} | {p['mean_test_loss']:.4f} |",
+        "",
+        f"Convergence speedup (scratch/pretrained epochs): "
+        f"**{report['epoch_speedup']:.2f}×** — the reference's 10-fold study "
+        "reports 68.5 vs 42.7 (1.60×, NB.ipynb cells 12-14).",
+    ]
+    report["summary_markdown"] = "\n".join(lines)
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "pretrain_study.md"), "w") as fh:
+        fh.write(report["summary_markdown"] + "\n")
+    with open(os.path.join(out_dir, "pretrain_study.csv"), "w", newline="") as fh:
+        wr = csv.writer(fh)
+        wr.writerow(["arm", "fold", "best_val_epoch", "test_auc", "test_loss"])
+        for name, stats in report["arms"].items():
+            rows = zip(
+                stats["best_val_epochs"], stats["test_aucs"], stats["test_losses"]
+            )
+            for k, (ep, auc, loss) in enumerate(rows):
+                wr.writerow([name, k, ep, auc, loss])
+    return report

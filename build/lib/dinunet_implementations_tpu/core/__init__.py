@@ -1,0 +1,13 @@
+from .config import (
+    AggEngine,
+    FSArgs,
+    ICAArgs,
+    MultimodalArgs,
+    NNComputation,
+    PretrainArgs,
+    SMRI3DArgs,
+    TrainConfig,
+    export_compspec,
+    load_inputspec,
+    resolve_site_configs,
+)

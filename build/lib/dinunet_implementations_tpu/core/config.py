@@ -200,10 +200,7 @@ class TrainConfig:
     pretrain: bool = False
     pretrain_args: PretrainArgs | None = None
     validation_epochs: int = 1
-    # payload dtype for gradient exchange: "32" | "16" (bf16 — the TPU-native
-    # 16-bit type) | "16-ieee" (the reference's literal fp16, compat mode —
-    # compspec.json:161-176)
-    precision_bits: str = "32"
+    precision_bits: str = "32"  # payload dtype for gradient exchange: "32" | "16"
     pin_memory: bool = False  # torch DataLoader parity no-op
     num_workers: int = 0  # torch DataLoader parity no-op
     patience: int = 35
@@ -411,9 +408,7 @@ COMPSPEC_META: dict[str, dict] = {
                               conditional=dict(variable="mode", value="train"),
                               label="Run validation after every epochs:"),
     "precision_bits": dict(type="select", source="owner", group="NN Params", order=14,
-                           # "16" = bf16 on TPU; "16-ieee" = the reference's
-                           # literal fp16 payload (compat)
-                           values=["32", "16", "16-ieee"],
+                           values=["32", "16"],
                            conditional=dict(variable="mode", value="train"),
                            label="Floating point precision for payload:"),
     "pin_memory": dict(type="boolean", source="member", group="NN Params", order=15,

@@ -1,0 +1,5 @@
+from .api import DataHandle, SiteArrays, SiteDataset, build_site_dataset
+from .batching import FedBatches, plan_epoch, plan_eval
+from .freesurfer import FreeSurferDataset, FSVDataHandle, coerce_label, read_aseg_stats
+from .ica import ICADataHandle, ICADataset, load_timecourses, window_timecourses
+from .splits import kfold_splits, load_split_file, resolve_splits, split_by_ratio

@@ -1,0 +1,115 @@
+"""Dataset / data-handle abstraction surface.
+
+Keeps the reference's framework contract (SURVEY.md §2.3: ``COINNDataset`` with
+``cache``/``state``/``indices``/``path()`` + hooks ``load_index`` /
+``_load_indices`` / ``__getitem__``; ``COINNDataHandle`` with ``list_files``)
+so reference workloads port 1:1 — but adds the TPU-first path: every dataset
+can **materialize** to dense numpy arrays once (:class:`SiteArrays`), which the
+trainer stacks across sites and ships to the mesh. The reference re-reads files
+per item per epoch (``comps/fs/__init__.py:33-39``); we pay I/O once.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class SiteArrays:
+    """One site's full dataset as dense arrays (the unit of SPMD feeding)."""
+
+    inputs: np.ndarray  # [n, ...] float32
+    labels: np.ndarray  # [n] int32
+    indices: np.ndarray  # [n] int32 — position in the site's sample inventory
+
+    def __len__(self):
+        return len(self.labels)
+
+    def take(self, ix) -> "SiteArrays":
+        ix = np.asarray(ix)
+        return SiteArrays(self.inputs[ix], self.labels[ix], self.indices[ix])
+
+
+class SiteDataset:
+    """Base dataset (capability parity with ``COINNDataset``, reconstructed
+    from call sites — see SURVEY.md §2.3).
+
+    Parameters
+    ----------
+    cache: dict-like task configuration (the reference's flat cache dict; here
+        usually ``dataclasses.asdict`` of a task-args block merged with the
+        train config).
+    state: dict with at least ``baseDirectory`` — the site's data root
+        (reference ``comps/fs/__init__.py:19``).
+    mode: 'train' | 'test' (parity field).
+    """
+
+    def __init__(self, cache=None, state=None, mode: str = "train", **kw):
+        self.cache = dict(cache or {})
+        self.state = dict(state or {})
+        self.mode = mode
+        self.indices: list = []
+
+    # -- reference API ---------------------------------------------------
+
+    def path(self, cache_key: str = "data_file") -> str:
+        """Resolve a cache key to a path under the site's base directory
+        (reference ``comps/fs/__init__.py:35``, ``comps/icalstm/__init__.py:27``).
+        With no/empty cache value, returns the base directory itself."""
+        base = self.state.get("baseDirectory", "")
+        name = self.cache.get(cache_key) or ""
+        return os.path.join(base, name) if name else base
+
+    def load_index(self, file):
+        """Register one inventory entry. Subclasses override (reference hook)."""
+        self.indices.append(file)
+
+    def _load_indices(self, files, **kw):
+        """Bulk variant (reference hook, ``comps/icalstm/__init__.py:26``)."""
+        for f in files:
+            self.load_index(f)
+
+    def __getitem__(self, ix) -> dict:
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.indices)
+
+    # -- TPU-first API ---------------------------------------------------
+
+    def as_arrays(self) -> SiteArrays:
+        """Materialize the whole site to dense arrays. Default implementation
+        stacks ``__getitem__`` outputs; subclasses override with a vectorized
+        loader when they can."""
+        items = [self[i] for i in range(len(self))]
+        inputs = np.stack([np.asarray(it["inputs"], np.float32) for it in items])
+        labels = np.asarray([int(it["labels"]) for it in items], np.int32)
+        ixs = np.asarray([int(it.get("ix", i)) for i, it in enumerate(items)], np.int32)
+        return SiteArrays(inputs, labels, ixs)
+
+
+class DataHandle:
+    """Base data handle (capability parity with ``COINNDataHandle``): defines a
+    site's sample inventory via ``list_files`` (reference
+    ``comps/fs/__init__.py:66-71``, ``comps/icalstm/__init__.py:73-77``)."""
+
+    def __init__(self, cache=None, state=None, **kw):
+        self.cache = dict(cache or {})
+        self.state = dict(state or {})
+
+    def list_files(self) -> list:
+        raise NotImplementedError
+
+
+def build_site_dataset(
+    dataset_cls, handle_cls, cache: dict, state: dict, mode: str = "train"
+) -> SiteDataset:
+    """Wire a (Dataset, DataHandle) pair the way ``COINNLocal`` does on the
+    first round (SURVEY.md §3.2): handle.list_files → dataset._load_indices."""
+    handle = handle_cls(cache=cache, state=state)
+    ds = dataset_cls(cache=cache, state=state, mode=mode)
+    ds._load_indices(handle.list_files())
+    return ds

@@ -1,0 +1,115 @@
+"""SPMD batch planning: sites × steps × batch dense arrays with masks.
+
+The reference hides heterogeneous site sizes (73–120 subjects in the FS
+fixture) behind round-based orchestration: every round each site pulls
+``local_iterations`` batches from its own cycling DataLoader with
+``drop_last=True`` for train (``local.py:29``). In one SPMD program all sites
+must take the same number of steps per epoch, so we make the step grid dense:
+
+- ``inputs  [S, steps, B, ...]``
+- ``labels  [S, steps, B]``
+- ``weights [S, steps, B]`` — 1.0 for real examples, 0.0 for padding; the
+  trainer weighs per-site gradients by ``weights.sum()`` so aggregation is
+  exactly example-weighted (dSGD == pooled SGD invariant).
+
+``pad_mode``:
+- ``"wrap"`` (train default): sites with fewer batches than the epoch's
+  ``steps`` recycle their shuffled data — every site contributes every round,
+  like the reference's cycling DataLoader.
+- ``"mask"`` (eval): padding gets weight 0; no sample is seen twice (AUC /
+  metric correctness).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .api import SiteArrays
+
+
+@dataclass
+class FedBatches:
+    inputs: np.ndarray  # [S, steps, B, ...]
+    labels: np.ndarray  # [S, steps, B]
+    weights: np.ndarray  # [S, steps, B] float32
+    indices: np.ndarray  # [S, steps, B] int32 (position in site inventory; -1 pad)
+
+    @property
+    def num_sites(self):
+        return self.inputs.shape[0]
+
+    @property
+    def steps(self):
+        return self.inputs.shape[1]
+
+    @property
+    def batch_size(self):
+        return self.inputs.shape[2]
+
+
+def _site_batches(arr: SiteArrays, batch_size: int, order: np.ndarray, drop_last: bool):
+    """Chunk one site's (ordered) samples into batches; returns list of index
+    arrays, each of length ``batch_size`` except possibly the last."""
+    n = len(order)
+    if drop_last:
+        n = (n // batch_size) * batch_size
+    return [order[i : i + batch_size] for i in range(0, n, batch_size)]
+
+
+def plan_epoch(
+    sites: list[SiteArrays],
+    batch_size: int,
+    seed: int = 0,
+    shuffle: bool = True,
+    drop_last: bool = True,
+    pad_mode: str = "wrap",
+) -> FedBatches:
+    """Build the dense [S, steps, B, ...] epoch plan (see module docstring)."""
+    assert pad_mode in ("wrap", "mask")
+    S = len(sites)
+    feat_shape = None
+    for s in sites:
+        if len(s):
+            fs = s.inputs.shape[1:]
+            assert feat_shape is None or fs == feat_shape, "heterogeneous feature shapes"
+            feat_shape = fs
+    assert feat_shape is not None, "all sites empty"
+
+    rng = np.random.default_rng(seed)
+    per_site: list[list[np.ndarray]] = []
+    for s in sites:
+        order = rng.permutation(len(s)) if shuffle else np.arange(len(s))
+        per_site.append(_site_batches(s, batch_size, order, drop_last))
+
+    steps = max(len(b) for b in per_site)
+    assert steps > 0, f"no site yields a batch (batch_size={batch_size}, drop_last={drop_last})"
+
+    inputs = np.zeros((S, steps, batch_size) + feat_shape, np.float32)
+    labels = np.zeros((S, steps, batch_size), np.int32)
+    weights = np.zeros((S, steps, batch_size), np.float32)
+    indices = np.full((S, steps, batch_size), -1, np.int32)
+
+    for si, (site, batches) in enumerate(zip(sites, per_site)):
+        if pad_mode == "wrap" and batches:
+            while len(batches) < steps:  # recycle with a fresh shuffle
+                order = rng.permutation(len(site)) if shuffle else np.arange(len(site))
+                batches = batches + _site_batches(site, batch_size, order, drop_last)
+            batches = batches[:steps]
+        for bi, ix in enumerate(batches):
+            k = len(ix)
+            sel = site.take(ix)
+            inputs[si, bi, :k] = sel.inputs
+            labels[si, bi, :k] = sel.labels
+            weights[si, bi, :k] = 1.0
+            indices[si, bi, :k] = sel.indices
+
+    return FedBatches(inputs, labels, weights, indices)
+
+
+def plan_eval(sites: list[SiteArrays], batch_size: int) -> FedBatches:
+    """Deterministic full pass: no shuffle, no drop, mask padding."""
+    return plan_epoch(
+        sites, batch_size, shuffle=False, drop_last=False, pad_mode="mask"
+    )

@@ -1,0 +1,119 @@
+"""FreeSurfer aseg-volume dataset.
+
+Reference semantics (``comps/fs/__init__.py:11-39``, ``comps/fs/__init__.py:66-71``):
+
+- the site inventory is the index column of the covariate CSV
+  (``labels_file``; indexed by ``data_column`` when present);
+- labels come from ``labels_column``; string labels coerce via
+  ``int(y.strip().lower() == 'true')``; ints/bools cast to int (the reference
+  comments that raw int64 wasn't JSON-serializable — irrelevant here but the
+  coercion is kept);
+- each sample file is a tab-separated table ``name\\tvalue`` with one header
+  row (skipped); the feature vector is **normalized by its own max**
+  (``df / df.max()`` on a single-column frame = divide the subject's 66
+  volumes by that subject's largest volume).
+
+TPU-first difference: ``as_arrays`` reads every file once into a dense
+``[n, input_size]`` float32 matrix instead of re-reading TSVs per item per
+epoch (reference hot-path pathology, SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+
+import numpy as np
+
+from .api import DataHandle, SiteArrays, SiteDataset
+
+
+def _read_covariates(path: str, data_column: str | None):
+    """Read the covariate CSV into (index list, {index → row dict})."""
+    with open(path, newline="") as fh:
+        rows = list(csv.DictReader(fh))
+    if not rows:
+        return [], {}
+    cols = rows[0].keys()
+    key = data_column if data_column in cols else next(iter(cols))
+    index = [r[key] for r in rows]
+    return index, {r[key]: r for r in rows}
+
+
+def coerce_label(y, bug_compatible: bool = False) -> int:
+    """Reference label coercion (``comps/fs/__init__.py:25-31``).
+
+    DOCUMENTED DEVIATION: the reference maps *every* string through
+    ``int(y.strip().lower() == 'true')`` — so the string ``"1"`` becomes 0
+    there. Here numeric strings parse numerically (``"1"`` → 1), which is
+    strictly safer for CSVs exported with 0/1 labels; only the literal
+    true/false strings use the boolean rule. Pass ``bug_compatible=True``
+    (FSArgs.bug_compatible_labels) to reproduce the reference bit-for-bit.
+    """
+    if isinstance(y, str):
+        low = y.strip().lower()
+        if bug_compatible:
+            return int(low == "true")
+        if low in ("true", "false"):
+            return int(low == "true")
+        return int(float(y))
+    return int(y)
+
+
+def read_aseg_stats(path: str) -> np.ndarray:
+    """Read one aseg-stats TSV → max-normalized float32 feature vector."""
+    vals = []
+    with open(path) as fh:
+        next(fh)  # header row (reference: skiprows=1)
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            vals.append(float(line.split("\t")[1]))
+    x = np.asarray(vals, np.float64)
+    x = x / x.max()
+    return x.astype(np.float32)
+
+
+class FreeSurferDataset(SiteDataset):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.labels = None  # {file → row dict}, lazy like the reference
+
+    def _ensure_labels(self):
+        if self.labels is None:
+            path = os.path.join(
+                self.state["baseDirectory"], self.cache["labels_file"]
+            )
+            _, self.labels = _read_covariates(path, self.cache.get("data_column"))
+
+    def load_index(self, file):
+        self._ensure_labels()
+        y = self.labels[file][self.cache["labels_column"]]
+        self.indices.append(
+            [file, coerce_label(y, self.cache.get("bug_compatible_labels", False))]
+        )
+
+    def __getitem__(self, ix) -> dict:
+        file, y = self.indices[ix]
+        x = read_aseg_stats(os.path.join(self.path(), file))
+        return {"inputs": x, "labels": y, "ix": ix}
+
+    def as_arrays(self) -> SiteArrays:
+        n = len(self.indices)
+        feats = [read_aseg_stats(os.path.join(self.path(), f)) for f, _ in self.indices]
+        return SiteArrays(
+            np.stack(feats) if n else np.zeros((0, 0), np.float32),
+            np.asarray([y for _, y in self.indices], np.int32),
+            np.arange(n, dtype=np.int32),
+        )
+
+
+class FSVDataHandle(DataHandle):
+    """Site inventory = covariate CSV index column
+    (reference ``comps/fs/__init__.py:66-71``)."""
+
+    def list_files(self) -> list:
+        path = os.path.join(self.state["baseDirectory"], self.cache["labels_file"])
+        index, _ = _read_covariates(path, self.cache.get("data_column"))
+        return index

@@ -1,0 +1,80 @@
+"""Multimodal FS+ICA dataset — TPU-build extension.
+
+Joins the two reference modalities per subject: the 66 FreeSurfer aseg volumes
+(data/freesurfer.py semantics) and the windowed ICA timecourses
+(data/ica.py semantics). The two are **packed into one flat float vector**
+``[fs_input_size + S*C*W]`` so the standard single-array site-batch pipeline
+(data/batching.py) applies unchanged; ``MultimodalNet`` unpacks by static
+offsets (models/transformer.py).
+
+Site layout: one directory holding the FS covariate CSV + aseg files AND the
+ICA ``data_file``/``labels_file``; subjects are joined positionally (row i of
+the covariate CSV ↔ data_index of labels row i).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .api import DataHandle, SiteArrays, SiteDataset
+from .freesurfer import _read_covariates, coerce_label, read_aseg_stats
+from .ica import load_timecourses, window_timecourses
+
+
+class MultimodalDataset(SiteDataset):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.fs_feats = None
+        self.ica_windows = None
+
+    def _load_indices(self, files, **kw):
+        base = self.state["baseDirectory"]
+        # FS side
+        cov_path = os.path.join(base, self.cache["labels_file"])
+        index, rows = _read_covariates(cov_path, self.cache.get("data_column"))
+        labels_col = self.cache["labels_column"]
+        # ICA side
+        tc = load_timecourses(self.path(cache_key="data_file"))
+        self.ica_windows = window_timecourses(
+            tc,
+            self.cache["temporal_size"],
+            self.cache["window_size"],
+            self.cache["window_stride"],
+        ).astype(np.float32)
+        n = min(len(index), len(self.ica_windows))
+        self.fs_feats = np.stack(
+            [read_aseg_stats(os.path.join(base, f)) for f in index[:n]]
+        )
+        self.indices += [
+            [i, coerce_label(rows[index[i]][labels_col])] for i in range(n)
+        ]
+
+    def __getitem__(self, ix) -> dict:
+        i, y = self.indices[ix]
+        packed = np.concatenate(
+            [self.fs_feats[int(i)], self.ica_windows[int(i)].reshape(-1)]
+        )
+        return {"inputs": packed, "labels": int(y), "ix": ix}
+
+    def as_arrays(self) -> SiteArrays:
+        rows = np.asarray([int(i) for i, _ in self.indices])
+        packed = np.concatenate(
+            [self.fs_feats[rows], self.ica_windows[rows].reshape(len(rows), -1)],
+            axis=1,
+        )
+        return SiteArrays(
+            packed.astype(np.float32),
+            np.asarray([int(y) for _, y in self.indices], np.int32),
+            np.arange(len(rows), dtype=np.int32),
+        )
+
+
+class MultimodalDataHandle(DataHandle):
+    """Inventory = covariate CSV index (FS convention)."""
+
+    def list_files(self) -> list:
+        path = os.path.join(self.state["baseDirectory"], self.cache["labels_file"])
+        index, _ = _read_covariates(path, self.cache.get("data_column"))
+        return index

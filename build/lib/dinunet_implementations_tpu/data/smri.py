@@ -1,0 +1,42 @@
+"""Structural-MRI (T1w volume) dataset — TPU-build extension.
+
+Follows the ICA dataset's fixture convention (data/ica.py): a numpy archive of
+volumes ``[N, D, H, W]`` named by ``data_file`` plus a ``labels_file`` CSV of
+``[index, label]`` rows; no reference implementation exists (BASELINE.json
+configs list the 3D-CNN sMRI federated classifier as a target workload).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .api import SiteArrays, SiteDataset
+from .ica import ICADataHandle, load_timecourses
+
+
+class SMRIDataset(SiteDataset):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.data = None
+
+    def _load_indices(self, files, **kw):
+        self.data = np.asarray(
+            load_timecourses(self.path(cache_key="data_file")), np.float32
+        )
+        self.indices += [list(f) for f in files]
+
+    def __getitem__(self, ix) -> dict:
+        data_index, y = self.indices[ix]
+        return {"inputs": self.data[int(data_index)], "labels": int(y), "ix": ix}
+
+    def as_arrays(self) -> SiteArrays:
+        rows = np.asarray([int(i) for i, _ in self.indices])
+        return SiteArrays(
+            self.data[rows],
+            np.asarray([int(y) for _, y in self.indices], np.int32),
+            np.arange(len(rows), dtype=np.int32),
+        )
+
+
+class SMRIDataHandle(ICADataHandle):
+    """Same ``[index, label]`` CSV inventory as the ICA handle."""

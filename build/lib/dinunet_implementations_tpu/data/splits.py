@@ -1,0 +1,90 @@
+"""Train/val/test splitting: ratio-based and k-fold.
+
+Capability parity with the reference library's split machinery as exercised by
+call sites: ``split_ratio=[0.8,0.1,0.1]`` / ``[0.7,0.15,0.15]``
+(``local.py:34``, ``compspec.json:205-215``), ``num_folds`` k-fold CV
+(``compspec.json:217-224``, 10-fold study in ``NB.ipynb``), and predefined
+``split_files`` (``compspec.json:249,263``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+SPLIT_KEYS = ("train", "validation", "test")
+
+
+def split_by_ratio(n: int, ratio, seed: int = 0) -> dict:
+    """Shuffle ``n`` samples and split by ``ratio`` (train, val, test).
+
+    Sizes: train/val floor to ``int(n*r)``; test takes the remainder so every
+    sample lands somewhere.
+    """
+    ratio = list(ratio)
+    test_share = len(ratio) > 2 and ratio[2] > 0
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n)
+    n_train = int(n * ratio[0])
+    # with no test share, flooring remainders go to validation, not test
+    n_val = (n - n_train) if not test_share else int(n * ratio[1])
+    return {
+        "train": np.sort(perm[:n_train]),
+        "validation": np.sort(perm[n_train : n_train + n_val]),
+        "test": np.sort(perm[n_train + n_val :]),
+    }
+
+
+def kfold_splits(n: int, k: int, seed: int = 0) -> list[dict]:
+    """K-fold CV (k ≥ 2): fold ``i`` is the test set, fold ``(i+1) % k`` is
+    validation, the rest train. With k == 2 there is no fold left for
+    validation, so validation is empty and the other fold is train. (Design
+    choice documented; the reference library's exact val-fold rule is internal
+    to coinstac-dinunet.)"""
+    if k < 2:
+        raise ValueError(f"num_folds must be >= 2, got {k}")
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n)
+    folds = np.array_split(perm, k)
+    out = []
+    for i in range(k):
+        test = folds[i]
+        if k == 2:
+            val = np.array([], int)
+            train = folds[(i + 1) % k]
+        else:
+            val_j = (i + 1) % k
+            val = folds[val_j]
+            train = np.concatenate([folds[j] for j in range(k) if j not in (i, val_j)])
+        out.append(
+            {"train": np.sort(train), "validation": np.sort(val), "test": np.sort(test)}
+        )
+    return out
+
+
+def load_split_file(path: str) -> dict:
+    """Load a predefined split JSON: {"train": [...], "validation": [...],
+    "test": [...]} — entries may be inventory positions or file names."""
+    with open(path) as fh:
+        spec = json.load(fh)
+    return {k: list(spec.get(k, [])) for k in SPLIT_KEYS}
+
+
+def resolve_splits(
+    n: int,
+    split_ratio=None,
+    num_folds: int | None = None,
+    split_files=(),
+    base_dir: str = "",
+    seed: int = 0,
+) -> list[dict]:
+    """One-stop resolution mirroring config precedence: ``split_files`` (if
+    given) > ``num_folds`` k-fold > ``split_ratio``. Returns a list of folds
+    (length 1 unless k-fold/multiple files)."""
+    if split_files:
+        return [load_split_file(os.path.join(base_dir, f)) for f in split_files]
+    if num_folds:
+        return kfold_splits(n, int(num_folds), seed)
+    return [split_by_ratio(n, split_ratio or (0.8, 0.1, 0.1), seed)]

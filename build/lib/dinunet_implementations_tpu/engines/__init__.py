@@ -1,0 +1,3 @@
+from .base import Engine, available_engines, make_engine
+from . import dsgd, powersgd, rankdad  # noqa: F401 — register engines
+from .lowrank import is_compressible, orthonormalize, subspace_iteration, to_matrix

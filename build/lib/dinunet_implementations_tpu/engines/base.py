@@ -1,0 +1,61 @@
+"""Aggregation-engine interface.
+
+The reference selects an engine by enum (``comps/__init__.py:13-16``) and runs
+it inside the remote aggregator across ``num_reducers`` worker processes
+(``remote.py:20-21,37``). Here an engine is a pair of pure functions used
+*inside* the SPMD train step:
+
+- ``init(grads) -> state`` — per-site engine state pytree (zeros; lives in
+  the training state alongside optimizer state);
+- ``aggregate(grads, state, weight, axis_name) -> (agg_grads, new_state)`` —
+  maps per-site gradients to the globally-aggregated gradient via collectives
+  over the ``site`` mesh axis. ``weight`` is the site's example count for this
+  round (heterogeneous sites), so dSGD == pooled SGD.
+
+Engines must be shape/dtype-preserving on the gradient pytree and jit-safe
+(static control flow only).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+from ..core.config import AggEngine
+
+
+@dataclass(frozen=True)
+class Engine:
+    name: str
+    init: Callable  # grads -> state
+    aggregate: Callable  # (grads, state, weight, axis_name) -> (agg, state)
+
+
+_REGISTRY: dict[str, Callable] = {}
+
+
+def register_engine(name: str):
+    def deco(factory):
+        _REGISTRY[name] = factory
+        return factory
+
+    return deco
+
+
+def make_engine(name: str, **cfg) -> Engine:
+    """Build an engine by registry name (``dSGD`` | ``rankDAD`` | ``powerSGD``).
+
+    ``cfg`` carries the DAD knobs from the task args
+    (``dad_reduction_rank``, ``dad_num_pow_iters``, ``dad_tol`` —
+    ``compspec.json:236-238``) and ``precision_bits``.
+    """
+    if name not in _REGISTRY:
+        raise ValueError(f"Unknown agg engine: {name!r} (have {sorted(_REGISTRY)})")
+    return _REGISTRY[name](**cfg)
+
+
+def available_engines():
+    return sorted(_REGISTRY)
+
+
+assert set(AggEngine.ALL) == {"dSGD", "rankDAD", "powerSGD"}

@@ -1,0 +1,27 @@
+"""dSGD — decentralized SGD: plain (example-weighted) gradient averaging.
+
+Reference: ``AggEngine.DECENTRALIZED_SGD`` (``comps/__init__.py:14``), the
+default engine (``compspec.json:57``). The remote averages the sites' full
+gradients; here that is one fused weighted ``psum`` over the ICI mesh
+(parallel/collectives.py), with optional 16-bit payload cast
+(``precision_bits``, ``compspec.json:161-176``) applied to the payload while
+accumulating in fp32.
+"""
+
+from __future__ import annotations
+
+from ..parallel.collectives import payload_cast, payload_uncast, site_weighted_mean
+from .base import Engine, register_engine
+
+
+@register_engine("dSGD")
+def make_dsgd(precision_bits="32", **_unused) -> Engine:
+    def init(grads):
+        return {}
+
+    def aggregate(grads, state, weight, axis_name):
+        payload = payload_cast(grads, precision_bits)
+        agg = site_weighted_mean(payload, weight, axis_name)
+        return payload_uncast(agg, grads), state
+
+    return Engine("dSGD", init, aggregate)

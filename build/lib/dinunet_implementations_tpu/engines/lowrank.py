@@ -1,0 +1,80 @@
+"""Shared low-rank machinery for the compressed engines (rankDAD / powerSGD).
+
+The reference exposes three knobs (``compspec.json:236-238,268-270``):
+``dad_reduction_rank`` (default 10), ``dad_num_pow_iters`` (default 5), and
+``dad_tol`` (default 1e-3). Tolerance-based early exit inside jit is a
+``lax.while_loop`` whose carry tracks the singular-value estimates — shapes
+stay static, only the trip count is dynamic (bounded by ``num_iters``).
+
+Matrix convention: a gradient leaf with ndim ≥ 2 is reshaped to
+``[prod(leading), last]`` (Dense kernels are already [in, out]; conv kernels
+[h, w, cin, cout] → [h*w*cin, cout]); ndim ≤ 1 leaves are "dense" and bypass
+compression.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def is_compressible(g, min_rank_dim: int = 2) -> bool:
+    return g.ndim >= 2 and min(_matrix_shape(g)) >= min_rank_dim
+
+
+def _matrix_shape(g):
+    m = 1
+    for d in g.shape[:-1]:
+        m *= d
+    return m, g.shape[-1]
+
+
+def to_matrix(g):
+    return g.reshape(_matrix_shape(g))
+
+
+def from_matrix(mat, like):
+    return mat.reshape(like.shape).astype(like.dtype)
+
+
+def subspace_iteration(G, rank: int, num_iters: int, tol: float, key=None):
+    """Rank-r factorization ``G ≈ P @ Q^T`` by subspace (block power) iteration.
+
+    P is [m, r] orthonormal, Q = G^T P is [n, r]. Early-exits when the relative
+    change of the singular-value estimates drops below ``tol`` (the
+    ``dad_tol`` semantics), else runs ``num_iters`` (``dad_num_pow_iters``).
+    """
+    G = G.astype(jnp.float32)
+    m, n = G.shape
+    r = min(rank, m, n)
+    if key is None:
+        key = jax.random.PRNGKey(m * 1000003 + n)
+    omega = jax.random.normal(key, (n, r), jnp.float32)
+    Y = G @ omega  # [m, r]
+    P0, _ = jnp.linalg.qr(Y)
+    sig0 = jnp.linalg.norm(G.T @ P0, axis=0)  # [r] singular-value estimates
+
+    def cond(carry):
+        i, _, _, delta = carry
+        return jnp.logical_and(i < num_iters, delta > tol)
+
+    def body(carry):
+        i, P, sig, _ = carry
+        Y = G @ (G.T @ P)
+        P_new, _ = jnp.linalg.qr(Y)
+        sig_new = jnp.linalg.norm(G.T @ P_new, axis=0)
+        delta = jnp.linalg.norm(sig_new - sig) / jnp.maximum(jnp.linalg.norm(sig), 1e-12)
+        return i + 1, P_new, sig_new, delta
+
+    # Tie the initial delta to G so its device-varying annotation matches the
+    # loop body's output under shard_map (per-site G ⇒ per-site delta).
+    delta0 = jnp.float32(jnp.inf) + 0.0 * jnp.sum(sig0)
+    _, P, _, _ = jax.lax.while_loop(cond, body, (jnp.zeros((), jnp.int32), P0, sig0, delta0))
+    Q = G.T @ P  # [n, r]
+    return P, Q
+
+
+def orthonormalize(P):
+    """QR-based orthonormalization (columns)."""
+    Q, _ = jnp.linalg.qr(P)
+    return Q

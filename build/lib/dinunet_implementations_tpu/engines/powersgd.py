@@ -1,0 +1,92 @@
+"""powerSGD — low-rank gradient compression with error feedback.
+
+Reference capability (``comps/__init__.py:16``; measured as the best-AUC
+engine in ``nnlogs.ipynb`` cell 2). Classic powerSGD (Vogels et al., 2019)
+round, expressed as XLA collectives over the ``site`` axis:
+
+    M_s = G_s + e_s                (error feedback)
+    P   = orth( Σ_s w_s · M_s Q )  (weighted psum, then QR)
+    Q'  = Σ_s w_s · M_sᵀ P         (weighted psum)
+    Ĝ   = P Q'ᵀ                    (identical on every site)
+    e_s = M_s − Ĝ                  (local residual carried to next round)
+
+State per compressible leaf: the right factor ``Q`` (warm-started across
+rounds — key to powerSGD's convergence) and the residual ``e``. 1-D leaves
+aggregate densely. Rank comes from ``dad_reduction_rank`` (the reference GUI
+exposes one rank knob for both compressed engines, ``compspec.json:236-238``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.collectives import payload_dtype, site_weight_scale
+from .base import Engine, register_engine
+from .lowrank import from_matrix, is_compressible, orthonormalize, to_matrix
+
+
+@register_engine("powerSGD")
+def make_powersgd(
+    dad_reduction_rank: int = 10,
+    precision_bits="32",
+    seed: int = 0,
+    **_unused,
+) -> Engine:
+    pdtype = payload_dtype(precision_bits)
+
+    def init(grads):
+        leaves, treedef = jax.tree.flatten(grads)
+        qs, es = [], []
+        for i, g in enumerate(leaves):
+            if is_compressible(g):
+                m, n = to_matrix(g).shape
+                r = min(dad_reduction_rank, m, n)
+                key = jax.random.fold_in(jax.random.PRNGKey(seed), i)
+                # Q must start identical on every site: keyed by leaf index only.
+                qs.append(jax.random.normal(key, (n, r), jnp.float32))
+                es.append(jnp.zeros((m, n), jnp.float32))
+            else:
+                qs.append(None)
+                es.append(None)
+        return {
+            "q": jax.tree.unflatten(treedef, qs),
+            "e": jax.tree.unflatten(treedef, es),
+        }
+
+    def aggregate(grads, state, weight, axis_name):
+        scale = site_weight_scale(weight, axis_name)
+
+        def agg_leaf(g, q, e):
+            if q is None:
+                return (
+                    jax.lax.psum(g.astype(jnp.float32) * scale, axis_name).astype(g.dtype),
+                    None,
+                    None,
+                )
+            M = to_matrix(g).astype(jnp.float32) + e
+            # wire-compress to the payload dtype, then accumulate in fp32
+            # (policy in parallel/collectives.py: psum never runs in bf16)
+            P = jax.lax.psum(
+                (M @ q * scale).astype(pdtype).astype(jnp.float32), axis_name
+            )
+            P = orthonormalize(P)
+            q_new = jax.lax.psum(
+                (M.T @ P * scale).astype(pdtype).astype(jnp.float32), axis_name
+            )
+            G_hat = P @ q_new.T
+            e_new = M - G_hat
+            return from_matrix(G_hat, g), q_new, e_new
+
+        flat_g, treedef = jax.tree.flatten(grads)
+        flat_q = treedef.flatten_up_to(state["q"])
+        flat_e = treedef.flatten_up_to(state["e"])
+        outs = [agg_leaf(g, q, e) for g, q, e in zip(flat_g, flat_q, flat_e)]
+        agg = jax.tree.unflatten(treedef, [o[0] for o in outs])
+        new_state = {
+            "q": jax.tree.unflatten(treedef, [o[1] for o in outs]),
+            "e": jax.tree.unflatten(treedef, [o[2] for o in outs]),
+        }
+        return agg, new_state
+
+    return Engine("powerSGD", init, aggregate)

@@ -1,0 +1,63 @@
+"""rankDAD — distributed-AD low-rank gradient compression.
+
+Reference capability (``comps/__init__.py:15``; knobs
+``compspec.json:236-238``; measured run ``nnlogs.ipynb`` cell 2): each site
+compresses its per-layer gradient to rank-r factors via power iteration and
+ships factors instead of full gradients; the aggregate is the weighted mean of
+the sites' rank-r reconstructions.
+
+TPU shape of the exchange (SURVEY.md §2.2): ``all_gather`` of the
+``[m, r]``/``[n, r]`` factors over the ``site`` axis — comm volume
+``r·(m+n)`` per site instead of ``m·n`` — followed by one batched einsum
+reconstruction, which XLA maps straight onto the MXU. 1-D leaves (biases, BN
+scales) are aggregated densely like dSGD.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.collectives import payload_dtype, site_all_gather, site_weight_scale
+from .base import Engine, register_engine
+from .lowrank import from_matrix, is_compressible, subspace_iteration, to_matrix
+
+
+@register_engine("rankDAD")
+def make_rankdad(
+    dad_reduction_rank: int = 10,
+    dad_num_pow_iters: int = 5,
+    dad_tol: float = 1e-3,
+    precision_bits="32",
+    **_unused,
+) -> Engine:
+    pdtype = payload_dtype(precision_bits)
+
+    def init(grads):
+        return {}
+
+    def aggregate(grads, state, weight, axis_name):
+        scale = site_weight_scale(weight, axis_name)
+
+        def agg_leaf(g):
+            if not is_compressible(g):
+                # dense dSGD path for 1-D leaves (biases, BN affines)
+                return jax.lax.psum(g.astype(jnp.float32) * scale, axis_name).astype(g.dtype)
+            G = to_matrix(g)
+            P, Q = subspace_iteration(G, dad_reduction_rank, dad_num_pow_iters, dad_tol)
+            # weight one factor so the gathered reconstruction sums to the
+            # weighted mean; cast payload like the reference's precision_bits
+            P_pay = P.astype(pdtype)
+            Q_pay = (Q * scale).astype(pdtype)
+            P_all = site_all_gather(P_pay, axis_name)  # [S, m, r]
+            Q_all = site_all_gather(Q_pay, axis_name)  # [S, n, r]
+            G_hat = jnp.einsum(
+                "smr,snr->mn",
+                P_all.astype(jnp.float32),
+                Q_all.astype(jnp.float32),
+            )
+            return from_matrix(G_hat, g)
+
+        return jax.tree.map(agg_leaf, grads), state
+
+    return Engine("rankDAD", init, aggregate)

@@ -1,0 +1,42 @@
+"""SMRI3DNet — 3D-CNN classifier for structural MRI (T1w) volumes.
+
+TPU-build extension (BASELINE.json configs: "3D-CNN sMRI (T1w volumes)
+federated classifier, 8 sites"); no reference implementation exists, so the
+design is TPU-first throughout:
+
+- NDHWC (channels-last) layout — the native TPU conv layout;
+- downsampling via stride-2 convolutions (keeps everything on the MXU; no
+  pooling ops between matmul-like kernels);
+- mask-aware batch-stat BatchNorm (models/layers.py) so SPMD padding rows
+  don't perturb statistics, matching the MSANNet convention;
+- global average pool + linear head.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .layers import BatchNorm, dense
+
+
+class SMRI3DNet(nn.Module):
+    channels: tuple = (16, 32, 64, 128)
+    num_cls: int = 2
+    dropout_rate: float = 0.25
+
+    @nn.compact
+    def __call__(self, x, train: bool = True, mask=None):
+        # x: [B, D, H, W] or [B, D, H, W, C]
+        if x.ndim == 4:
+            x = x[..., None]
+        for i, ch in enumerate(self.channels):
+            x = nn.Conv(ch, kernel_size=(3, 3, 3), strides=(2, 2, 2),
+                        use_bias=False, name=f"conv_{i}")(x)
+            x = BatchNorm(ch, track_running_stats=False, name=f"bn_{i}")(
+                x, train=train, mask=mask
+            )
+            x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2, 3))  # global average pool → [B, C]
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return dense(self.num_cls, fan_in=x.shape[-1], name="head")(x)

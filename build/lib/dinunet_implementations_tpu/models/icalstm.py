@@ -1,0 +1,266 @@
+"""ICALstm — the ICA-timecourse bidirectional LSTM classifier.
+
+Capability parity with reference ``comps/icalstm/models.py:5-110``:
+
+- per-window encoder ``Linear(num_comps*window → input_size) + ReLU``
+  (the reference applies it in a Python loop over the batch,
+  ``models.py:107``; here it is one batched matmul over ``[B*S]`` rows);
+- hand-rolled (bi)LSTM: per direction a cell with ``i2h: (D → 4H)``,
+  ``h2h: (H → 4H)``; ``hidden_size`` is split across directions
+  (``models.py:55-57``); the reverse direction runs over the time-flipped
+  input and hidden sequences concat on the feature dim (``models.py:60-65``);
+- mean-pool over time, then the classifier head
+  ``Dropout(0.25) → Linear(H→256) → BatchNorm1d(256) → ReLU → Linear(256→64)
+  → ReLU → Linear(64→num_cls)`` (``models.py:96-104``).
+
+**Gate math.** The reference cell has a numerical quirk
+(``models.py:31-38``): it applies ``sigmoid`` to the i/f/o pre-activations
+*twice* (``gates = preact[:, :3H].sigmoid()`` then ``sigmoid(gates[...])``),
+while ``g`` uses ``tanh`` of the raw pre-activation. ``double_sigmoid_gates``
+reproduces that bit-for-bit for parity runs; the default is standard LSTM
+gates (single sigmoid), which trains strictly better.
+
+TPU-first shape of the recurrence: the input projection for *all* timesteps is
+hoisted out of the loop into one ``[B*T, D] @ [D, 4H]`` MXU matmul; only the
+``h @ W_hh`` recurrence stays inside ``lax.scan`` (sequential by nature).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .layers import BatchNorm, TorchLinearInit, dense
+
+
+def _lstm_gates(preact, H, double_sigmoid: bool):
+    if double_sigmoid:
+        gates = jax.nn.sigmoid(preact[..., : 3 * H])
+        i = jax.nn.sigmoid(gates[..., :H])
+        f = jax.nn.sigmoid(gates[..., H : 2 * H])
+        o = jax.nn.sigmoid(gates[..., 2 * H : 3 * H])
+    else:
+        i = jax.nn.sigmoid(preact[..., :H])
+        f = jax.nn.sigmoid(preact[..., H : 2 * H])
+        o = jax.nn.sigmoid(preact[..., 2 * H : 3 * H])
+    g = jnp.tanh(preact[..., 3 * H :])
+    return i, f, o, g
+
+
+def _auto_pallas() -> bool:
+    # The fused kernel uses TPU-only pltpu.VMEM specs; any other accelerator
+    # (e.g. GPU) must fall back to the lax.scan path rather than crash.
+    return jax.default_backend() == "tpu"
+
+
+class LSTMCell(nn.Module):
+    """One direction over a full sequence: x [B, T, D] → hidden seq [B, T, H].
+
+    Reference ``comps/icalstm/models.py:5-45`` — but the Python
+    loop-over-timesteps becomes ``lax.scan`` (or the fused Pallas recurrence
+    kernel, ops/lstm_pallas.py) and the i2h projection one batched matmul.
+
+    ``use_pallas``: None = auto (fused kernel on accelerators, scan on CPU);
+    the double-sigmoid compat mode always uses the scan path.
+    """
+
+    hidden_size: int
+    double_sigmoid_gates: bool = False
+    use_pallas: bool | None = None
+    compute_dtype: str | None = None  # e.g. "bfloat16"; None = f32 (parity)
+
+    @nn.compact
+    def __call__(self, x, h0=None):
+        B, T, D = x.shape
+        H = self.hidden_size
+        w_ih = self.param("w_ih", TorchLinearInit.kernel, (D, 4 * H))
+        b_ih = self.param("b_ih", TorchLinearInit.bias_for(D), (4 * H,))
+        w_hh = self.param("w_hh", TorchLinearInit.kernel, (H, 4 * H))
+        b_hh = self.param("b_hh", TorchLinearInit.bias_for(H), (4 * H,))
+
+        cdt = jnp.dtype(self.compute_dtype) if self.compute_dtype else None
+        if h0 is None:
+            # carry is always f32: the scan body computes an f32 carry (scan
+            # requires carry-type invariance) and the kernel keeps f32 carries
+            h0 = (jnp.zeros((B, H), jnp.float32), jnp.zeros((B, H), jnp.float32))
+
+        use_pallas = (
+            self.use_pallas if self.use_pallas is not None else _auto_pallas()
+        ) and not self.double_sigmoid_gates
+        if use_pallas:
+            # fused kernel: i2h projection runs in-kernel with W_ih resident
+            # in VMEM — streams x [T, B, D] once instead of a pre-projected
+            # [T, B, 4H] (no XLA-side xi materialization at all)
+            from ..ops.lstm_pallas import lstm_forward_fused
+
+            return lstm_forward_fused(
+                x, w_ih, b_ih + b_hh, w_hh, h0[0], h0[1], compute_dtype=cdt
+            )
+
+        if cdt is not None:
+            # scan path: hoist the i2h projection for all timesteps into one
+            # bf16 MXU matmul (f32 accum); XLA fuses the downcast epilogue
+            xi = (jnp.dot(
+                x.astype(cdt), w_ih.astype(cdt),
+                preferred_element_type=jnp.float32,
+            ) + (b_ih + b_hh)).astype(cdt)
+        else:
+            xi = x @ w_ih + (b_ih + b_hh)  # [B, T, 4H] — one matmul
+
+        def step(carry, xt):
+            h, c = carry
+            if cdt is not None:
+                preact = xt + jnp.dot(
+                    h.astype(cdt), w_hh.astype(cdt),
+                    preferred_element_type=jnp.float32,
+                )
+            else:
+                preact = xt + h @ w_hh
+            i, f, o, g = _lstm_gates(preact, H, self.double_sigmoid_gates)
+            c = f * c + i * g
+            h = o * jnp.tanh(c)
+            return (h, c), h
+
+        (hT, cT), hs = jax.lax.scan(step, h0, jnp.swapaxes(xi, 0, 1))
+        return jnp.swapaxes(hs, 0, 1), (hT, cT)
+
+
+class BiLSTM(nn.Module):
+    """Bidirectional wrapper (reference ``comps/icalstm/models.py:48-66``):
+    ``hidden_size`` is the *total* width, split across directions.
+
+    ``sequence_axis``: when set (a bound mesh axis name, normally
+    ``parallel.mesh.MODEL_AXIS``), ``x`` is this device's time chunk of a
+    sequence sharded over that axis; each direction runs as a ring LSTM
+    (parallel/sequence.py) with the carry relayed around the ring. Submodule
+    names match the dense path, so params are interchangeable.
+    """
+
+    hidden_size: int
+    bidirectional: bool = True
+    double_sigmoid_gates: bool = False
+    use_pallas: bool | None = None
+    compute_dtype: str | None = None
+    sequence_axis: str | None = None
+
+    @nn.compact
+    def __call__(self, x, h0=None):
+        per_dir = self.hidden_size // (2 if self.bidirectional else 1)
+        fwd_cell = LSTMCell(
+            per_dir, self.double_sigmoid_gates, self.use_pallas,
+            self.compute_dtype, name="fwd"
+        )
+        if self.sequence_axis is None:
+            fwd, (h, c) = fwd_cell(x, h0)
+        else:
+            from ..parallel.sequence import reverse_sequence, ring_lstm
+
+            if h0 is None:
+                z = jnp.zeros((x.shape[0], per_dir), jnp.float32)
+                h0 = (z, z)
+            fwd, (h, c) = ring_lstm(
+                lambda xc, carry: fwd_cell(xc, carry), x, h0[0], h0[1],
+                axis_name=self.sequence_axis,
+            )
+        if not self.bidirectional:
+            return fwd, (h, c)
+        rev_cell = LSTMCell(
+            per_dir, self.double_sigmoid_gates, self.use_pallas,
+            self.compute_dtype, name="rev"
+        )
+        if self.sequence_axis is None:
+            rev, (hr, cr) = rev_cell(jnp.flip(x, axis=1), h0)
+        else:
+            # reverse direction = the cell over the time-reversed GLOBAL
+            # sequence; reverse_sequence re-shards it so device i holds
+            # reversed-chunk i, making the local concat line up with the dense
+            # path's (no flip-back, as the reference) hidden concat
+            rev, (hr, cr) = ring_lstm(
+                lambda xc, carry: rev_cell(xc, carry),
+                reverse_sequence(x, self.sequence_axis, axis=1),
+                h0[0], h0[1], axis_name=self.sequence_axis,
+            )
+        return (
+            jnp.concatenate([fwd, rev], axis=2),
+            (jnp.concatenate([h, hr], 1), jnp.concatenate([c, cr], 1)),
+        )
+
+
+class ICALstm(nn.Module):
+    input_size: int = 256
+    hidden_size: int = 256
+    bidirectional: bool = True
+    num_cls: int = 2
+    num_comps: int = 53
+    window_size: int = 20
+    num_layers: int = 1  # parity field; reference builds 1 layer regardless
+    double_sigmoid_gates: bool = False
+    dropout_rate: float = 0.25
+    use_pallas: bool | None = None  # None = auto (kernel on accelerators)
+    compute_dtype: str | None = None  # "bfloat16" = mixed precision (f32 accum)
+    # Sequence parallelism (TPU extension, SURVEY.md §2.2): a bound mesh axis
+    # name (parallel.mesh.MODEL_AXIS) shards the window axis S across that
+    # axis — the encoder runs on the local chunk, the BiLSTM relays its carry
+    # ring-style, and the time mean-pool finishes with an all_gather. Callers
+    # pass the FULL [B, S, C, W] batch (replicated over the axis); the model
+    # takes its own chunk. Init outside the mesh with sequence_axis=None —
+    # param shapes/names are identical (FederatedTask.init_variables does this).
+    sequence_axis: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True, mask=None):
+        # x: [B, S, C, W] (windows, components, timepoints-per-window)
+        B, S = x.shape[0], x.shape[1]
+        flat = x.reshape(B, S, -1)  # [B, S, C*W]
+        if self.sequence_axis is not None:
+            from ..parallel.sequence import shard_sequence
+
+            n = jax.lax.axis_size(self.sequence_axis)
+            if S % n:
+                raise ValueError(
+                    f"sequence parallelism needs windows ({S}) divisible by "
+                    f"the {self.sequence_axis!r} axis size ({n})"
+                )
+            flat = shard_sequence(flat, self.sequence_axis, axis=1)
+        cdt = jnp.dtype(self.compute_dtype) if self.compute_dtype else None
+        # under compute_dtype the encoder output stays bf16 — it feeds the
+        # per-direction i2h projections, which consume bf16 directly
+        enc = nn.relu(
+            dense(self.input_size, fan_in=self.num_comp_window, name="encoder",
+                  dtype=cdt)(flat)
+        )
+        o, h = BiLSTM(
+            self.hidden_size,
+            self.bidirectional,
+            self.double_sigmoid_gates,
+            self.use_pallas,
+            self.compute_dtype,
+            self.sequence_axis,
+            name="lstm",
+        )(enc)
+        if self.sequence_axis is not None:
+            # mean over the GLOBAL window axis: local sum, then all_gather
+            # (transpose = reduce-scatter, so chunk cotangents route back to
+            # the owning device — sound under AD, unlike a bare psum here)
+            o = jax.lax.all_gather(
+                o.sum(axis=1), self.sequence_axis
+            ).sum(axis=0) / S
+        else:
+            o = jnp.mean(o, axis=1)  # mean-pool over windows (models.py:109)
+        o = o.astype(jnp.float32)  # classifier head + BN stay full precision
+
+        # classifier head (models.py:96-104); per-direction width totals
+        # hidden_size when bidirectional splits evenly, else 2*(H//2).
+        o = nn.Dropout(self.dropout_rate, deterministic=not train)(o)
+        o = dense(256, fan_in=o.shape[-1], name="cls_fc1")(o)
+        o = BatchNorm(256, track_running_stats=True, name="cls_bn")(
+            o, train=train, mask=mask
+        )
+        o = nn.relu(o)
+        o = nn.relu(dense(64, fan_in=256, name="cls_fc2")(o))
+        return dense(self.num_cls, fan_in=64, name="cls_fc3")(o)
+
+    @property
+    def num_comp_window(self):
+        return self.num_comps * self.window_size

@@ -1,0 +1,116 @@
+"""Shared layers.
+
+The one nontrivial piece is :class:`BatchNorm`: the reference uses two
+different torch BatchNorm configurations —
+
+- ``BatchNorm1d(h, track_running_stats=False)`` in MSANNet
+  (``comps/fs/models.py:15``): batch statistics are used in *both* train and
+  eval, nothing is tracked;
+- ``BatchNorm1d(256)`` (track_running_stats=True) in the ICALstm classifier
+  head (``comps/icalstm/models.py:97``): train uses batch stats and updates
+  running stats (momentum 0.1, unbiased var), eval uses the running stats.
+
+Because our SPMD batches are dense ``[B, ...]`` blocks with weight-0 padding
+rows (data/batching.py), batch statistics must be **mask-weighted** — a padded
+row must not shift the mean/var. With an all-ones mask this reduces exactly to
+torch's biased batch variance.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def masked_moments(x, mask, axis=0, eps_count: float = 1.0):
+    """Weighted mean/var over ``axis``. ``mask`` broadcasts against ``x`` with
+    trailing feature dims of size 1. Biased variance (torch normalization)."""
+    if mask is None:
+        mean = jnp.mean(x, axis=axis, keepdims=True)
+        var = jnp.mean(jnp.square(x - mean), axis=axis, keepdims=True)
+        count = x.shape[axis] if isinstance(axis, int) else None
+        return mean, var, count
+    w = mask
+    count = jnp.maximum(jnp.sum(w, axis=axis, keepdims=True), eps_count)
+    mean = jnp.sum(x * w, axis=axis, keepdims=True) / count
+    var = jnp.sum(w * jnp.square(x - mean), axis=axis, keepdims=True) / count
+    return mean, var, count
+
+
+class BatchNorm(nn.Module):
+    """Torch-faithful BatchNorm1d with optional running stats and masking."""
+
+    features: int
+    track_running_stats: bool = False
+    momentum: float = 0.1  # torch convention: new = (1-m)*old + m*batch
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True, mask=None):
+        scale = self.param("scale", nn.initializers.ones, (self.features,))
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+
+        if self.track_running_stats:
+            ra_mean = self.variable(
+                "batch_stats", "mean", lambda: jnp.zeros((self.features,))
+            )
+            ra_var = self.variable(
+                "batch_stats", "var", lambda: jnp.ones((self.features,))
+            )
+
+        m = None if mask is None else mask.reshape(mask.shape[0], *([1] * (x.ndim - 1)))
+        use_batch = train or not self.track_running_stats
+        if use_batch:
+            mean, var, count = masked_moments(x, m, axis=0)
+            if self.track_running_stats and not self.is_initializing():
+                # torch tracks the *unbiased* variance
+                n = count if m is not None else x.shape[0]
+                unbiased = var * (n / jnp.maximum(n - 1, 1))
+                ra_mean.value = (1 - self.momentum) * ra_mean.value + self.momentum * jnp.squeeze(mean, 0)
+                ra_var.value = (1 - self.momentum) * ra_var.value + self.momentum * jnp.squeeze(unbiased, 0)
+            y = (x - mean) * jnp.reciprocal(jnp.sqrt(var + self.eps))
+        else:
+            y = (x - ra_mean.value) * jnp.reciprocal(jnp.sqrt(ra_var.value + self.eps))
+        return y * scale + bias
+
+
+class TorchLinearInit:
+    """Torch ``nn.Linear`` initialization (kaiming-uniform weights,
+    fan-in-uniform bias) — used so warm starts / parity comparisons against the
+    reference start from the same distribution family."""
+
+    @staticmethod
+    def kernel(key, shape, dtype=jnp.float32):
+        # flax Dense kernel shape is (fan_in, fan_out)
+        fan_in = shape[0]
+        # torch kaiming_uniform_(a=sqrt(5)): gain = sqrt(2/(1+5)) = sqrt(1/3),
+        # bound = sqrt(3) * gain / sqrt(fan_in) = 1/sqrt(fan_in)
+        bound = jnp.sqrt(1.0 / fan_in)
+        import jax
+
+        return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+    @staticmethod
+    def bias_for(fan_in):
+        def init(key, shape, dtype=jnp.float32):
+            import jax
+
+            bound = jnp.sqrt(1.0 / fan_in)
+            return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+        return init
+
+
+def dense(features: int, use_bias: bool = True, name=None, fan_in: int | None = None,
+          dtype=None):
+    """``nn.Dense`` with torch-style init. ``dtype`` sets the computation
+    dtype (e.g. bf16 mixed precision); params stay f32."""
+    return nn.Dense(
+        features,
+        use_bias=use_bias,
+        name=name,
+        dtype=dtype,
+        param_dtype=jnp.float32,
+        kernel_init=TorchLinearInit.kernel,
+        bias_init=TorchLinearInit.bias_for(fan_in) if fan_in else nn.initializers.zeros,
+    )

@@ -1,0 +1,39 @@
+"""MSANNet — the FreeSurfer-volume MLP classifier.
+
+Architecture parity with reference ``comps/fs/models.py:4-31``: per hidden
+layer ``Linear(bias=False) → BatchNorm(track_running_stats=False) → ReLU
+[→ Dropout(0.5) if layer index ∈ dropout_in]``, then a biased ``Linear`` head.
+Defaults 66 → (256,128,64,32) → 2 (``compspec.json:227-235``).
+
+TPU notes: the whole net is a chain of small matmuls — XLA fuses the
+BN/ReLU/dropout elementwise chain into the matmuls; batch stats are
+mask-weighted so SPMD padding rows don't perturb them (see models/layers.py).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+from .layers import BatchNorm, dense
+
+
+class MSANNet(nn.Module):
+    in_size: int = 66
+    hidden_sizes: tuple = (256, 128, 64, 32)
+    out_size: int = 2
+    dropout_in: tuple = ()
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True, mask=None):
+        fan_in = self.in_size
+        for i, h in enumerate(self.hidden_sizes):
+            x = dense(h, use_bias=False, name=f"linear_{i}")(x)
+            x = BatchNorm(h, track_running_stats=False, name=f"bn_{i}")(
+                x, train=train, mask=mask
+            )
+            x = nn.relu(x)
+            if i in self.dropout_in:
+                x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+            fan_in = h
+        return dense(self.out_size, fan_in=fan_in, name="fc_out")(x)

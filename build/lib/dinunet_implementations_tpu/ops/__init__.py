@@ -1,0 +1,1 @@
+from .lstm_pallas import lstm_forward_fused, lstm_recurrence_fused

@@ -401,13 +401,11 @@ def _vjp_fused_fwd(x, wih4, b4, whh4, h0, c0, compute_dtype):
     hs, cs, i, f, o, g, hT, cT = _fwd_fused_callable(_cdt_name(compute_dtype))(
         x, wih4, b4, whh4, h0, c0
     )
-    # b4 rides along only for its dtype: custom_vjp cotangent avals must
-    # match the primal avals even when a caller passes non-f32 weights
-    return (hs, (hT, cT)), (x, wih4, b4, whh4, h0, c0, hs, cs, (i, f, o, g))
+    return (hs, (hT, cT)), (x, wih4, whh4, h0, c0, hs, cs, (i, f, o, g))
 
 
 def _vjp_fused_bwd(compute_dtype, res, grads):
-    x, wih4, b4, whh4, h0, c0, hs, cs, acts = res
+    x, wih4, whh4, h0, c0, hs, cs, acts = res
     dhs, (dhT, dcT) = grads
     cdt_name = _cdt_name(compute_dtype)
     dp_i, dp_f, dp_o, dp_g, dh0, dc0 = _bwd_callable(cdt_name)(
@@ -423,13 +421,13 @@ def _vjp_fused_bwd(compute_dtype, res, grads):
     dwih = jnp.einsum(
         "tbd,ktbh->kdh", x.astype(cdt), dp4.astype(cdt),
         preferred_element_type=jnp.float32,
-    ).astype(wih4.dtype)
-    db = dp4.astype(jnp.float32).sum(axis=(1, 2)).astype(b4.dtype)
+    )
+    db = dp4.astype(jnp.float32).sum(axis=(1, 2))
     h_prev = jnp.concatenate([h0[None].astype(hs.dtype), hs[:-1]], 0)
     dwhh = jnp.einsum(
         "tbh,ktbg->khg", h_prev.astype(cdt), dp4.astype(cdt),
         preferred_element_type=jnp.float32,
-    ).astype(whh4.dtype)
+    )
     return dx, dwih, db, dwhh, dh0, dc0
 
 

@@ -1,0 +1,20 @@
+from .mesh import (
+    MODEL_AXIS,
+    SITE_AXIS,
+    host_mesh,
+    make_site_mesh,
+    replicated,
+    site_sharding,
+)
+from .collectives import (
+    payload_cast,
+    payload_dtype,
+    payload_uncast,
+    site_weight_scale,
+    site_all_gather,
+    site_count,
+    site_index,
+    site_mean,
+    site_sum,
+    site_weighted_mean,
+)

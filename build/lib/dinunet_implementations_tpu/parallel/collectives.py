@@ -20,15 +20,10 @@ import jax.numpy as jnp
 
 from .mesh import SITE_AXIS
 
-# precision_bits payload casting (compspec.json:161-176). On TPU, "16" means
-# bfloat16 (the native 16-bit type; same byte count on the wire, wider
-# exponent); "16-ieee" opts into the reference's literal IEEE fp16 payload for
-# bit-level compat runs. The reduction itself always accumulates in fp32.
-_PAYLOAD_DTYPES = {
-    "32": jnp.float32, 32: jnp.float32,
-    "16": jnp.bfloat16, 16: jnp.bfloat16,
-    "16-ieee": jnp.float16,
-}
+# precision_bits payload casting (compspec.json:161-176). On TPU, 16-bit payload
+# means bfloat16 (fp16 is not a native TPU type); the reduction itself still
+# accumulates in fp32.
+_PAYLOAD_DTYPES = {"32": jnp.float32, "16": jnp.bfloat16, 32: jnp.float32, 16: jnp.bfloat16}
 
 
 def payload_dtype(precision_bits="32"):

@@ -1,0 +1,83 @@
+"""Device mesh construction — the communication backend.
+
+This replaces the reference's COINSTAC transport layer (L0): Docker containers
+exchanging JSON payloads through a message bus (reference ``entry.py:5``,
+``local.py:19``, ``remote.py:13``). In the TPU build, every federated site lives
+on a slice of a ``jax.sharding.Mesh`` with a ``"site"`` axis; the local→remote
+gradient ship + remote→local broadcast collapses into XLA collectives over ICI
+(multi-host: DCN). See SURVEY.md §2.2.
+
+Axes:
+  - ``site``  — one federated site per mesh index (or per core-group).
+  - ``model`` — optional inner axis for tensor/sequence sharding within a site
+                (a TPU-build extension; the reference is single-device per site).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SITE_AXIS = "site"
+MODEL_AXIS = "model"
+# vmap axis name for sites folded onto one device (several simulated sites per
+# chip, e.g. 32 sites on 8 chips): the trainer nests a vmap over the local
+# site block inside shard_map, and cross-site collectives run over the
+# (SITE_AXIS, FOLD_AXIS) pair. Never a mesh axis.
+FOLD_AXIS = "site_fold"
+
+
+def make_site_mesh(
+    num_sites: int | None = None,
+    devices: list | None = None,
+    model_axis_size: int = 1,
+) -> Mesh:
+    """Build a ``(site, model)`` mesh.
+
+    ``num_sites`` defaults to ``len(devices) // model_axis_size``. When fewer
+    devices than sites are available, callers should fold multiple sites onto
+    one device via a batched site dimension instead (see trainer); this function
+    requires num_sites * model_axis_size == number of devices used.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if num_sites is None:
+        num_sites = len(devices) // model_axis_size
+    need = num_sites * model_axis_size
+    if need > len(devices):
+        raise ValueError(
+            f"need {need} devices for {num_sites} sites × model={model_axis_size}, "
+            f"have {len(devices)}"
+        )
+    arr = np.array(devices[:need]).reshape(num_sites, model_axis_size)
+    return Mesh(arr, (SITE_AXIS, MODEL_AXIS))
+
+
+def site_sharding(mesh: Mesh, *trailing_axes) -> NamedSharding:
+    """Sharding with the leading dim split over ``site`` (per-site data)."""
+    return NamedSharding(mesh, P(SITE_AXIS, *trailing_axes))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated sharding (global params — all sites hold the same
+    weights between rounds, as in the reference where the remote broadcasts the
+    aggregated update back to every site)."""
+    return NamedSharding(mesh, P())
+
+
+def host_mesh(num_sites: int, model_axis_size: int = 1) -> Mesh:
+    """Mesh over CPU host devices, for the simulator path (tests / local dev).
+
+    Requires ``XLA_FLAGS=--xla_force_host_platform_device_count=N``; this is the
+    TPU-build replacement for the reference's Docker-based COINSTAC simulator
+    (SURVEY.md §4.1).
+    """
+    cpus = [d for d in jax.devices() if d.platform == "cpu"]
+    if not cpus:
+        raise RuntimeError(
+            "host_mesh needs CPU host devices; set "
+            'jax.config.update("jax_platforms", "cpu") and '
+            'jax.config.update("jax_num_cpu_devices", N) before first jax use '
+            "(see tests/conftest.py)"
+        )
+    return make_site_mesh(num_sites, cpus, model_axis_size)

@@ -1,0 +1,149 @@
+"""Sequence / context parallelism over the ``model`` mesh axis.
+
+The reference has no sequence sharding (SURVEY.md §2.2: its longest-sequence
+handling is a single-device Python-loop LSTM over ≤98 windows). For the TPU
+build, long-context is first-class: sequences too long for one device's HBM
+shard their time axis across the ``model`` axis, with collectives carrying the
+cross-chunk dependencies:
+
+- :func:`ring_attention` — blockwise attention with online-softmax
+  accumulation while K/V blocks rotate around the ring via ``ppermute``
+  (the standard ring-attention recipe; memory per device is O(T/n)).
+- :func:`ring_lstm` — the LSTM carry relayed around the ring: device s
+  computes its chunk in wavefront stage s and hands (h, c) to device s+1.
+  A recurrence is inherently sequential, so a single sequence incurs n-stage
+  latency (each stage runs on every device SPMD-uniformly; outputs are
+  selected by stage) — what it buys is *memory* scaling: n× longer sequences
+  than fit on one device. Batched workloads overlap stages across
+  microbatches.
+
+All functions run inside ``shard_map``/``vmap`` with a bound axis name.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .mesh import MODEL_AXIS
+
+
+def _ring_perm(n):
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def ring_attention(q, k, v, axis_name: str | None = MODEL_AXIS):
+    """Ring attention over a sequence sharded on ``axis_name``.
+
+    q/k/v: ``[B, T_local, N, Hd]`` per device (full heads, local time chunk).
+    Returns ``[B, T_local, N, Hd]`` — exact (non-causal) softmax attention
+    over the *global* sequence, computed with online-softmax accumulation as
+    K/V blocks rotate around the ring.
+    """
+    if axis_name is None:
+        from ..models.transformer import dot_product_attention
+
+        return dot_product_attention(q, k, v)
+
+    n = jax.lax.axis_size(axis_name)
+    scale = q.shape[-1] ** -0.5
+    B, T, N, Hd = q.shape
+
+    num = jnp.zeros((B, T, N, Hd), jnp.float32)
+    den = jnp.zeros((B, N, T), jnp.float32)
+    mx = jnp.full((B, N, T), -jnp.inf, jnp.float32)
+
+    def step(carry, _):
+        k_blk, v_blk, num, den, mx = carry
+        logits = jnp.einsum("btnh,bsnh->bnts", q, k_blk).astype(jnp.float32) * scale
+        blk_max = logits.max(axis=-1)
+        new_mx = jnp.maximum(mx, blk_max)
+        corr = jnp.exp(mx - new_mx)
+        p = jnp.exp(logits - new_mx[..., None])  # [B, N, T, S]
+        num_new = num * jnp.moveaxis(corr, 1, 2)[..., None] + jnp.einsum(
+            "bnts,bsnh->btnh", p, v_blk.astype(jnp.float32)
+        )
+        den_new = den * corr + p.sum(axis=-1)
+        k_nxt = jax.lax.ppermute(k_blk, axis_name, _ring_perm(n))
+        v_nxt = jax.lax.ppermute(v_blk, axis_name, _ring_perm(n))
+        return (k_nxt, v_nxt, num_new, den_new, new_mx), None
+
+    (k_f, v_f, num, den, mx), _ = jax.lax.scan(
+        step, (k, v, num, den, mx), None, length=n
+    )
+    out = num / jnp.moveaxis(den, 1, 2)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_lstm(cell_fn, x_local, h0, c0, axis_name: str = MODEL_AXIS):
+    """Run an LSTM over a time-sharded sequence by relaying the carry.
+
+    ``cell_fn(x_chunk, (h, c)) -> (hs_chunk, (hT, cT))`` — any full-sequence
+    cell (e.g. a bound ``LSTMCell``). ``x_local`` is this device's
+    ``[B, T_local, D]`` chunk; ``h0``/``c0`` seed device 0.
+
+    Returns ``(hs_local [B, T_local, H], (hT, cT))`` where the terminal carry
+    is valid on every device (broadcast from the last ring position).
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+
+    carry = (h0, c0)
+    out = None
+    for s in range(n):  # n is static (mesh size)
+        hs, (hT, cT) = cell_fn(x_local, carry)
+        sel = idx == s
+        out = jnp.where(sel[..., None, None], hs, out if out is not None else jnp.zeros_like(hs))
+        # relay the carry produced at stage s to stage s+1's device
+        send = jax.tree.map(
+            lambda t: jnp.where(sel[..., None], t, jnp.zeros_like(t)), (hT, cT)
+        )
+        recv = jax.tree.map(
+            lambda t: jax.lax.ppermute(t, axis_name, _ring_perm(n)), send
+        )
+        take = idx == (s + 1) % n
+        carry = jax.tree.map(
+            lambda new, old: jnp.where(take[..., None], new, old), recv, carry
+        )
+    # After stage n-1 the final carry was relayed to device 0 ("take" index
+    # (n-1+1) % n == 0); broadcast it to every device via a masked psum.
+    is0 = idx == 0
+    final = jax.tree.map(
+        lambda t: jax.lax.psum(
+            jnp.where(is0[..., None], t, jnp.zeros_like(t)), axis_name
+        ),
+        carry,
+    )
+    return out, final
+
+
+def reverse_sequence(x_local, axis_name: str = MODEL_AXIS, axis: int = 1):
+    """Time-reverse a sequence that is sharded on ``axis_name``.
+
+    If device i holds chunk i of the global sequence, after this call device i
+    holds chunk i of the *reversed* global sequence: one ``ppermute`` swaps
+    chunk i ↔ chunk n-1-i, and a local flip reverses within the chunk. Used by
+    the ring bidirectional LSTM (the reference's reverse direction runs the
+    cell over ``torch.flip(x, (1,))``, ``comps/icalstm/models.py:60-65``).
+    Self-inverse, and its AD transpose is itself (ppermute + flip are both
+    linear and self-inverse here), so gradients route back to the owning chunk.
+    """
+    n = jax.lax.axis_size(axis_name)
+    swapped = jax.lax.ppermute(
+        x_local, axis_name, [(i, n - 1 - i) for i in range(n)]
+    )
+    return jnp.flip(swapped, axis=axis)
+
+
+def shard_sequence(x, axis_name: str = MODEL_AXIS, axis: int = 1):
+    """Split a gathered [B, T, ...] array into this device's chunk."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    T = x.shape[axis]
+    chunk = T // n
+    return jax.lax.dynamic_slice_in_dim(x, idx * chunk, chunk, axis=axis)
+
+
+def gather_sequence(x_local, axis_name: str = MODEL_AXIS, axis: int = 1):
+    """Inverse of :func:`shard_sequence` — all-gather chunks back to [B, T, ...]."""
+    return jax.lax.all_gather(x_local, axis_name, axis=axis, tiled=True)

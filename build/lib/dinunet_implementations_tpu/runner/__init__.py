@@ -1,0 +1,2 @@
+from .fed_runner import FedRunner, SiteRunner, discover_site_dirs, load_site_splits
+from .registry import TASKS, TaskSpec, get_task, register_task, task_cache

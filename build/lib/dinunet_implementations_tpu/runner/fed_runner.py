@@ -1,0 +1,228 @@
+"""Runners: single-site (SiteRunner parity) and federated over a dataset tree.
+
+- :class:`SiteRunner` — the reference's standalone debug harness
+  (``comps/fs/site_run.py:4-6``, ``comps/icalstm/site_run.py:5-9``): train one
+  site from a ``datasets/<name>`` folder + its ``inputspec.json``, no
+  aggregation (a 1-site federation).
+- :class:`FedRunner` — the replacement for the COINSTAC simulator (SURVEY.md
+  §4.1): discovers ``input/local*/simulatorRun`` site dirs (the reference's
+  fixture convention), builds per-site datasets/splits, and trains them as one
+  SPMD program on a site mesh (or folded onto one chip with ``mesh=None``).
+  Supports split-ratio and k-fold drivers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+
+from ..core.config import TrainConfig, resolve_site_configs
+from ..data.api import SiteArrays, build_site_dataset
+from ..data.splits import resolve_splits
+from ..parallel.mesh import host_mesh, make_site_mesh
+from ..trainer.loop import FederatedTrainer
+from .registry import get_task, task_cache
+
+
+def discover_site_dirs(dataset_dir: str) -> list[str]:
+    """Reference fixture layout: ``<dataset_dir>/input/local{i}/simulatorRun``
+    (``datasets/test_fsl``); falls back to ``dataset_dir`` itself as a single
+    site when no local* dirs exist."""
+    pattern = os.path.join(dataset_dir, "input", "local*", "simulatorRun")
+    dirs = sorted(
+        glob.glob(pattern),
+        key=lambda p: int("".join(c for c in p.split("local")[-1].split(os.sep)[0] if c.isdigit()) or 0),
+    )
+    return dirs or [dataset_dir]
+
+
+def load_site_splits(
+    cfg: TrainConfig, site_dirs: list[str], site_cfgs: list[TrainConfig] | None = None
+):
+    """Build per-site datasets and per-fold splits.
+
+    Returns ``folds``: list (per fold) of dicts with ``train``/``validation``/
+    ``test`` lists of :class:`SiteArrays` (one entry per site).
+    """
+    site_cfgs = site_cfgs or [cfg] * len(site_dirs)
+    spec = get_task(cfg.task_id)
+    site_arrays = []
+    site_splits = []
+    for i, (d, scfg) in enumerate(zip(site_dirs, site_cfgs)):
+        ds = build_site_dataset(
+            spec.dataset_cls, spec.handle_cls, task_cache(scfg), {"baseDirectory": d},
+            mode=scfg.mode,
+        )
+        arrs = ds.as_arrays()
+        site_arrays.append(arrs)
+        args = scfg.task_args()
+        site_splits.append(
+            resolve_splits(
+                len(arrs),
+                split_ratio=scfg.split_ratio,
+                num_folds=scfg.num_folds,
+                split_files=tuple(getattr(args, "split_files", ()) or ()),
+                base_dir=d,
+                seed=scfg.seed + i,
+            )
+        )
+    num_folds = min(len(s) for s in site_splits)
+    folds = []
+    for k in range(num_folds):
+        fold = {"train": [], "validation": [], "test": []}
+        for arrs, splits in zip(site_arrays, site_splits):
+            for key in fold:
+                fold[key].append(arrs.take(splits[k][key]))
+        folds.append(fold)
+    return folds
+
+
+class FedRunner:
+    """Federated training over a reference-style dataset tree."""
+
+    def __init__(
+        self,
+        cfg: TrainConfig | None = None,
+        data_path: str = ".",
+        out_dir: str | None = None,
+        mesh="auto",
+        **overrides,
+    ):
+        cfg = (cfg or TrainConfig()).with_overrides(overrides)
+        self.data_path = data_path
+        self.site_dirs = discover_site_dirs(data_path)
+        self.site_cfgs = resolve_site_configs(cfg, data_path, num_sites=len(self.site_dirs))
+        # owner-scoped fields come from site 0 (the reference GUI sends one
+        # owner config; per-site inputspecs override member fields)
+        self.cfg = self.site_cfgs[0].replace(num_sites=len(self.site_dirs))
+        self.out_dir = out_dir or os.path.join(data_path, "output")
+        if mesh == "auto":
+            import jax
+
+            n = len(self.site_dirs)
+            m = max(self.cfg.model_axis_size, 1)
+            k = max(self.cfg.sites_per_device, 1)
+            if n % k:
+                raise ValueError(
+                    f"sites_per_device={k} must divide the site count ({n})"
+                )
+            n_mesh = n // k  # mesh site-axis size; k sites fold per device
+            devs = jax.devices()
+            cpus = [d for d in devs if d.platform == "cpu"]
+            if len(devs) >= n_mesh * m:
+                mesh = make_site_mesh(n_mesh, devs, model_axis_size=m)
+            elif len(cpus) >= n_mesh * m:
+                mesh = host_mesh(n_mesh, model_axis_size=m)
+            elif m > 1:
+                raise ValueError(
+                    f"model_axis_size={m} with {n_mesh} mesh sites needs "
+                    f"{n_mesh * m} devices (have {len(devs)}); sequence "
+                    "parallelism cannot fold onto one device"
+                )
+            else:
+                mesh = None  # fold all sites onto the local device via vmap
+        self.mesh = mesh
+
+    def run(self, folds=None, verbose: bool = True, resume: bool = False) -> list[dict]:
+        """``resume=True`` continues each fold from its last
+        validation-boundary checkpoint; ``cfg.mode == "test"`` skips training
+        and evaluates each fold's best checkpoint."""
+        all_folds = load_site_splits(self.cfg, self.site_dirs, self.site_cfgs)
+        fold_ids = list(range(len(all_folds)))
+        if folds is not None:
+            all_folds = [all_folds[k] for k in folds]
+            fold_ids = list(folds)
+        results = []
+        for k, fold in zip(fold_ids, all_folds):
+            trainer = FederatedTrainer(
+                self.cfg, get_task(self.cfg.task_id).build_model(self.cfg),
+                self.mesh, out_dir=self.out_dir,
+            )
+            res = trainer.fit(
+                fold["train"], fold["validation"], fold["test"], fold=k,
+                verbose=verbose, resume=resume,
+            )
+            results.append(res)
+        return results
+
+
+class SiteRunner:
+    """Single-site harness (reference ``SiteRunner``; the ``taks_id`` typo is
+    the library's kwarg — accepted here for drop-in parity)."""
+
+    def __init__(
+        self,
+        taks_id: str | None = None,
+        task_id: str | None = None,
+        data_path: str = ".",
+        mode: str = "train",
+        seed: int = 0,
+        site_index: int = 0,
+        split_ratio=(0.8, 0.1, 0.1),
+        monitor_metric: str = "auc",
+        metric_direction: str = "maximize",
+        log_header: str = "Loss|AUC",
+        batch_size: int = 16,
+        out_dir: str | None = None,
+        **kw,
+    ):
+        # the reference's taks_id is a short name ('FSL', 'ICA'); map to tasks
+        tid = task_id or {"FSL": "FS-Classification", "ICA": "ICA-Classification"}.get(
+            taks_id, taks_id
+        )
+        self.site_index = site_index
+        self.cfg = TrainConfig(
+            task_id=tid,
+            mode=mode,
+            seed=seed,
+            split_ratio=tuple(split_ratio),
+            monitor_metric=monitor_metric,
+            metric_direction=metric_direction,
+            log_header=log_header,
+            batch_size=batch_size,
+        ).with_overrides(kw)
+        self.data_path = data_path
+        self.out_dir = out_dir
+
+    def run(self, trainer_cls=None, dataset_cls=None, handle_cls=None, verbose=True):
+        """Positional (Trainer, Dataset, DataHandle) accepted for reference
+        signature parity; the registry supplies defaults."""
+        site_dirs = discover_site_dirs(self.data_path)
+        site_cfgs = resolve_site_configs(
+            self.cfg, self.data_path, num_sites=len(site_dirs)
+        )
+        ix = min(self.site_index, len(site_dirs) - 1)
+        cfg = site_cfgs[ix]
+        spec = get_task(cfg.task_id)
+        dataset_cls = dataset_cls or spec.dataset_cls
+        handle_cls = handle_cls or spec.handle_cls
+        ds = build_site_dataset(
+            dataset_cls, handle_cls, task_cache(cfg),
+            {"baseDirectory": site_dirs[ix]}, mode=cfg.mode,
+        )
+        arrs = ds.as_arrays()
+        args = cfg.task_args()
+        splits = resolve_splits(
+            len(arrs),
+            split_ratio=cfg.split_ratio,
+            num_folds=cfg.num_folds,
+            split_files=tuple(getattr(args, "split_files", ()) or ()),
+            base_dir=site_dirs[ix],
+            seed=cfg.seed,
+        )
+        results = []
+        for k, split in enumerate(splits):
+            trainer = FederatedTrainer(
+                cfg, spec.build_model(cfg), mesh=None, out_dir=self.out_dir
+            )
+            results.append(
+                trainer.fit(
+                    [arrs.take(split["train"])],
+                    [arrs.take(split["validation"])],
+                    [arrs.take(split["test"])],
+                    fold=k,
+                    verbose=verbose,
+                )
+            )
+        return results

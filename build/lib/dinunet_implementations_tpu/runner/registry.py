@@ -1,0 +1,121 @@
+"""Task registry: task_id → (model builder, Dataset, DataHandle).
+
+Mirrors the reference's dispatch tables (``local.py:40-47``,
+``remote.py:28-35``) and the ``NNComputation``/``AggEngine`` enums
+(``comps/__init__.py:7-16``). Adding a computation = registering one entry
+(the reference's "Add new NN computation Here" comment, made a table).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Callable
+
+from ..core.config import NNComputation, TrainConfig
+from ..data.api import DataHandle, SiteDataset
+from ..parallel.mesh import MODEL_AXIS
+from ..data.freesurfer import FreeSurferDataset, FSVDataHandle
+from ..data.ica import ICADataHandle, ICADataset
+from ..data.multimodal import MultimodalDataHandle, MultimodalDataset
+from ..data.smri import SMRIDataHandle, SMRIDataset
+from ..models.cnn3d import SMRI3DNet
+from ..models.icalstm import ICALstm
+from ..models.msannet import MSANNet
+from ..models.transformer import MultimodalNet
+
+
+@dataclass(frozen=True)
+class TaskSpec:
+    task_id: str
+    build_model: Callable[[TrainConfig], object]
+    dataset_cls: type[SiteDataset]
+    handle_cls: type[DataHandle]
+
+
+def _build_msannet(cfg: TrainConfig):
+    a = cfg.fs_args
+    return MSANNet(
+        in_size=a.input_size,
+        hidden_sizes=tuple(a.hidden_sizes),
+        out_size=a.num_class,
+    )
+
+
+def _build_icalstm(cfg: TrainConfig):
+    a = cfg.ica_args
+    return ICALstm(
+        input_size=a.input_size,
+        hidden_size=a.hidden_size,
+        bidirectional=a.bidirectional,
+        num_cls=a.num_class,
+        num_comps=a.num_components,
+        window_size=a.window_size,
+        num_layers=a.num_layers,
+        compute_dtype=a.compute_dtype or None,
+        # model_axis_size > 1 → window axis sharded over the mesh model axis
+        # (ring LSTM; parallel/sequence.py)
+        sequence_axis=MODEL_AXIS if cfg.model_axis_size > 1 else None,
+    )
+
+
+def _build_smri3d(cfg: TrainConfig):
+    a = cfg.smri3d_args
+    return SMRI3DNet(channels=tuple(a.channels), num_cls=a.num_class)
+
+
+def _build_multimodal(cfg: TrainConfig):
+    a = cfg.multimodal_args
+    attention = a.attention or ("ring" if cfg.model_axis_size > 1 else "local")
+    if attention == "ring" and cfg.model_axis_size < 2:
+        # forced ring without a model axis would crash much later with an
+        # opaque "unbound axis name" trace error on the vmap-folded path
+        raise ValueError(
+            'attention="ring" needs model_axis_size >= 2 (the token axis '
+            "shards over the mesh model axis)"
+        )
+    return MultimodalNet(
+        fs_input_size=a.fs_input_size,
+        num_comps=a.num_components,
+        window_size=a.window_size,
+        embed_dim=a.embed_dim,
+        num_heads=a.num_heads,
+        num_layers=a.num_layers,
+        mlp_ratio=a.mlp_ratio,
+        num_cls=a.num_class,
+        attention=attention,
+        axis_name=MODEL_AXIS if attention == "ring" else None,
+    )
+
+
+TASKS: dict[str, TaskSpec] = {
+    NNComputation.TASK_FREE_SURFER: TaskSpec(
+        NNComputation.TASK_FREE_SURFER, _build_msannet, FreeSurferDataset, FSVDataHandle
+    ),
+    NNComputation.TASK_ICA: TaskSpec(
+        NNComputation.TASK_ICA, _build_icalstm, ICADataset, ICADataHandle
+    ),
+    NNComputation.TASK_SMRI_3D: TaskSpec(
+        NNComputation.TASK_SMRI_3D, _build_smri3d, SMRIDataset, SMRIDataHandle
+    ),
+    NNComputation.TASK_MULTIMODAL: TaskSpec(
+        NNComputation.TASK_MULTIMODAL, _build_multimodal,
+        MultimodalDataset, MultimodalDataHandle,
+    ),
+}
+
+
+def get_task(task_id: str) -> TaskSpec:
+    if task_id not in TASKS:
+        raise ValueError(f"Invalid task: {task_id!r} (have {sorted(TASKS)})")
+    return TASKS[task_id]
+
+
+def register_task(spec: TaskSpec):
+    TASKS[spec.task_id] = spec
+
+
+def task_cache(cfg: TrainConfig) -> dict:
+    """The flat cache dict datasets consume (the reference merges GUI input
+    into one cache; our datasets read the same keys)."""
+    return dataclasses.asdict(cfg.task_args())

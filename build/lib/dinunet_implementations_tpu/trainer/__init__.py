@@ -1,0 +1,11 @@
+from .checkpoint import load_checkpoint, load_params, save_checkpoint
+from .loop import FederatedTrainer
+from .metrics import Averages, ClassificationMetrics, is_improvement
+from .steps import (
+    FederatedTask,
+    TrainState,
+    init_train_state,
+    make_eval_fn,
+    make_optimizer,
+    make_train_epoch_fn,
+)

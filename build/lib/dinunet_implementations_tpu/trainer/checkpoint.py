@@ -1,0 +1,116 @@
+"""Checkpoint / resume.
+
+The reference's persistence is implicit: cross-round module-level ``CACHE``
+dicts plus library-side best-model files implied by ``best_val_epoch``
+(SURVEY.md §5 checkpoint/resume). Here it is explicit and complete: params +
+batch_stats + optimizer state + engine state + RNG + round counter, serialized
+with flax msgpack. ``save_best``/warm-start covers the reference's
+``pretrain`` largest-site warm start (``compspec.json:120-127``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import flax.serialization
+import jax
+import jax.numpy as jnp
+
+from .steps import TrainState
+
+
+def _atomic_write(path: str, data):
+    """Write via temp file + os.replace so a kill mid-write never leaves a
+    truncated file at ``path`` (resume exists to survive kills)."""
+    mode = "wb" if isinstance(data, bytes) else "w"
+    tmp = path + ".tmp"
+    with open(tmp, mode) as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def save_checkpoint(path: str, state: TrainState, meta: dict | None = None) -> str:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    payload = {
+        "params": state.params,
+        "batch_stats": state.batch_stats,
+        "opt_state": state.opt_state,
+        "engine_state": state.engine_state,
+        "rng": state.rng,
+        "round": state.round,
+        # meta rides INSIDE the msgpack so state+meta are one atomic unit (a
+        # kill between two separate files would pair epoch-N state with
+        # epoch-(N-1) bookkeeping and resume from the wrong epoch)
+        "meta_json": json.dumps(meta or {}),
+    }
+    _atomic_write(path, flax.serialization.to_bytes(payload))
+    if meta is not None:  # human-readable sidecar (non-authoritative)
+        _atomic_write(path + ".meta.json", json.dumps(meta, indent=2, default=float))
+    return path
+
+
+def load_checkpoint(path: str, like: TrainState, with_meta: bool = False):
+    """Restore into the structure of ``like`` (shapes/treedef must match).
+    ``with_meta=True`` also returns the embedded (atomically-paired) meta."""
+    template = {
+        "params": like.params,
+        "batch_stats": like.batch_stats,
+        "opt_state": like.opt_state,
+        "engine_state": like.engine_state,
+        "rng": like.rng,
+        "round": like.round,
+    }
+    with open(path, "rb") as fh:
+        raw = flax.serialization.msgpack_restore(fh.read())
+    # meta_json restored tolerantly: checkpoints written before it existed
+    # (pre-0.2.0) must still resume rather than fail the template match
+    meta_json = raw.pop("meta_json", None)
+    restored = flax.serialization.from_state_dict(template, raw)
+    restored["meta_json"] = meta_json
+    state = TrainState(
+        params=restored["params"],
+        batch_stats=restored["batch_stats"],
+        opt_state=restored["opt_state"],
+        engine_state=restored["engine_state"],
+        rng=jnp.asarray(restored["rng"]),
+        round=jnp.asarray(restored["round"]),
+    )
+    if with_meta:
+        meta = restored.get("meta_json")
+        if isinstance(meta, bytes):
+            meta = meta.decode()
+        return state, json.loads(meta or "{}")
+    return state
+
+
+def load_params(path: str, like_params: Any):
+    """Warm-start: load only params from a checkpoint (pretrain semantics)."""
+    with open(path, "rb") as fh:
+        raw = flax.serialization.msgpack_restore(fh.read())
+    return flax.serialization.from_state_dict(like_params, raw["params"])
+
+
+def load_eval_state(path: str, like_params: Any, like_stats: Any):
+    """Inference-only restore: (params, batch_stats, meta) — no dependency on
+    optimizer/engine-state shapes, so a ``mode="test"`` run works even when
+    its site count differs from the training run's."""
+    with open(path, "rb") as fh:
+        raw = flax.serialization.msgpack_restore(fh.read())
+    params = flax.serialization.from_state_dict(like_params, raw["params"])
+    stats = flax.serialization.from_state_dict(like_stats, raw.get("batch_stats", {}))
+    meta = raw.get("meta_json") or "{}"
+    if isinstance(meta, bytes):
+        meta = meta.decode()
+    return params, stats, json.loads(meta)
+
+
+def checkpoint_meta(path: str) -> dict:
+    mpath = path + ".meta.json"
+    if os.path.exists(mpath):
+        with open(mpath) as fh:
+            return json.load(fh)
+    return {}

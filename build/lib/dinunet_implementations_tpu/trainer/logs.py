@@ -1,0 +1,93 @@
+"""Output/observability writers — byte-compatible with what the reference
+notebooks consume (SURVEY.md §5 metrics/logging):
+
+- ``logs.json`` keys: ``agg_engine``, ``test_metrics`` (nested list, e.g.
+  ``[[loss, auc]]``), ``best_val_epoch``, ``cumulative_total_duration`` (list,
+  cumulative — last entry is the total), ``time_spent_on_computation``
+  (per-round list), ``local_iter_duration`` / ``remote_iter_duration``
+  (``nnlogs.ipynb`` cell 2; ``NB.ipynb`` cells 2-3, 34-36);
+- ``test_metrics.csv``: header + one row where columns [1]=accuracy, [2]=f1
+  (parsed by ``NB.ipynb`` cell 6);
+- directory layout ``<out>/<site>/simulatorRun/<task_id>/fold_<k>/`` as read
+  back by ``NB.ipynb`` cells 33-35, plus the remote's zipped global results
+  (``nnlogs.ipynb`` cell 2 unzips it).
+
+The point: the reference's analysis notebooks should run unmodified against
+our outputs (SURVEY.md §7 'cheap, strong parity check').
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import zipfile
+
+
+def duration(cache: dict, start: float, key: str):
+    """Append elapsed seconds since ``start`` to ``cache[key]`` (reference
+    ``coinstac_dinunet.utils.duration``, used at ``local.py:51-52``)."""
+    cache.setdefault(key, []).append(time.time() - start)
+    return cache[key][-1]
+
+
+def fold_dir(out_dir: str, site: str, task_id: str, fold: int) -> str:
+    d = os.path.join(out_dir, site, "simulatorRun", task_id, f"fold_{fold}")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def write_logs_json(
+    dirpath: str,
+    agg_engine: str,
+    test_metrics: list,
+    best_val_epoch: int,
+    cumulative_total_duration: list,
+    time_spent_on_computation: list,
+    iter_durations: list,
+    side: str = "local",
+    extra: dict | None = None,
+) -> str:
+    log = {
+        "agg_engine": agg_engine,
+        "test_metrics": test_metrics,
+        "best_val_epoch": int(best_val_epoch),
+        "cumulative_total_duration": [round(x, 6) for x in cumulative_total_duration],
+        "time_spent_on_computation": [round(x, 6) for x in time_spent_on_computation],
+        f"{side}_iter_duration": [round(x, 6) for x in iter_durations],
+    }
+    if extra:
+        log.update(extra)
+    os.makedirs(dirpath, exist_ok=True)
+    path = os.path.join(dirpath, "logs.json")
+    with open(path, "w") as fh:
+        json.dump(log, fh, indent=2)
+    return path
+
+
+def write_test_metrics_csv(dirpath: str, fold: int, metrics: dict) -> str:
+    """``metrics``: mapping name → value; accuracy and f1 must be present (the
+    notebook indexes columns 1 and 2)."""
+    names = ["accuracy", "f1"] + [k for k in metrics if k not in ("accuracy", "f1")]
+    os.makedirs(dirpath, exist_ok=True)
+    path = os.path.join(dirpath, "test_metrics.csv")
+    with open(path, "w") as fh:
+        fh.write("fold," + ",".join(names) + "\n")
+        fh.write(f"fold_{fold}," + ",".join(f"{metrics[n]:.5f}" for n in names) + "\n")
+    return path
+
+
+def zip_global_results(out_dir: str, remote_site: str = "remote") -> str:
+    """Zip the remote's result tree into the transfer output, like the
+    reference remote does (``nnlogs.ipynb`` cell 2 finds a ``.zip`` next to
+    the task dir and extracts ``fold_k/logs.json`` from it)."""
+    remote_dir = os.path.join(out_dir, remote_site, "simulatorRun")
+    zpath = os.path.join(out_dir, remote_site, "global_results.zip")
+    with zipfile.ZipFile(zpath, "w") as zf:
+        for root, _, files in os.walk(remote_dir):
+            for f in files:
+                full = os.path.join(root, f)
+                # archive paths start at the task level: <task>/fold_k/...
+                rel = os.path.relpath(full, remote_dir)
+                zf.write(full, rel)
+    return zpath

@@ -1,0 +1,394 @@
+"""The SPMD federated train/eval steps — where the reference's entire
+local↔remote round trip collapses into one compiled program.
+
+Reference execution (SURVEY.md §3.1): per round, every site container steps
+``local_iterations`` batches with gradient accumulation, JSON-ships its
+(possibly compressed) gradient to the remote, the remote reduces across sites
+on an mp.Pool and broadcasts the update back. ~97% of wall-clock was that
+transport. Here:
+
+- one epoch = ``jax.lax.scan`` over rounds *inside* a single ``shard_map``
+  over the ``(site,)`` mesh — zero host round trips;
+- gradient accumulation = inner ``lax.scan`` over ``local_iterations``
+  micro-batches (``compspec.json:88-95``);
+- the engine's collectives (psum / all-gather, engines/) are the only
+  cross-site communication, riding ICI;
+- parameters & optimizer state are replicated (every site applies the same
+  aggregated update — the invariant the reference maintains by broadcast).
+
+BatchNorm running stats (ICALstm head) are psum-averaged across sites each
+round ("sync-BN across sites"): the reference lets per-site buffers drift and
+never reconciles them; averaging is the principled SPMD equivalent and keeps
+eval single-model. Documented TPU-design divergence.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..engines.base import Engine
+from ..parallel.collectives import site_weight_scale
+from ..parallel.mesh import FOLD_AXIS, MODEL_AXIS, SITE_AXIS
+
+
+def _model_axis_of(mesh) -> str | None:
+    """The bound model/sequence axis name, when the mesh has one of size > 1.
+
+    With a ``(site, model)`` mesh the data stays partitioned over ``site``
+    only — every model-axis member sees the full per-site batch and the model
+    internally shards its sequence axis (models/icalstm.py sequence_axis,
+    models/transformer.py attention="ring")."""
+    if mesh is not None and dict(getattr(mesh, "shape", {})).get(MODEL_AXIS, 1) > 1:
+        return MODEL_AXIS
+    return None
+
+
+@flax.struct.dataclass
+class TrainState:
+    params: Any
+    batch_stats: Any  # {} when the model tracks no running stats
+    opt_state: Any
+    engine_state: Any  # PER-SITE: leaves carry a leading [num_sites] axis
+    rng: jax.Array
+    round: jax.Array  # global round counter (int32)
+
+
+def _state_specs(state: TrainState):
+    """shard_map partition specs: everything replicated except the per-site
+    engine state (e.g. powerSGD's error-feedback residual), which is sharded
+    over the site axis — collapsing it to one site's copy would silently break
+    error feedback across epoch boundaries."""
+    return TrainState(
+        params=jax.tree.map(lambda _: P(), state.params),
+        batch_stats=jax.tree.map(lambda _: P(), state.batch_stats),
+        opt_state=jax.tree.map(lambda _: P(), state.opt_state),
+        engine_state=jax.tree.map(lambda _: P(SITE_AXIS), state.engine_state),
+        rng=P(),
+        round=P(),
+    )
+
+
+def _state_axes():
+    """vmap in/out axes: engine_state mapped over sites, the rest broadcast."""
+    return TrainState(
+        params=None, batch_stats=None, opt_state=None, engine_state=0,
+        rng=None, round=None,
+    )
+
+
+def make_optimizer(name: str, learning_rate: float) -> optax.GradientTransformation:
+    """Reference trains with Adam at ``learning_rate`` (coinstac-dinunet
+    default); SGD kept as an option."""
+    if name == "adam":
+        return optax.adam(learning_rate)
+    if name == "sgd":
+        return optax.sgd(learning_rate)
+    raise ValueError(f"unknown optimizer {name!r}")
+
+
+def cross_entropy(logits, labels, weights):
+    """Masked mean cross-entropy. FS uses log_softmax+NLL, ICA uses
+    cross_entropy — identical math (``comps/fs/__init__.py:54-55``,
+    ``comps/icalstm/__init__.py:60``)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ce = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    denom = jnp.maximum(weights.sum(), 1.0)
+    return (ce * weights).sum() / denom
+
+
+class FederatedTask:
+    """Bundles a flax model with its loss/apply plumbing for the trainer."""
+
+    def __init__(self, model, has_batch_stats: bool | None = None):
+        self.model = model
+        self.has_batch_stats = has_batch_stats  # resolved at init_variables
+
+    def init_variables(self, rng, sample_x):
+        # init runs OUTSIDE shard_map (no mesh axis bound), so a model
+        # configured for sequence parallelism initializes via a dense twin —
+        # submodule names/shapes are identical by construction, only the
+        # collective plumbing differs
+        model = self.model
+        dense_kw = {}
+        if getattr(model, "sequence_axis", None) is not None:
+            dense_kw["sequence_axis"] = None
+        if getattr(model, "attention", None) == "ring":
+            dense_kw.update(attention="local", axis_name=None)
+        if dense_kw:
+            model = model.clone(**dense_kw)
+        variables = model.init(
+            {"params": rng, "dropout": rng}, sample_x, train=True
+        )
+        self.has_batch_stats = "batch_stats" in variables
+        return variables["params"], variables.get("batch_stats", {})
+
+    def apply(self, params, batch_stats, x, train, rng=None, mask=None, mutable=False):
+        variables = {"params": params}
+        if self.has_batch_stats:
+            variables["batch_stats"] = batch_stats
+        rngs = {"dropout": rng} if rng is not None else None
+        if mutable and self.has_batch_stats:
+            logits, upd = self.model.apply(
+                variables, x, train=train, mask=mask, rngs=rngs, mutable=["batch_stats"]
+            )
+            return logits, upd["batch_stats"]
+        logits = self.model.apply(variables, x, train=train, mask=mask, rngs=rngs)
+        return logits, batch_stats
+
+
+def init_train_state(
+    task: FederatedTask,
+    engine: Engine,
+    optimizer: optax.GradientTransformation,
+    rng,
+    sample_x,
+    num_sites: int = 1,
+) -> TrainState:
+    params, batch_stats = task.init_variables(rng, sample_x)
+    site_state = engine.init(params)
+    return TrainState(
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=optimizer.init(params),
+        # per-site engine state: one copy per site, leading [num_sites] axis
+        engine_state=jax.tree.map(
+            lambda a: jnp.stack([a] * num_sites), site_state
+        ),
+        rng=rng,
+        round=jnp.zeros((), jnp.int32),
+    )
+
+
+def make_train_epoch_fn(
+    task: FederatedTask,
+    engine: Engine,
+    optimizer: optax.GradientTransformation,
+    mesh=None,
+    local_iterations: int = 1,
+):
+    """Build the jitted epoch function.
+
+    Takes ``(state, inputs [S,steps,B,...], labels [S,steps,B],
+    weights [S,steps,B])``; consumes ``steps`` in rounds of
+    ``local_iterations`` micro-batches (trailing remainder < local_iterations
+    is dropped, mirroring drop_last at round granularity); returns
+    ``(state, per-round weighted loss [rounds])``.
+
+    Site-axis realization (both run the *same* per-site program):
+
+    - ``mesh`` given → ``shard_map`` over the mesh's ``site`` axis: one site
+      per device (slice), collectives ride ICI. The multi-chip path.
+    - ``mesh=None`` → ``jax.vmap(axis_name="site")``: all S sites fold onto
+      the local device as a batched dimension; ``psum``/``all_gather`` resolve
+      over the vmapped axis. This is how one TPU chip simulates 32 federated
+      sites (BASELINE.json north star) at full MXU utilization.
+    """
+
+    model_axis = _model_axis_of(mesh)
+
+    def loss_fn(params, batch_stats, rng, x, y, w):
+        logits, new_stats = task.apply(
+            params, batch_stats, x, train=True, rng=rng, mask=w, mutable=True
+        )
+        loss = cross_entropy(logits, y, w)
+        if model_axis is not None:
+            # The forward runs on every model-axis member (sequence-sharded
+            # inside the model, logits replicated by its final gather), so an
+            # unmasked loss would seed the head cotangent once PER member and
+            # the later grad psum would count head grads n×. Keep member 0's
+            # loss only: its cotangent reaches every member's sequence chunk
+            # through the transposed collectives (reduce-scatter / ppermute),
+            # and the psum over the axis then assembles the exact full grad.
+            keep = (jax.lax.axis_index(model_axis) == 0).astype(loss.dtype)
+            loss = loss * keep
+        return loss, new_stats
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def per_site_epoch(state: TrainState, x, y, w, site_axes=SITE_AXIS):
+        # x: [steps, B, ...] — one site's epoch. ``site_axes`` is the bound
+        # axis (or (mesh, vmap-fold) axis pair when several sites share one
+        # device) that cross-site collectives reduce over; axis_index over the
+        # pair linearizes to the same global site order as the data layout.
+        steps = x.shape[0]
+        rounds = steps // local_iterations
+        L = rounds * local_iterations
+        xr = x[:L].reshape((rounds, local_iterations) + x.shape[1:])
+        yr = y[:L].reshape((rounds, local_iterations) + y.shape[1:])
+        wr = w[:L].reshape((rounds, local_iterations) + w.shape[1:])
+
+        site_ix = jax.lax.axis_index(site_axes)
+
+        def one_round(carry, batch):
+            params, batch_stats, opt_state, engine_state, rng, rnd = carry
+            xb, yb, wb = batch  # [L, B, ...]
+
+            rng, sub = jax.random.split(rng)
+
+            def micro(acc, mb):
+                g_sum, n_sum, stats = acc
+                xm, ym, wm, i = mb
+                key_i = jax.random.fold_in(jax.random.fold_in(sub, site_ix), i)
+                (loss, new_stats), grads = grad_fn(params, stats, key_i, xm, ym, wm)
+                if model_axis is not None:
+                    # assemble the full gradient (and un-mask the loss scalar)
+                    # from the per-member pieces — see loss_fn
+                    grads = jax.lax.psum(grads, model_axis)
+                    loss = jax.lax.psum(loss, model_axis)
+                n = wm.sum()
+                g_sum = jax.tree.map(lambda a, g: a + g * n, g_sum, grads)
+                return (g_sum, n_sum + n, new_stats), loss * n
+
+            g0 = jax.tree.map(jnp.zeros_like, params)
+            (g_sum, n_sum, new_stats), loss_sums = jax.lax.scan(
+                micro,
+                (g0, jnp.zeros(()), batch_stats),
+                (xb, yb, wb, jnp.arange(local_iterations)),
+            )
+            site_grad = jax.tree.map(
+                lambda g: g / jnp.maximum(n_sum, 1.0), g_sum
+            )
+            agg, engine_state = engine.aggregate(
+                site_grad, engine_state, n_sum, site_axes
+            )
+            updates, opt_state = optimizer.update(agg, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            # sync-BN: example-weighted average of per-site running stats
+            if task.has_batch_stats:
+                scale = site_weight_scale(n_sum, site_axes)
+                new_stats = jax.tree.map(
+                    lambda s: jax.lax.psum(s * scale, site_axes), new_stats
+                )
+            # round-weighted global loss (for logs): psum of per-site sums
+            loss_round = jax.lax.psum(loss_sums.sum(), site_axes) / jnp.maximum(
+                jax.lax.psum(n_sum, site_axes), 1.0
+            )
+            return (params, new_stats, opt_state, engine_state, rng, rnd + 1), loss_round
+
+        carry0 = (
+            state.params,
+            state.batch_stats,
+            state.opt_state,
+            state.engine_state,
+            jax.random.fold_in(state.rng, state.round),
+            state.round,
+        )
+        (params, stats, opt_state, engine_state, rng, rnd), losses = jax.lax.scan(
+            one_round, carry0, (xr, yr, wr)
+        )
+        new_state = TrainState(
+            params=params,
+            batch_stats=stats,
+            opt_state=opt_state,
+            engine_state=engine_state,
+            rng=state.rng,
+            round=rnd,
+        )
+        return new_state, losses
+
+    if mesh is not None:
+
+        def shard_wrapped(st, x, y, w):
+            # x: [k, steps, B, ...] — this device's block of k sites. k > 1 is
+            # the folded case (cfg.sites_per_device: more simulated sites than
+            # devices); the block runs as an inner vmap with cross-site
+            # collectives spanning the (mesh site, fold) axis pair. k == 1 is
+            # the one-site-per-device case, same program.
+            new_state, losses = jax.vmap(
+                lambda s_, x_, y_, w_: per_site_epoch(
+                    s_, x_, y_, w_, site_axes=(SITE_AXIS, FOLD_AXIS)
+                ),
+                in_axes=(_state_axes(), 0, 0, 0),
+                out_axes=(0, 0),
+                axis_name=FOLD_AXIS,
+            )(st, x, y, w)
+            # collectives make every site's copy identical — keep block row 0
+            # of everything EXCEPT the per-site engine state
+            collapsed = jax.tree.map(lambda a: a[0], new_state)
+            collapsed = collapsed.replace(engine_state=new_state.engine_state)
+            return collapsed, losses[0]
+
+        @jax.jit
+        def epoch_fn(state: TrainState, inputs, labels, weights):
+            specs = _state_specs(state)
+            return shard_map(
+                shard_wrapped,
+                mesh=mesh,
+                in_specs=(specs, P(SITE_AXIS), P(SITE_AXIS), P(SITE_AXIS)),
+                out_specs=(specs, P()),
+                check_vma=False,
+            )(state, inputs, labels, weights)
+
+    else:
+
+        @jax.jit
+        def epoch_fn(state: TrainState, inputs, labels, weights):
+            new_state, losses = jax.vmap(
+                per_site_epoch,
+                in_axes=(_state_axes(), 0, 0, 0),
+                out_axes=(0, 0),
+                axis_name=SITE_AXIS,
+            )(state, inputs, labels, weights)
+            # psum makes every site's output identical (keep replica 0) —
+            # EXCEPT the per-site engine state, which must stay per-site
+            collapsed = jax.tree.map(lambda a: a[0], new_state)
+            collapsed = collapsed.replace(engine_state=new_state.engine_state)
+            return collapsed, losses[0]
+
+    return epoch_fn
+
+
+def make_eval_fn(task: FederatedTask, mesh=None):
+    """Jitted full-pass eval: returns per-site ``probs [S, steps, B, C]``,
+    ``loss_sum [S]``, ``weight_sum [S]`` — metric scalars are computed
+    host-side (trainer/metrics.py). ``mesh=None`` folds sites via vmap, as in
+    :func:`make_train_epoch_fn`."""
+
+    def per_site_eval(params, batch_stats, x, y, w):
+        def step(_, batch):
+            xb, yb, wb = batch
+            logits, _ = task.apply(params, batch_stats, xb, train=False, mask=wb)
+            logp = jax.nn.log_softmax(logits, -1)
+            ce = -jnp.take_along_axis(logp, yb[..., None].astype(jnp.int32), -1)[..., 0]
+            return None, (jax.nn.softmax(logits, -1), (ce * wb).sum())
+
+        _, (probs, loss_sums) = jax.lax.scan(step, None, (x, y, w))
+        return probs, loss_sums.sum(), w.sum()
+
+    if mesh is not None:
+
+        @jax.jit
+        def eval_fn(state: TrainState, inputs, labels, weights):
+            return shard_map(
+                # inner vmap over the device's site block (k ≥ 1 folded sites)
+                lambda p, s, x, y, w: jax.vmap(
+                    per_site_eval, in_axes=(None, None, 0, 0, 0)
+                )(p, s, x, y, w),
+                mesh=mesh,
+                in_specs=(
+                    jax.tree.map(lambda _: P(), state.params),
+                    jax.tree.map(lambda _: P(), state.batch_stats),
+                    P(SITE_AXIS),
+                    P(SITE_AXIS),
+                    P(SITE_AXIS),
+                ),
+                out_specs=(P(SITE_AXIS), P(SITE_AXIS), P(SITE_AXIS)),
+                check_vma=False,
+            )(state.params, state.batch_stats, inputs, labels, weights)
+
+    else:
+
+        @jax.jit
+        def eval_fn(state: TrainState, inputs, labels, weights):
+            return jax.vmap(per_site_eval, in_axes=(None, None, 0, 0, 0))(
+                state.params, state.batch_stats, inputs, labels, weights
+            )
+
+    return eval_fn

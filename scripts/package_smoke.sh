@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Package smoke test (VERDICT r2 #8): build the wheel, install it into a
+# clean target directory (this environment has no network and is itself a
+# venv, so a nested venv can't see jax — PYTHONPATH-target isolation proves
+# the same thing: OUR wheel, not the repo checkout, provides the package),
+# and run the README quick-start on the reference fixture from a neutral
+# working directory.
+set -euo pipefail
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+WORK="${1:-$(mktemp -d)}"
+FIXTURE="${FIXTURE:-/root/reference/datasets/test_fsl}"
+
+cd "$WORK"
+python -m pip wheel --no-deps --no-build-isolation -w "$WORK/dist" "$REPO" >/dev/null
+WHEEL="$(ls "$WORK"/dist/dinunet_implementations_tpu-*.whl)"
+python -m pip install --no-deps --target "$WORK/site" "$WHEEL" >/dev/null
+
+cd "$WORK"  # neutral cwd: the repo checkout must NOT be importable
+PYTHONPATH="$WORK/site" JAX_PLATFORMS=cpu python - <<EOF
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+import dinunet_implementations_tpu as dt
+assert dt.__file__.startswith("$WORK/site"), (
+    f"imported from {dt.__file__}, not the installed wheel"
+)
+
+from dinunet_implementations_tpu import TrainConfig
+from dinunet_implementations_tpu.runner import FedRunner
+
+cfg = TrainConfig(agg_engine="dSGD", epochs=2, batch_size=8,
+                  split_ratio=(0.7, 0.15, 0.15))
+results = FedRunner(cfg, data_path="$FIXTURE", out_dir="$WORK/out").run(verbose=False)
+loss, auc = results[0]["test_metrics"][0]
+assert 0 <= auc <= 1 and loss > 0
+print(f"package smoke OK: wheel install + quick-start trained (loss={loss}, auc={auc})")
+EOF

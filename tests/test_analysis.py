@@ -1,0 +1,40 @@
+"""Pretrain k-fold study (VERDICT r2 #5): reproduce the reference's
+NB.ipynb cells 6-17 convergence comparison in-repo, reading back our own
+logs.json artifacts."""
+
+import os
+
+import pytest
+
+from dinunet_implementations_tpu.analysis import pretrain_study
+
+FSL = "/root/reference/datasets/test_fsl"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(FSL), reason="reference fixture not mounted"
+)
+
+
+@pytest.mark.golden
+def test_pretrain_study_shows_faster_convergence(tmp_path):
+    """The reference's claim (mean stop epoch 68.5 scratch vs 42.7
+    pretrained): the pretrained arm must converge at least as fast, at
+    comparable accuracy. 3 folds of the 5-site fixture, seed 0 —
+    deterministic on the CPU simulator (measured 37.7 vs 35.0 epochs)."""
+    report = pretrain_study(
+        FSL, str(tmp_path), num_folds=5, pretrain_epochs=20, folds=[0, 1, 2]
+    )
+    s = report["arms"]["scratch"]
+    p = report["arms"]["pretrained"]
+    assert p["mean_best_val_epoch"] <= s["mean_best_val_epoch"], (
+        f"pretrained arm converged SLOWER: {p['mean_best_val_epoch']:.1f} vs "
+        f"{s['mean_best_val_epoch']:.1f} epochs"
+    )
+    assert p["mean_test_auc"] >= s["mean_test_auc"] - 0.05, (
+        "pretraining degraded accuracy beyond tolerance"
+    )
+    # report artifacts exist and carry the table
+    md = open(os.path.join(tmp_path, "pretrain_study.md")).read()
+    assert "| scratch |" in md and "| pretrained |" in md
+    csv_text = open(os.path.join(tmp_path, "pretrain_study.csv")).read()
+    assert csv_text.count("\n") >= 7  # header + 2 arms x 3 folds

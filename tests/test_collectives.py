@@ -73,6 +73,10 @@ def test_payload_cast_roundtrip():
     assert back["w"].dtype == jnp.float32
     same = payload_cast(tree, "32")
     assert same["w"].dtype == jnp.float32
+    # compat mode: the reference's literal IEEE fp16 payload
+    # (compspec.json:161-176) — "16" is bf16 on TPU, "16-ieee" opts into fp16
+    ieee = payload_cast(tree, "16-ieee")
+    assert ieee["w"].dtype == jnp.float16
 
 
 def test_weighted_mean_accumulates_fp32():

@@ -34,6 +34,89 @@ REFERENCE_AUC = {  # nnlogs.ipynb cell 2 (BASELINE.md)
 
 
 @pytest.mark.golden
+def test_two_site_matches_reference_setup(tmp_path):
+    """VERDICT r2 #9: apples-to-apples with the reference's published table —
+    its numbers come from a 2-site run (``fs-lstm_2S``, nnlogs.ipynb cell 2).
+    Restrict the fixture to local0/local1 with compspec defaults and assert
+    the same [loss, AUC] row beats the reference's dSGD 0.81404."""
+    import json
+
+    two = tmp_path / "fsl2"
+    (two / "input").mkdir(parents=True)
+    for site in ("local0", "local1"):
+        os.symlink(
+            os.path.join(FSL, "input", site), str(two / "input" / site)
+        )
+    spec = json.load(open(os.path.join(FSL, "inputspec.json")))
+    (two / "inputspec.json").write_text(json.dumps(spec[:2]))
+
+    cfg = TrainConfig(
+        agg_engine="dSGD", epochs=101, patience=35,
+        split_ratio=(0.7, 0.15, 0.15), seed=0,
+    )
+    res = FedRunner(cfg, data_path=str(two), out_dir=str(tmp_path / "out")).run(
+        verbose=False
+    )[0]
+    loss, auc = res["test_metrics"][0]
+    assert auc >= 0.81404, (
+        f"2-site dSGD AUC {auc:.4f} below the reference's 2-site 0.81404"
+    )
+    assert math.isfinite(loss)
+
+
+def _make_hard_ica_tree(root, n_sites=3, subjects=24, comps=8, temporal=40,
+                        window=5, stride=5, seed=7, shift=0.35):
+    """Synthetic ICA simulator tree at a deliberately weak SNR: the class
+    signal is a +0.35σ shift in 2 of 8 components (the e2e runner tests use
+    an easy +2σ shift on every component). Same layout as the reference's
+    fixture convention (datasets/icalstm/inputspec.json shapes, scaled down)."""
+    import json as _json
+
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    spec = []
+    for i in range(n_sites):
+        d = root / "input" / f"local{i}" / "simulatorRun"
+        d.mkdir(parents=True)
+        y = rng.integers(0, 2, subjects)
+        X = rng.normal(size=(subjects, comps, temporal)).astype(np.float32)
+        X[:, :2] += (y[:, None, None] * shift).astype(np.float32)
+        np.savez(d / "timecourses.npz", X)
+        with open(d / "labels.csv", "w") as fh:
+            fh.write("index,label\n")
+            for j in range(subjects):
+                fh.write(f"{j},{int(y[j])}\n")
+        spec.append({k: {"value": v} for k, v in dict(
+            data_file="timecourses.npz", labels_file="labels.csv",
+            temporal_size=temporal, window_size=window, window_stride=stride,
+            num_components=comps, input_size=16, hidden_size=12, num_class=2,
+        ).items()})
+    (root / "inputspec.json").write_text(_json.dumps(spec))
+
+
+@pytest.mark.golden
+@pytest.mark.parametrize("engine", ["dSGD", "powerSGD"])
+def test_ica_converges_at_hard_snr(engine, tmp_path):
+    """VERDICT r2 #6: ICA golden regression — the fixture AUC floor for the
+    plain and one compressed engine (measured 0.94 at seed 0 for both)."""
+    _make_hard_ica_tree(tmp_path)
+    cfg = TrainConfig(
+        task_id="ICA-Classification", agg_engine=engine, epochs=60,
+        patience=20, batch_size=8, split_ratio=(0.7, 0.15, 0.15), seed=0,
+    )
+    res = FedRunner(
+        cfg, data_path=str(tmp_path), out_dir=str(tmp_path / "out")
+    ).run(verbose=False)[0]
+    loss, auc = res["test_metrics"][0]
+    assert auc >= 0.85, (
+        f"ICA {engine}: test AUC {auc:.4f} under the 0.85 golden floor "
+        f"(best_val_epoch={res['best_val_epoch']})"
+    )
+    assert math.isfinite(loss)
+
+
+@pytest.mark.golden
 @pytest.mark.parametrize("engine", ["dSGD", "rankDAD", "powerSGD"])
 def test_engine_converges_to_reference_grade_auc(engine, tmp_path):
     cfg = TrainConfig(

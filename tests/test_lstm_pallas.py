@@ -244,6 +244,33 @@ def test_lstm_recurrence_direct_f32_x_bf16_compute_grad():
     assert np.isfinite(np.asarray(g)).all()
 
 
+def test_fused_grad_with_bf16_weights_matches_primal_dtypes():
+    """Review regression (r3): a direct lstm_recurrence_fused call with
+    non-f32 weights must return cotangents at the PRIMAL dtypes (custom_vjp
+    aval check) — dwih/db/dwhh, not just dx."""
+    from dinunet_implementations_tpu.ops.lstm_pallas import lstm_recurrence_fused
+
+    B, T, D, H = 4, 5, 6, 8
+    key = jax.random.PRNGKey(11)
+    bf16 = jnp.bfloat16
+    x = jax.random.normal(key, (T, B, D)).astype(bf16)
+    wih4 = (jax.random.normal(key, (4, D, H)) * 0.2).astype(bf16)
+    b4 = jnp.zeros((4, H), bf16)
+    whh4 = (jax.random.normal(key, (4, H, H)) * 0.2).astype(bf16)
+    h0 = jnp.zeros((B, H))
+    c0 = jnp.zeros((B, H))
+
+    def loss(x, wih4, b4, whh4):
+        hs, _ = lstm_recurrence_fused(x, wih4, b4, whh4, h0, c0, bf16)
+        return jnp.sum(hs.astype(jnp.float32) ** 2)
+
+    gx, gwih, gb, gwhh = jax.grad(loss, argnums=(0, 1, 2, 3))(x, wih4, b4, whh4)
+    assert gx.dtype == bf16 and gwih.dtype == bf16
+    assert gb.dtype == bf16 and gwhh.dtype == bf16
+    for g in (gx, gwih, gb, gwhh):
+        assert np.isfinite(np.asarray(g, np.float32)).all()
+
+
 def test_fused_terminal_carry_is_f32_even_under_bf16():
     """Ring-relay contract: (hT, cT) come from the kernel's f32 scratch, not
     the bf16 streams — so chunk-boundary relays never quantize the carry."""

@@ -1,0 +1,24 @@
+"""Package smoke (VERDICT r2 #8): the wheel installs into a clean target and
+the README quick-start runs without the repo checkout on sys.path."""
+
+import os
+import subprocess
+
+import pytest
+
+SCRIPT = os.path.join(os.path.dirname(__file__), "..", "scripts", "package_smoke.sh")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir("/root/reference/datasets/test_fsl"),
+    reason="reference fixture not mounted",
+)
+
+
+@pytest.mark.golden
+def test_wheel_install_and_quickstart(tmp_path):
+    proc = subprocess.run(
+        ["bash", SCRIPT, str(tmp_path)], capture_output=True, text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    assert "package smoke OK" in proc.stdout
