@@ -90,6 +90,7 @@ def pretrain_study(
         fold_ids = list(folds) if folds is not None else list(range(len(results)))
         logs = _read_fold_logs(arm_out, runner.cfg.task_id, fold_ids)
         stats = _arm_stats(logs)
+        stats["fold_ids"] = fold_ids
         for lg, res in zip(logs, results):
             assert lg["best_val_epoch"] == res["best_val_epoch"], (
                 "logs.json disagrees with the in-memory result"
@@ -132,8 +133,9 @@ def pretrain_study(
         wr.writerow(["arm", "fold", "best_val_epoch", "test_auc", "test_loss"])
         for name, stats in report["arms"].items():
             rows = zip(
-                stats["best_val_epochs"], stats["test_aucs"], stats["test_losses"]
+                stats["fold_ids"], stats["best_val_epochs"],
+                stats["test_aucs"], stats["test_losses"],
             )
-            for k, (ep, auc, loss) in enumerate(rows):
+            for k, ep, auc, loss in rows:
                 wr.writerow([name, k, ep, auc, loss])
     return report

@@ -37,12 +37,56 @@ def from_matrix(mat, like):
     return mat.reshape(like.shape).astype(like.dtype)
 
 
+def _cholqr(Y):
+    """Column-normalized shifted CholeskyQR2 of ``Y [m, r]`` → ``(Q, colnorm)``.
+
+    TPU-first replacement for ``jnp.linalg.qr``: Householder QR lowers to a
+    long sequential scalar loop on TPU, while this is two matmuls plus an
+    ``[r, r]`` Cholesky + triangular solve per round (r ≤ rank, default 10) —
+    MXU/batch friendly, and (unlike an eigh-based Löwdin orthonormalization,
+    which was tried and reverted) CONTINUOUS in Y: float-noise between the
+    vmapped and unbatched lowerings stays proportional instead of being
+    amplified by near-degenerate eigen-subspace mixing.
+
+    Each round first normalizes columns, so the trace-relative Cholesky shift
+    is a PER-COLUMN relative floor rather than a global one — a naive
+    ``shift·trace`` floor is dominated by σ₁ and collapses every direction
+    with σᵢ² ≲ √shift·σ₁² (review finding r3; measured rec-error 16× worse on
+    a decaying spectrum). With normalization the variant matches Householder
+    QR's orthogonality (~6e-7) and reconstruction error on spectra spanning
+    4 decades, while staying NaN-safe for rank-deficient / all-zero Y (true
+    gradient rank is routinely < r, e.g. bounded by the batch size).
+    ``colnorm`` is the pre-normalization column-norm vector of the first
+    round — the σ-scale convergence proxy.
+    """
+    r = Y.shape[1]
+    eye = jnp.eye(r, dtype=Y.dtype)
+
+    def once(Y, shift):
+        nc = jnp.linalg.norm(Y, axis=0)
+        Y = Y / jnp.maximum(nc, 1e-30)
+        Gm = Y.T @ Y
+        L = jnp.linalg.cholesky(Gm + (shift * jnp.trace(Gm) + 1e-30) * eye)
+        Q = jax.scipy.linalg.solve_triangular(L, Y.T, lower=True).T
+        return Q, nc
+
+    Q1, colnorm = once(Y, 1e-6)
+    Q2, _ = once(Q1, 1e-7)
+    return Q2, colnorm
+
+
 def subspace_iteration(G, rank: int, num_iters: int, tol: float, key=None):
     """Rank-r factorization ``G ≈ P @ Q^T`` by subspace (block power) iteration.
 
     P is [m, r] orthonormal, Q = G^T P is [n, r]. Early-exits when the relative
     change of the singular-value estimates drops below ``tol`` (the
     ``dad_tol`` semantics), else runs ``num_iters`` (``dad_num_pow_iters``).
+
+    Orthonormalization is column-normalized CholeskyQR2 (see :func:`_cholqr`)
+    and the singular-value estimates come from its column norms for free —
+    ``‖(G Gᵀ P)ᵢ‖`` estimates σᵢ², so ``sqrt`` puts the convergence test on
+    the same σ scale the reference's ``dad_tol`` means, without the extra
+    full ``Gᵀ P`` matmul per iteration a direct estimate would cost.
     """
     G = G.astype(jnp.float32)
     m, n = G.shape
@@ -51,8 +95,8 @@ def subspace_iteration(G, rank: int, num_iters: int, tol: float, key=None):
         key = jax.random.PRNGKey(m * 1000003 + n)
     omega = jax.random.normal(key, (n, r), jnp.float32)
     Y = G @ omega  # [m, r]
-    P0, _ = jnp.linalg.qr(Y)
-    sig0 = jnp.linalg.norm(G.T @ P0, axis=0)  # [r] singular-value estimates
+    P0, _ = _cholqr(Y)
+    sig0 = jnp.linalg.norm(G.T @ P0, axis=0)  # [r] σ estimates, column order
 
     def cond(carry):
         i, _, _, delta = carry
@@ -60,9 +104,8 @@ def subspace_iteration(G, rank: int, num_iters: int, tol: float, key=None):
 
     def body(carry):
         i, P, sig, _ = carry
-        Y = G @ (G.T @ P)
-        P_new, _ = jnp.linalg.qr(Y)
-        sig_new = jnp.linalg.norm(G.T @ P_new, axis=0)
+        P_new, colnorm = _cholqr(G @ (G.T @ P))
+        sig_new = jnp.sqrt(colnorm)  # ‖G Gᵀ p‖ ≈ σ² → σ scale (see docstring)
         delta = jnp.linalg.norm(sig_new - sig) / jnp.maximum(jnp.linalg.norm(sig), 1e-12)
         return i + 1, P_new, sig_new, delta
 
@@ -75,6 +118,6 @@ def subspace_iteration(G, rank: int, num_iters: int, tol: float, key=None):
 
 
 def orthonormalize(P):
-    """QR-based orthonormalization (columns)."""
-    Q, _ = jnp.linalg.qr(P)
+    """Orthonormalize columns (shifted CholeskyQR2 — see :func:`_cholqr`)."""
+    Q, _ = _cholqr(P)
     return Q

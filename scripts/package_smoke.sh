@@ -13,6 +13,10 @@ FIXTURE="${FIXTURE:-/root/reference/datasets/test_fsl}"
 
 cd "$WORK"
 python -m pip wheel --no-deps --no-build-isolation -w "$WORK/dist" "$REPO" >/dev/null
+# setuptools writes build/ + *.egg-info into the source tree under
+# --no-build-isolation; don't leave artifacts in the repo (they must never
+# be committed — a stale copy shadowing the real module is a trap)
+rm -rf "$REPO/build" "$REPO"/*.egg-info
 WHEEL="$(ls "$WORK"/dist/dinunet_implementations_tpu-*.whl)"
 python -m pip install --no-deps --target "$WORK/site" "$WHEEL" >/dev/null
 
