@@ -124,6 +124,8 @@ class SMRI3DArgs:
     num_class: int = 2
     volume_shape: tuple = (64, 64, 64)
     channels: tuple = (16, 32, 64, 128)
+    # "bfloat16" = bf16 convolutions with f32 BatchNorm/head; "" = full f32
+    compute_dtype: str = ""
     dad_reduction_rank: int = 10
     dad_num_pow_iters: int = 5
     dad_tol: float = 1e-3
@@ -151,6 +153,8 @@ class MultimodalArgs:
     mlp_ratio: int = 4
     # "" = auto: ring attention iff model_axis_size > 1; "local"/"ring" force
     attention: str = ""
+    # "bfloat16" = bf16 matmuls with f32 softmax/LayerNorm; "" = full f32
+    compute_dtype: str = ""
     dad_reduction_rank: int = 10
     dad_num_pow_iters: int = 5
     dad_tol: float = 1e-3

@@ -64,7 +64,13 @@ def _cholqr(Y):
 
     def once(Y, shift):
         nc = jnp.linalg.norm(Y, axis=0)
-        Y = Y / jnp.maximum(nc, 1e-30)
+        # exactly-zero columns take canonical basis vectors, so a zero input
+        # still yields an ORTHONORMAL Q — matching Householder QR's behavior.
+        # powerSGD warm-starts its q factor from the previous round's P; a
+        # P=0 here would make q die permanently (q_new = MᵀP = 0 forever)
+        # while its error-feedback residual grows unflushed (review, r3).
+        fallback = jnp.eye(Y.shape[0], dtype=Y.dtype)[:, : Y.shape[1]]
+        Y = jnp.where(nc > 0, Y / jnp.maximum(nc, 1e-30), fallback)
         Gm = Y.T @ Y
         L = jnp.linalg.cholesky(Gm + (shift * jnp.trace(Gm) + 1e-30) * eye)
         Q = jax.scipy.linalg.solve_triangular(L, Y.T, lower=True).T

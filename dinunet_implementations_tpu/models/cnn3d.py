@@ -17,25 +17,34 @@ from __future__ import annotations
 import flax.linen as nn
 import jax.numpy as jnp
 
-from .layers import BatchNorm, dense
+from .layers import BatchNorm, compute_dtype_of, dense
 
 
 class SMRI3DNet(nn.Module):
     channels: tuple = (16, 32, 64, 128)
     num_cls: int = 2
     dropout_rate: float = 0.25
+    # "bfloat16" runs the convolutions (all the FLOPs) in bf16 on the MXU
+    # (f32 accumulation in hardware); BatchNorm statistics and the head stay
+    # f32. None = full f32.
+    compute_dtype: str | None = None
 
     @nn.compact
     def __call__(self, x, train: bool = True, mask=None):
         # x: [B, D, H, W] or [B, D, H, W, C]
         if x.ndim == 4:
             x = x[..., None]
+        cdt = compute_dtype_of(self.compute_dtype)
         for i, ch in enumerate(self.channels):
             x = nn.Conv(ch, kernel_size=(3, 3, 3), strides=(2, 2, 2),
-                        use_bias=False, name=f"conv_{i}")(x)
-            x = BatchNorm(ch, track_running_stats=False, name=f"bn_{i}")(
-                x, train=train, mask=mask
-            )
+                        use_bias=False, name=f"conv_{i}", dtype=cdt,
+                        param_dtype=jnp.float32)(x)
+            x = x.astype(jnp.float32)  # BN moments at full precision
+            # per-channel statistics over (B, D, H, W) — BatchNorm3d semantics
+            x = BatchNorm(
+                ch, track_running_stats=False, reduce_axes=(0, 1, 2, 3),
+                name=f"bn_{i}",
+            )(x, train=train, mask=mask)
             x = nn.relu(x)
         x = jnp.mean(x, axis=(1, 2, 3))  # global average pool → [B, C]
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)

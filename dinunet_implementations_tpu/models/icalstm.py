@@ -31,7 +31,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from .layers import BatchNorm, TorchLinearInit, dense
+from .layers import BatchNorm, TorchLinearInit, compute_dtype_of, dense
 
 
 def _lstm_gates(preact, H, double_sigmoid: bool):
@@ -79,7 +79,7 @@ class LSTMCell(nn.Module):
         w_hh = self.param("w_hh", TorchLinearInit.kernel, (H, 4 * H))
         b_hh = self.param("b_hh", TorchLinearInit.bias_for(H), (4 * H,))
 
-        cdt = jnp.dtype(self.compute_dtype) if self.compute_dtype else None
+        cdt = compute_dtype_of(self.compute_dtype)
         if h0 is None:
             # carry is always f32: the scan body computes an f32 carry (scan
             # requires carry-type invariance) and the kernel keeps f32 carries
@@ -223,7 +223,7 @@ class ICALstm(nn.Module):
                     f"the {self.sequence_axis!r} axis size ({n})"
                 )
             flat = shard_sequence(flat, self.sequence_axis, axis=1)
-        cdt = jnp.dtype(self.compute_dtype) if self.compute_dtype else None
+        cdt = compute_dtype_of(self.compute_dtype)
         # under compute_dtype the encoder output stays bf16 — it feeds the
         # per-direction i2h projections, which consume bf16 directly
         enc = nn.relu(

@@ -22,15 +22,30 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 
+def compute_dtype_of(compute_dtype):
+    """Resolve a model's ``compute_dtype`` field ("bfloat16" / "" / None /
+    a dtype) to ``jnp.dtype | None`` — the one place the mixed-precision
+    sentinel convention lives."""
+    return jnp.dtype(compute_dtype) if compute_dtype else None
+
+
 def masked_moments(x, mask, axis=0, eps_count: float = 1.0):
-    """Weighted mean/var over ``axis``. ``mask`` broadcasts against ``x`` with
+    """Weighted mean/var over ``axis`` (an int or tuple — e.g. ``(0,1,2,3)``
+    for per-channel conv statistics). ``mask`` broadcasts against ``x`` with
     trailing feature dims of size 1. Biased variance (torch normalization)."""
     if mask is None:
         mean = jnp.mean(x, axis=axis, keepdims=True)
         var = jnp.mean(jnp.square(x - mean), axis=axis, keepdims=True)
-        count = x.shape[axis] if isinstance(axis, int) else None
+        if isinstance(axis, int):
+            count = x.shape[axis]
+        else:
+            count = 1
+            for a in axis:
+                count *= x.shape[a]
         return mean, var, count
-    w = mask
+    # the count must tally every reduced x-position the (broadcast) mask
+    # covers — e.g. a [B,1,1,1,1] mask over (0,1,2,3) counts B·D·H·W, not B
+    w = jnp.broadcast_to(mask, x.shape)
     count = jnp.maximum(jnp.sum(w, axis=axis, keepdims=True), eps_count)
     mean = jnp.sum(x * w, axis=axis, keepdims=True) / count
     var = jnp.sum(w * jnp.square(x - mean), axis=axis, keepdims=True) / count
@@ -38,12 +53,17 @@ def masked_moments(x, mask, axis=0, eps_count: float = 1.0):
 
 
 class BatchNorm(nn.Module):
-    """Torch-faithful BatchNorm1d with optional running stats and masking."""
+    """Torch-faithful BatchNorm1d with optional running stats and masking.
+
+    ``reduce_axes`` selects the statistics axes: 0 (default, BatchNorm1d over
+    ``[B, F]``) or a tuple like ``(0, 1, 2, 3)`` for per-channel conv stats
+    over ``[B, D, H, W, C]`` (BatchNorm3d semantics, channels-last)."""
 
     features: int
     track_running_stats: bool = False
     momentum: float = 0.1  # torch convention: new = (1-m)*old + m*batch
     eps: float = 1e-5
+    reduce_axes: int | tuple = 0
 
     @nn.compact
     def __call__(self, x, train: bool = True, mask=None):
@@ -61,13 +81,12 @@ class BatchNorm(nn.Module):
         m = None if mask is None else mask.reshape(mask.shape[0], *([1] * (x.ndim - 1)))
         use_batch = train or not self.track_running_stats
         if use_batch:
-            mean, var, count = masked_moments(x, m, axis=0)
+            mean, var, count = masked_moments(x, m, axis=self.reduce_axes)
             if self.track_running_stats and not self.is_initializing():
                 # torch tracks the *unbiased* variance
-                n = count if m is not None else x.shape[0]
-                unbiased = var * (n / jnp.maximum(n - 1, 1))
-                ra_mean.value = (1 - self.momentum) * ra_mean.value + self.momentum * jnp.squeeze(mean, 0)
-                ra_var.value = (1 - self.momentum) * ra_var.value + self.momentum * jnp.squeeze(unbiased, 0)
+                unbiased = var * (count / jnp.maximum(count - 1, 1))
+                ra_mean.value = (1 - self.momentum) * ra_mean.value + self.momentum * mean.reshape(-1)
+                ra_var.value = (1 - self.momentum) * ra_var.value + self.momentum * unbiased.reshape(-1)
             y = (x - mean) * jnp.reciprocal(jnp.sqrt(var + self.eps))
         else:
             y = (x - ra_mean.value) * jnp.reciprocal(jnp.sqrt(ra_var.value + self.eps))

@@ -21,14 +21,18 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from .layers import dense
+from .layers import compute_dtype_of, dense
 
 
 def dot_product_attention(q, k, v):
-    """[B, T, N, Hd] q/k/v → [B, T, N, Hd]; plain softmax attention."""
+    """[B, T, N, Hd] q/k/v → [B, T, N, Hd]; plain softmax attention.
+    Logits accumulate and softmax runs in f32 regardless of input dtype
+    (bf16 q/k/v under mixed precision); output returns at v's dtype."""
     scale = q.shape[-1] ** -0.5
-    logits = jnp.einsum("btnh,bsnh->bnts", q, k) * scale
-    weights = jax.nn.softmax(logits, axis=-1)
+    logits = jnp.einsum(
+        "btnh,bsnh->bnts", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    weights = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     return jnp.einsum("bnts,bsnh->btnh", weights, v)
 
 
@@ -37,13 +41,17 @@ class MultiHeadAttention(nn.Module):
     num_heads: int
     attention: str = "local"  # "local" | "ring" (sequence-parallel)
     axis_name: str | None = None  # mesh axis for ring attention
+    compute_dtype: str | None = None  # bf16 matmuls, f32 softmax/accum
 
     @nn.compact
     def __call__(self, x):
         B, T, E = x.shape
         N = self.num_heads
         Hd = E // N
-        qkv = dense(3 * E, fan_in=E, name="qkv")(x).reshape(B, T, 3, N, Hd)
+        cdt = compute_dtype_of(self.compute_dtype)
+        qkv = dense(3 * E, fan_in=E, name="qkv", dtype=cdt)(x).reshape(
+            B, T, 3, N, Hd
+        )
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if self.attention == "ring":
             from ..parallel.sequence import ring_attention
@@ -51,7 +59,7 @@ class MultiHeadAttention(nn.Module):
             out = ring_attention(q, k, v, axis_name=self.axis_name)
         else:
             out = dot_product_attention(q, k, v)
-        return dense(E, fan_in=E, name="proj")(out.reshape(B, T, E))
+        return dense(E, fan_in=E, name="proj", dtype=cdt)(out.reshape(B, T, E))
 
 
 class TransformerBlock(nn.Module):
@@ -61,6 +69,7 @@ class TransformerBlock(nn.Module):
     dropout_rate: float = 0.1
     attention: str = "local"
     axis_name: str | None = None
+    compute_dtype: str | None = None  # bf16 matmuls; LayerNorm/residual f32
 
     def _dropout(self, h, train: bool):
         if not train or self.dropout_rate == 0.0:
@@ -80,17 +89,21 @@ class TransformerBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        h = nn.LayerNorm(name="ln1")(x)
+        cdt = compute_dtype_of(self.compute_dtype)
+        h = nn.LayerNorm(name="ln1")(x)  # LN stats at f32 (x is f32 stream)
         h = MultiHeadAttention(
             self.embed_dim, self.num_heads, self.attention, self.axis_name,
-            name="attn",
+            self.compute_dtype, name="attn",
         )(h)
-        x = x + self._dropout(h, train)
+        # residual stream stays f32 (f32 + bf16 promotes to f32)
+        x = x + self._dropout(h.astype(jnp.float32), train)
         h = nn.LayerNorm(name="ln2")(x)
-        h = dense(self.embed_dim * self.mlp_ratio, fan_in=self.embed_dim, name="mlp1")(h)
+        h = dense(self.embed_dim * self.mlp_ratio, fan_in=self.embed_dim,
+                  name="mlp1", dtype=cdt)(h)
         h = nn.gelu(h)
-        h = dense(self.embed_dim, fan_in=self.embed_dim * self.mlp_ratio, name="mlp2")(h)
-        return x + self._dropout(h, train)
+        h = dense(self.embed_dim, fan_in=self.embed_dim * self.mlp_ratio,
+                  name="mlp2", dtype=cdt)(h)
+        return x + self._dropout(h.astype(jnp.float32), train)
 
 
 class MultimodalNet(nn.Module):
@@ -105,6 +118,9 @@ class MultimodalNet(nn.Module):
     dropout_rate: float = 0.1
     attention: str = "local"
     axis_name: str | None = None
+    # "bfloat16" runs every matmul (embeddings, qkv/proj, MLPs) in bf16 with
+    # f32 softmax/LayerNorm/residual stream; None = full f32
+    compute_dtype: str | None = None
 
     @nn.compact
     def __call__(self, x, train: bool = True, mask=None):
@@ -117,16 +133,21 @@ class MultimodalNet(nn.Module):
             B, -1, self.num_comps * self.window_size
         )  # [B, S, C*W]
 
-        fs_tok = dense(self.embed_dim, fan_in=self.fs_input_size, name="fs_embed")(fs)
+        cdt = compute_dtype_of(self.compute_dtype)
+        fs_tok = dense(self.embed_dim, fan_in=self.fs_input_size,
+                       name="fs_embed", dtype=cdt)(fs)
         ica_tok = dense(
-            self.embed_dim, fan_in=self.num_comps * self.window_size, name="ica_embed"
+            self.embed_dim, fan_in=self.num_comps * self.window_size,
+            name="ica_embed", dtype=cdt,
         )(ica)
         cls = self.param(
             "cls", nn.initializers.normal(0.02), (1, 1, self.embed_dim)
         )
         tokens = jnp.concatenate(
-            [jnp.tile(cls, (B, 1, 1)), fs_tok[:, None, :], ica_tok], axis=1
-        )
+            [jnp.tile(cls, (B, 1, 1)),
+             fs_tok[:, None, :].astype(jnp.float32),
+             ica_tok.astype(jnp.float32)], axis=1
+        )  # token/residual stream is f32; block matmuls re-cast internally
         T = tokens.shape[1]
         pos = self.param(
             "pos_embed", nn.initializers.normal(0.02), (1, T, self.embed_dim)
@@ -149,7 +170,8 @@ class MultimodalNet(nn.Module):
         for i in range(self.num_layers):
             h = TransformerBlock(
                 self.embed_dim, self.num_heads, self.mlp_ratio, self.dropout_rate,
-                self.attention, self.axis_name, name=f"block_{i}",
+                self.attention, self.axis_name, self.compute_dtype,
+                name=f"block_{i}",
             )(h, train=train)
         h = nn.LayerNorm(name="ln_f")(h)
         if ring:

@@ -55,7 +55,9 @@ def ring_attention(q, k, v, axis_name: str | None = MODEL_AXIS):
 
     def step(carry, _):
         k_blk, v_blk, num, den, mx = carry
-        logits = jnp.einsum("btnh,bsnh->bnts", q, k_blk).astype(jnp.float32) * scale
+        logits = jnp.einsum(
+            "btnh,bsnh->bnts", q, k_blk, preferred_element_type=jnp.float32
+        ) * scale
         blk_max = logits.max(axis=-1)
         new_mx = jnp.maximum(mx, blk_max)
         corr = jnp.exp(mx - new_mx)

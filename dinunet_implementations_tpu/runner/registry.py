@@ -61,7 +61,10 @@ def _build_icalstm(cfg: TrainConfig):
 
 def _build_smri3d(cfg: TrainConfig):
     a = cfg.smri3d_args
-    return SMRI3DNet(channels=tuple(a.channels), num_cls=a.num_class)
+    return SMRI3DNet(
+        channels=tuple(a.channels), num_cls=a.num_class,
+        compute_dtype=a.compute_dtype or None,
+    )
 
 
 def _build_multimodal(cfg: TrainConfig):
@@ -85,6 +88,7 @@ def _build_multimodal(cfg: TrainConfig):
         num_cls=a.num_class,
         attention=attention,
         axis_name=MODEL_AXIS if attention == "ring" else None,
+        compute_dtype=a.compute_dtype or None,
     )
 
 

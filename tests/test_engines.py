@@ -210,3 +210,15 @@ def test_subspace_iteration_rank_deficient_and_zero_safe():
     P, Q = subspace_iteration(G_lowrank, 6, 20, 1e-9)
     rec = float(jnp.linalg.norm(P @ Q.T - G_lowrank) / jnp.linalg.norm(G_lowrank))
     assert rec < 1e-3, f"rank-2 reconstruction error {rec:.2e}"
+
+
+def test_orthonormalize_zero_input_recovers():
+    """Review regression (r3): orthonormalize(0) must return an ORTHONORMAL
+    basis (as Householder QR does), not zeros — powerSGD warm-starts its q
+    factor from P, and P=0 would freeze the leaf's gradient forever."""
+    from dinunet_implementations_tpu.engines.lowrank import orthonormalize
+
+    P = orthonormalize(jnp.zeros((12, 4), jnp.float32))
+    np.testing.assert_allclose(
+        np.asarray(P.T @ P), np.eye(4), atol=1e-5
+    )

@@ -196,3 +196,52 @@ def test_multimodal_fed_runner_end_to_end(tmp_path):
         tmp_path / "output/local0/simulatorRun/Multimodal-Classification/fold_0/logs.json"
     ))
     assert log["agg_engine"] == "dSGD"
+
+
+def test_multimodal_bf16_tracks_f32():
+    """Mixed precision for the transformer: bf16 matmuls with f32
+    softmax/LayerNorm must track the f32 forward within bf16 tolerance."""
+    rng = np.random.default_rng(21)
+    S, C, W = 4, 3, 4
+    f32m = MultimodalNet(
+        fs_input_size=5, num_comps=C, window_size=W, embed_dim=16,
+        num_heads=2, num_layers=2, num_cls=2,
+    )
+    b16m = f32m.clone(compute_dtype="bfloat16")
+    x = jnp.asarray(rng.normal(size=(3, 5 + S * C * W)).astype(np.float32))
+    variables = f32m.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        x, train=False,
+    )
+    out_f = f32m.apply(variables, x, train=False)
+    out_b = b16m.apply(variables, x, train=False)
+    assert out_b.dtype == jnp.float32  # head returns f32
+    np.testing.assert_allclose(np.asarray(out_b), np.asarray(out_f), atol=0.05)
+
+    def loss(v, m):
+        return (m.apply(v, x, train=False) ** 2).mean()
+
+    g_f = jax.grad(loss)(variables, f32m)["params"]
+    g_b = jax.grad(loss)(variables, b16m)["params"]
+    for (path, a), b in zip(
+        jax.tree_util.tree_leaves_with_path(g_f), jax.tree.leaves(g_b)
+    ):
+        denom = max(float(np.abs(np.asarray(a)).max()), 1e-3)
+        assert float(np.abs(np.asarray(a) - np.asarray(b, np.float32)).max()) / denom < 0.1, (
+            jax.tree_util.keystr(path)
+        )
+
+
+def test_smri3d_bf16_tracks_f32():
+    rng = np.random.default_rng(22)
+    f32m = SMRI3DNet(channels=(4, 8), num_cls=2)
+    b16m = f32m.clone(compute_dtype="bfloat16")
+    x = jnp.asarray(rng.normal(size=(2, 16, 16, 16)).astype(np.float32))
+    variables = f32m.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        x, train=False,
+    )
+    out_f = f32m.apply(variables, x, train=False)
+    out_b = b16m.apply(variables, x, train=False)
+    assert out_b.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out_b), np.asarray(out_f), atol=0.05)
