@@ -116,9 +116,14 @@ def measure_tpu() -> float:
     epoch_fn = make_train_epoch_fn(task, engine, opt, mesh=None, local_iterations=1)
 
     chain_epochs(epoch_fn, state0, x, y, w, 1)  # compile + lazy-runtime warmup
-    t1 = chain_epochs(epoch_fn, state0, x, y, w, 1)
-    tN = chain_epochs(epoch_fn, state0, x, y, w, TIMED_EPOCHS + 1)
-    dt = max((tN - t1) / TIMED_EPOCHS, 1e-9)
+    # tunnel contention adds tens-of-ms jitter per run: take the median of
+    # three independent marginal measurements
+    dts = []
+    for _ in range(3):
+        t1 = chain_epochs(epoch_fn, state0, x, y, w, 1)
+        tN = chain_epochs(epoch_fn, state0, x, y, w, TIMED_EPOCHS + 1)
+        dts.append(max((tN - t1) / TIMED_EPOCHS, 1e-9))
+    dt = sorted(dts)[1]
 
     n_chips = 1  # the folded site axis runs on one chip
     samples = S * steps * B

@@ -69,7 +69,7 @@ def _cholqr(Y):
         # powerSGD warm-starts its q factor from the previous round's P; a
         # P=0 here would make q die permanently (q_new = MᵀP = 0 forever)
         # while its error-feedback residual grows unflushed (review, r3).
-        fallback = jnp.eye(Y.shape[0], dtype=Y.dtype)[:, : Y.shape[1]]
+        fallback = jnp.eye(Y.shape[0], Y.shape[1], dtype=Y.dtype)
         Y = jnp.where(nc > 0, Y / jnp.maximum(nc, 1e-30), fallback)
         Gm = Y.T @ Y
         L = jnp.linalg.cholesky(Gm + (shift * jnp.trace(Gm) + 1e-30) * eye)

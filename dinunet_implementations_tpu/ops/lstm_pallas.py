@@ -414,6 +414,9 @@ def _vjp_fused_bwd(compute_dtype, res, grads):
         *acts, cs, whh4, c0, dhs, dhT, dcT
     )
     cdt = jnp.dtype(cdt_name) if cdt_name else x.dtype
+    # the [4, T, B, H] stack looks like an extra materialization but XLA
+    # fuses it, and the single batched einsum beats four per-gate einsums
+    # (measured 1.10 vs 1.20 ms/iter at the bench shape on v5e)
     dp4 = jnp.stack([dp_i, dp_f, dp_o, dp_g])  # [4, T, B, H] at stream dtype
     # dx = Σ_k dp_k @ Wih_kᵀ; dW_ih = Σ_t x_tᵀ dp_k; db = Σ_{t,b} dp_k
     dx = jnp.einsum(

@@ -1,0 +1,136 @@
+"""Command-line entry point — the operational surface of the build.
+
+The reference is driven by ``python entry.py`` inside a COINSTAC container
+(``Dockerfile:20``) or by the standalone ``comps/*/site_run.py`` scripts.
+Here one CLI covers both:
+
+    # federated run over a simulator tree (the COINSTAC-simulator replacement)
+    dinunet-tpu --data-path datasets/test_fsl --task FS-Classification \
+        --engine dSGD --epochs 101 --out-dir out
+
+    # single-site debug harness (SiteRunner parity)
+    dinunet-tpu --data-path datasets/test_fsl --site 0 --epochs 20
+
+    # resume / inference-only
+    dinunet-tpu --data-path ... --resume
+    dinunet-tpu --data-path ... --mode test
+
+Any TrainConfig field (or task-args field) can be overridden with
+``--set key=value`` (repeatable; values parse as JSON when possible, e.g.
+``--set split_ratio=[0.7,0.15,0.15]`` or ``--set hidden_size=348``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..core.config import AggEngine, NNComputation, TrainConfig
+
+
+def _parse_set(pairs: list[str]) -> dict:
+    out = {}
+    for pair in pairs:
+        if "=" not in pair:
+            raise SystemExit(f"--set expects key=value, got {pair!r}")
+        k, v = pair.split("=", 1)
+        try:
+            out[k] = json.loads(v)
+        except json.JSONDecodeError:
+            out[k] = v  # bare string
+    return out
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dinunet-tpu",
+        description="TPU-native federated training (dinunet capabilities).",
+    )
+    p.add_argument("--data-path", required=True,
+                   help="dataset tree (reference simulator layout: "
+                        "input/local*/simulatorRun + inputspec.json)")
+    p.add_argument("--task", default=None, choices=list(NNComputation.ALL),
+                   help="task id (default: TrainConfig/inputspec default)")
+    p.add_argument("--engine", default=None, choices=list(AggEngine.ALL),
+                   help="aggregation engine")
+    p.add_argument("--mode", default=None, choices=["train", "test"])
+    p.add_argument("--epochs", type=int, default=None)
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--num-folds", type=int, default=None)
+    p.add_argument("--model-axis-size", type=int, default=None,
+                   help="sequence parallelism: shard the model's sequence "
+                        "axis over this many devices per site")
+    p.add_argument("--sites-per-device", type=int, default=None,
+                   help="fold several simulated sites onto one device")
+    p.add_argument("--out-dir", default=None,
+                   help="output root (default <data-path>/output)")
+    p.add_argument("--site", type=int, default=None,
+                   help="single-site mode: run only this site index "
+                        "(SiteRunner parity)")
+    p.add_argument("--folds", type=int, nargs="*", default=None,
+                   help="run only these fold indices")
+    p.add_argument("--resume", action="store_true",
+                   help="resume each fold from its latest checkpoint")
+    p.add_argument("--profile-dir", default=None,
+                   help="write a jax.profiler trace per fold here")
+    p.add_argument("--quiet", action="store_true")
+    p.add_argument("--set", dest="overrides", action="append", default=[],
+                   metavar="KEY=VALUE",
+                   help="override any TrainConfig / task-args field "
+                        "(repeatable; value parsed as JSON when possible)")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    overrides = _parse_set(args.overrides)
+    for key, val in (
+        ("task_id", args.task), ("agg_engine", args.engine),
+        ("mode", args.mode), ("epochs", args.epochs),
+        ("batch_size", args.batch_size), ("num_folds", args.num_folds),
+        ("model_axis_size", args.model_axis_size),
+        ("sites_per_device", args.sites_per_device),
+        ("profile_dir", args.profile_dir),
+    ):
+        if val is not None:
+            overrides[key] = val
+    cfg = TrainConfig().with_overrides(overrides)
+    verbose = not args.quiet
+
+    if args.site is not None:
+        if args.folds is not None or args.resume:
+            raise SystemExit(
+                "--folds/--resume are federated-mode options; "
+                "not supported together with --site"
+            )
+        from .fed_runner import SiteRunner
+
+        runner = SiteRunner(
+            task_id=cfg.task_id, data_path=args.data_path,
+            mode=cfg.mode, site_index=args.site, out_dir=args.out_dir,
+            # drop the keys passed explicitly above — they already carry any
+            # override (cfg.mode includes --mode / --set mode=...)
+            **{k: v for k, v in overrides.items()
+               if k not in ("task_id", "mode", "site_index", "out_dir")},
+        )
+        results = runner.run(verbose=verbose)
+    else:
+        from .fed_runner import FedRunner
+
+        runner = FedRunner(cfg, data_path=args.data_path, out_dir=args.out_dir)
+        results = runner.run(folds=args.folds, verbose=verbose, resume=args.resume)
+
+    for k, res in enumerate(results):
+        loss, metric = res["test_metrics"][0]
+        print(json.dumps({
+            "fold": (args.folds or list(range(len(results))))[k],
+            "test_loss": loss,
+            f"test_{cfg.monitor_metric}": metric,
+            "best_val_epoch": res["best_val_epoch"],
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
