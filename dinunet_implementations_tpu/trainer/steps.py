@@ -75,14 +75,6 @@ def _state_specs(state: TrainState):
     )
 
 
-def _state_axes():
-    """vmap in/out axes: engine_state mapped over sites, the rest broadcast."""
-    return TrainState(
-        params=None, batch_stats=None, opt_state=None, engine_state=0,
-        rng=None, round=None,
-    )
-
-
 def make_optimizer(name: str, learning_rate: float) -> optax.GradientTransformation:
     """Reference trains with Adam at ``learning_rate`` (coinstac-dinunet
     default); SGD kept as an option."""
@@ -212,65 +204,88 @@ def make_train_epoch_fn(
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
-    def per_site_epoch(state: TrainState, x, y, w, site_axes=SITE_AXIS):
-        # x: [steps, B, ...] — one site's epoch. ``site_axes`` is the bound
-        # axis (or (mesh, vmap-fold) axis pair when several sites share one
-        # device) that cross-site collectives reduce over; axis_index over the
-        # pair linearizes to the same global site order as the data layout.
-        steps = x.shape[0]
+    def epoch_over_sites(state: TrainState, x, y, w, site_axes, inner_axis):
+        """Run one epoch for the k in-device sites in ``x [k, steps, B, ...]``.
+
+        Only the per-site work (grads, engine aggregation, stat sync) runs
+        under the inner vmap; the optimizer update applies ONCE per round on
+        the (replicated) aggregate. The scan carry therefore holds a single
+        copy of params/opt_state — vmapping the whole round used to replicate
+        them per site, costing ~k× the params+Adam-state in HBM writes every
+        round (measured ~half the epoch time at 32 folded sites).
+
+        ``site_axes`` is the bound axis (or (mesh, vmap-fold) pair) that
+        cross-site collectives reduce over; ``inner_axis`` is the vmap axis
+        name for the in-device block. axis_index over ``site_axes``
+        linearizes to the same global site order as the data layout.
+        """
+        k, steps = x.shape[0], x.shape[1]
         rounds = steps // local_iterations
         L = rounds * local_iterations
-        xr = x[:L].reshape((rounds, local_iterations) + x.shape[1:])
-        yr = y[:L].reshape((rounds, local_iterations) + y.shape[1:])
-        wr = w[:L].reshape((rounds, local_iterations) + w.shape[1:])
 
-        site_ix = jax.lax.axis_index(site_axes)
+        def to_rounds(a):
+            a = a[:, :L].reshape((k, rounds, local_iterations) + a.shape[2:])
+            return jnp.moveaxis(a, 1, 0)  # [rounds, k, L, B, ...]
+
+        xr, yr, wr = to_rounds(x), to_rounds(y), to_rounds(w)
 
         def one_round(carry, batch):
             params, batch_stats, opt_state, engine_state, rng, rnd = carry
-            xb, yb, wb = batch  # [L, B, ...]
-
+            xb, yb, wb = batch  # [k, L, B, ...]
             rng, sub = jax.random.split(rng)
 
-            def micro(acc, mb):
-                g_sum, n_sum, stats = acc
-                xm, ym, wm, i = mb
-                key_i = jax.random.fold_in(jax.random.fold_in(sub, site_ix), i)
-                (loss, new_stats), grads = grad_fn(params, stats, key_i, xm, ym, wm)
-                if model_axis is not None:
-                    # assemble the full gradient (and un-mask the loss scalar)
-                    # from the per-member pieces — see loss_fn
-                    grads = jax.lax.psum(grads, model_axis)
-                    loss = jax.lax.psum(loss, model_axis)
-                n = wm.sum()
-                g_sum = jax.tree.map(lambda a, g: a + g * n, g_sum, grads)
-                return (g_sum, n_sum + n, new_stats), loss * n
+            def site_part(es, xs, ys, ws):
+                site_ix = jax.lax.axis_index(site_axes)
 
-            g0 = jax.tree.map(jnp.zeros_like, params)
-            (g_sum, n_sum, new_stats), loss_sums = jax.lax.scan(
-                micro,
-                (g0, jnp.zeros(()), batch_stats),
-                (xb, yb, wb, jnp.arange(local_iterations)),
-            )
-            site_grad = jax.tree.map(
-                lambda g: g / jnp.maximum(n_sum, 1.0), g_sum
-            )
-            agg, engine_state = engine.aggregate(
-                site_grad, engine_state, n_sum, site_axes
-            )
+                def micro(acc, mb):
+                    g_sum, n_sum, stats = acc
+                    xm, ym, wm, i = mb
+                    key_i = jax.random.fold_in(jax.random.fold_in(sub, site_ix), i)
+                    (loss, new_stats), grads = grad_fn(params, stats, key_i, xm, ym, wm)
+                    if model_axis is not None:
+                        # assemble the full gradient (and un-mask the loss
+                        # scalar) from the per-member pieces — see loss_fn
+                        grads = jax.lax.psum(grads, model_axis)
+                        loss = jax.lax.psum(loss, model_axis)
+                    n = wm.sum()
+                    g_sum = jax.tree.map(lambda a, g: a + g * n, g_sum, grads)
+                    return (g_sum, n_sum + n, new_stats), loss * n
+
+                g0 = jax.tree.map(jnp.zeros_like, params)
+                (g_sum, n_sum, new_stats), loss_sums = jax.lax.scan(
+                    micro,
+                    (g0, jnp.zeros(()), batch_stats),
+                    (xs, ys, ws, jnp.arange(local_iterations)),
+                )
+                site_grad = jax.tree.map(
+                    lambda g: g / jnp.maximum(n_sum, 1.0), g_sum
+                )
+                agg, es = engine.aggregate(site_grad, es, n_sum, site_axes)
+                # sync-BN: example-weighted average of per-site running stats
+                if task.has_batch_stats:
+                    scale = site_weight_scale(n_sum, site_axes)
+                    new_stats = jax.tree.map(
+                        lambda s: jax.lax.psum(s * scale, site_axes), new_stats
+                    )
+                # round-weighted global loss (for logs)
+                loss_round = jax.lax.psum(loss_sums.sum(), site_axes) / jnp.maximum(
+                    jax.lax.psum(n_sum, site_axes), 1.0
+                )
+                return agg, es, new_stats, loss_round
+
+            agg, engine_state, stats_k, loss_k = jax.vmap(
+                site_part, in_axes=(0, 0, 0, 0), out_axes=(0, 0, 0, 0),
+                axis_name=inner_axis,
+            )(engine_state, xb, yb, wb)
+            # agg/stats/loss are psum'd over site_axes → identical across the
+            # k in-device rows; collapse to one copy and update once
+            agg = jax.tree.map(lambda a: a[0], agg)
+            batch_stats = jax.tree.map(lambda a: a[0], stats_k)
             updates, opt_state = optimizer.update(agg, opt_state, params)
             params = optax.apply_updates(params, updates)
-            # sync-BN: example-weighted average of per-site running stats
-            if task.has_batch_stats:
-                scale = site_weight_scale(n_sum, site_axes)
-                new_stats = jax.tree.map(
-                    lambda s: jax.lax.psum(s * scale, site_axes), new_stats
-                )
-            # round-weighted global loss (for logs): psum of per-site sums
-            loss_round = jax.lax.psum(loss_sums.sum(), site_axes) / jnp.maximum(
-                jax.lax.psum(n_sum, site_axes), 1.0
-            )
-            return (params, new_stats, opt_state, engine_state, rng, rnd + 1), loss_round
+            return (
+                params, batch_stats, opt_state, engine_state, rng, rnd + 1,
+            ), loss_k[0]
 
         carry0 = (
             state.params,
@@ -298,22 +313,12 @@ def make_train_epoch_fn(
         def shard_wrapped(st, x, y, w):
             # x: [k, steps, B, ...] — this device's block of k sites. k > 1 is
             # the folded case (cfg.sites_per_device: more simulated sites than
-            # devices); the block runs as an inner vmap with cross-site
-            # collectives spanning the (mesh site, fold) axis pair. k == 1 is
-            # the one-site-per-device case, same program.
-            new_state, losses = jax.vmap(
-                lambda s_, x_, y_, w_: per_site_epoch(
-                    s_, x_, y_, w_, site_axes=(SITE_AXIS, FOLD_AXIS)
-                ),
-                in_axes=(_state_axes(), 0, 0, 0),
-                out_axes=(0, 0),
-                axis_name=FOLD_AXIS,
-            )(st, x, y, w)
-            # collectives make every site's copy identical — keep block row 0
-            # of everything EXCEPT the per-site engine state
-            collapsed = jax.tree.map(lambda a: a[0], new_state)
-            collapsed = collapsed.replace(engine_state=new_state.engine_state)
-            return collapsed, losses[0]
+            # devices); cross-site collectives span the (mesh site, fold)
+            # axis pair. k == 1 is the one-site-per-device case, same program.
+            return epoch_over_sites(
+                st, x, y, w, site_axes=(SITE_AXIS, FOLD_AXIS),
+                inner_axis=FOLD_AXIS,
+            )
 
         @jax.jit
         def epoch_fn(state: TrainState, inputs, labels, weights):
@@ -330,17 +335,12 @@ def make_train_epoch_fn(
 
         @jax.jit
         def epoch_fn(state: TrainState, inputs, labels, weights):
-            new_state, losses = jax.vmap(
-                per_site_epoch,
-                in_axes=(_state_axes(), 0, 0, 0),
-                out_axes=(0, 0),
-                axis_name=SITE_AXIS,
-            )(state, inputs, labels, weights)
-            # psum makes every site's output identical (keep replica 0) —
-            # EXCEPT the per-site engine state, which must stay per-site
-            collapsed = jax.tree.map(lambda a: a[0], new_state)
-            collapsed = collapsed.replace(engine_state=new_state.engine_state)
-            return collapsed, losses[0]
+            # all S sites fold onto the local device: the inner vmap IS the
+            # site axis
+            return epoch_over_sites(
+                state, inputs, labels, weights,
+                site_axes=SITE_AXIS, inner_axis=SITE_AXIS,
+            )
 
     return epoch_fn
 

@@ -323,3 +323,36 @@ def test_model_axis_requires_enough_devices(tmp_path):
         FedRunner(
             TrainConfig(model_axis_size=2), data_path=str(tmp_path),
         )
+
+
+def test_long_context_ring_trains_512_windows():
+    """Long-context capability: a sequence far beyond the reference's ~98
+    windows (512), sharded 4-way over the model axis — the ring LSTM carries
+    the recurrence across chunks and training stays finite and learns."""
+    S_WINDOWS = 512
+    rng = np.random.default_rng(13)
+    model = ICALstm(
+        input_size=8, hidden_size=6, num_comps=2, window_size=3, num_cls=2,
+        sequence_axis=MODEL_AXIS,
+    )
+    task = FederatedTask(model)
+    engine = make_engine("dSGD")
+    opt = make_optimizer("adam", 1e-2)
+    B = 4
+    x_np = rng.normal(size=(2, 2, B, S_WINDOWS, 2, 3)).astype(np.float32)
+    y = jnp.asarray((rng.random((2, 2, B)) > 0.5).astype(np.int32))
+    # plant a class signal so the loss must actually fall
+    x_np += np.asarray(y)[..., None, None, None] * 0.5
+    x = jnp.asarray(x_np)
+    w = jnp.ones((2, 2, B), jnp.float32)
+    mesh = host_mesh(2, model_axis_size=4)  # 2 sites x 4-way sequence shard
+    state = init_train_state(
+        task, engine, opt, jax.random.PRNGKey(0), x[0, 0], num_sites=2
+    )
+    fn = make_train_epoch_fn(task, engine, opt, mesh, local_iterations=1)
+    losses = []
+    for _ in range(4):
+        state, ls = fn(state, x, y, w)
+        losses.append(float(np.asarray(ls).mean()))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], f"loss did not fall: {losses}"
