@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bench import chain_epochs
+from bench import chain_epochs, least_contended_marginal
 
 from dinunet_implementations_tpu.engines import make_engine
 from dinunet_implementations_tpu.models import (
@@ -66,7 +66,7 @@ def measure(name, model, x_shape, sites, engine_name, batch, engine_kw=None,
     # adaptive: grow N until the marginal compute dominates the ~0.1 s
     # tunnel-round-trip noise floor, else fast configs read as noise
     t1 = min(run(1) for _ in range(2))
-    n = max(timed_epochs, 2)
+    n = max(timed_epochs, 4)
     while True:
         tN = run(n + 1)
         d = tN - t1
@@ -86,7 +86,10 @@ def measure(name, model, x_shape, sites, engine_name, batch, engine_kw=None,
         # methodology exists to eliminate)
         record.update(value=None, unreliable=True, marginal_seconds=round(d, 4))
     else:
-        record["value"] = round(sites * STEPS * batch * n / d, 2)
+        # final measurement with the shared least-contended estimator
+        # (bench.py) at the calibrated chain length
+        dt = least_contended_marginal(run, n)
+        record["value"] = round(sites * STEPS * batch / dt, 2)
     print(json.dumps(record), flush=True)
     return record.get("value")
 

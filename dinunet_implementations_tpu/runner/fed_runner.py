@@ -13,12 +13,11 @@
 
 from __future__ import annotations
 
-import dataclasses
 import glob
 import os
 
 from ..core.config import TrainConfig, resolve_site_configs
-from ..data.api import SiteArrays, build_site_dataset
+from ..data.api import build_site_dataset
 from ..data.splits import resolve_splits
 from ..parallel.mesh import host_mesh, make_site_mesh
 from ..trainer.loop import FederatedTrainer
