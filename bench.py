@@ -76,17 +76,22 @@ def chain_epochs(epoch_fn, state0, x, y, w, n: int) -> float:
     return time.time() - t0
 
 
-def least_contended_marginal(run_chain, n: int, repeats: int = 3) -> float:
+def least_contended_marginal(run_chain, n: int, repeats: int = 3,
+                             pre_full: float | None = None) -> float:
     """Marginal seconds/epoch between an ``n``-epoch and an ``n/2``-epoch
     chain, taking the MINIMUM of ``repeats`` runs PER ENDPOINT (module
     docstring step 3): tunnel contention only adds time, so each endpoint's
     minimum is its least-contended observation; minimizing paired
     differences instead would be downward-biased. ``run_chain(k)`` must
-    return wall-clock seconds for a k-epoch fully-materialized chain."""
+    return wall-clock seconds for a k-epoch fully-materialized chain.
+    ``pre_full`` feeds an already-observed (n+1)-chain timing into the
+    full-endpoint minimum (valid for a min estimator; saves a chain)."""
     half = n // 2
     t_half = min(run_chain(half + 1) for _ in range(repeats))
-    t_full = min(run_chain(n + 1) for _ in range(repeats))
-    return max((t_full - t_half) / (n - half), 1e-9)
+    fulls = [run_chain(n + 1) for _ in range(repeats)]
+    if pre_full is not None:
+        fulls.append(pre_full)
+    return max((min(fulls) - t_half) / (n - half), 1e-9)
 
 
 def flops_per_sample() -> float:

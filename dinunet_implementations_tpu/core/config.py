@@ -126,6 +126,9 @@ class SMRI3DArgs:
     channels: tuple = (16, 32, 64, 128)
     # "bfloat16" = bf16 convolutions with f32 BatchNorm/head; "" = full f32
     compute_dtype: str = ""
+    # fold 2x2x2 spatial blocks into 8 channels before conv_0 (3.7-6.9x
+    # faster on TPU; changes the architecture, so old checkpoints need False)
+    space_to_depth: bool = False
     dad_reduction_rank: int = 10
     dad_num_pow_iters: int = 5
     dad_tol: float = 1e-3

@@ -64,6 +64,7 @@ def _build_smri3d(cfg: TrainConfig):
     return SMRI3DNet(
         channels=tuple(a.channels), num_cls=a.num_class,
         compute_dtype=a.compute_dtype or None,
+        space_to_depth=a.space_to_depth,
     )
 
 
