@@ -52,11 +52,17 @@ class SMRI3DNet(nn.Module):
         # x: [B, D, H, W] or [B, D, H, W, C]
         if x.ndim == 4:
             x = x[..., None]
-        if (
-            self.space_to_depth
-            and x.shape[-1] == 1
-            and all(d % 2 == 0 for d in x.shape[1:4])
-        ):
+        if self.space_to_depth:
+            # fail loudly rather than silently skipping the fold: a no-op
+            # here would mean a different architecture than configured (and
+            # an opaque conv shape error later if a trained model meets
+            # odd-sized data)
+            if x.shape[-1] != 1 or any(d % 2 for d in x.shape[1:4]):
+                raise ValueError(
+                    "space_to_depth needs single-channel input with even "
+                    f"spatial dims; got shape {x.shape[1:]}. Pad/crop the "
+                    "volumes or set space_to_depth=False."
+                )
             x = space_to_depth_222(x)
         cdt = compute_dtype_of(self.compute_dtype)
         for i, ch in enumerate(self.channels):

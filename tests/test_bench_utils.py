@@ -1,0 +1,63 @@
+"""The bench measurement utilities (bench.py) — the estimator math must be
+right, because every recorded throughput number flows through it."""
+
+import importlib.util
+import os
+
+
+def _bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_least_contended_marginal_recovers_truth_under_contention():
+    """Synthetic chains: T(k) = k·c + fetch + contention-noise. The estimator
+    must recover c when at least one run per endpoint is uncontended."""
+    bench = _bench()
+    c, fetch = 0.010, 4.5
+    # deterministic "contention" schedule: some runs get hit, some don't
+    hits = iter([3.0, 0.0, 1.2, 0.0, 2.0, 0.4])
+
+    def run_chain(k):
+        return k * c + fetch + next(hits)
+
+    dt = bench.least_contended_marginal(run_chain, n=100, repeats=3)
+    assert abs(dt - c) < 1e-9, dt
+
+
+def test_least_contended_marginal_uses_pre_full_sample():
+    bench = _bench()
+    c, fetch = 0.010, 4.5
+    # every fresh full-chain run is contended; only the pre-observed one is clean
+    def run_chain(k):
+        return k * c + fetch + (0.0 if k < 60 else 5.0)
+
+    clean_full = 101 * c + fetch
+    dt = bench.least_contended_marginal(run_chain, n=100, repeats=2,
+                                        pre_full=clean_full)
+    assert abs(dt - c) < 1e-9, dt
+
+
+def test_least_contended_marginal_floor_guards_nonpositive():
+    bench = _bench()
+    # pathological: full chain faster than half chain → clamped, not negative
+    times = {51: 10.0, 101: 9.0}
+    dt = bench.least_contended_marginal(lambda k: times[k], n=100, repeats=1)
+    assert dt == 1e-9
+
+
+def test_flops_per_sample_matches_hand_count():
+    """The MFU denominator, pinned against an INDEPENDENT hand count (not
+    the module's own formula) for the flagship dims: 98 windows, encoder
+    1000→256, biLSTM H=174/direction, head 348→256→64→2, train = 3× fwd.
+
+    enc  = 98·1000·256·2                         =  50,176,000
+    lstm = 98·2dirs·(256·(4·174) + 174·(4·174))·2 = 117,317,760
+    head = 348·256·2 + 256·64·2 + 64·2·2          =     211,200
+    """
+    bench = _bench()
+    assert bench.flops_per_sample() == 3.0 * (50_176_000 + 117_317_760 + 211_200)
