@@ -142,3 +142,37 @@ def test_fed_runner_rejects_nondivisible_fold(tmp_path):
             TrainConfig(sites_per_device=2),
             data_path="/root/reference/datasets/test_fsl",
         )
+
+
+def test_folded_eval_with_model_axis():
+    """Eval on a (2 site × 2 model) mesh with 4 sites folded 2-per-device —
+    the one folding/model-axis combination the train tests don't cover."""
+    from dinunet_implementations_tpu.models import ICALstm
+    from dinunet_implementations_tpu.parallel.mesh import MODEL_AXIS
+
+    rng = np.random.default_rng(7)
+    S, steps, B = 4, 2, 4
+    x = jnp.asarray(rng.normal(size=(S, steps, B, 8, 3, 4)).astype(np.float32))
+    y = jnp.asarray((rng.random((S, steps, B)) > 0.5).astype(np.int32))
+    w = jnp.ones((S, steps, B), jnp.float32)
+
+    dense = ICALstm(input_size=12, hidden_size=10, num_comps=3, window_size=4,
+                    num_cls=2)
+    ring = dense.clone(sequence_axis=MODEL_AXIS)
+    t_dense, t_ring = FederatedTask(dense), FederatedTask(ring)
+    # resolves has_batch_stats for the ring task (dense's resolves inside
+    # init_train_state below)
+    t_ring.init_variables(jax.random.PRNGKey(0), x[0, 0])
+
+    state = init_train_state(
+        t_dense, make_engine("dSGD"), make_optimizer("sgd", 1e-2),
+        jax.random.PRNGKey(0), x[0, 0], num_sites=S,
+    )
+    pd, ld, wd = make_eval_fn(t_dense, None)(state, x, y, w)
+    state_np = jax.tree.map(np.asarray, state)
+    pc, lc, wc = make_eval_fn(t_ring, host_mesh(2, model_axis_size=2))(
+        state_np, x, y, w
+    )
+    np.testing.assert_allclose(np.asarray(pc), np.asarray(pd), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(lc), np.asarray(ld), atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(wc), np.asarray(wd))
