@@ -143,9 +143,26 @@ class BiLSTM(nn.Module):
     use_pallas: bool | None = None
     compute_dtype: str | None = None
     sequence_axis: str | None = None
+    # time_pool="mean": return the time-mean [B, H_total] instead of the
+    # hidden sequence. Numerically identical to mean-pooling the concat
+    # (column blocks reduce independently), but the [B, T, 2*per_dir] concat
+    # never materializes — its per-direction boundary sits at a non-lane-
+    # aligned feature offset (e.g. 174), and profiling the 32-site bench
+    # showed XLA spending ~0.5 ms/round on relayout copies plus a slowed
+    # reverse-direction backward kernel whose dhs cotangent arrived
+    # lane-rotated. Dense path only (the ring path pools in ICALstm).
+    time_pool: str | None = None
 
     @nn.compact
     def __call__(self, x, h0=None):
+        if self.time_pool not in (None, "mean"):
+            raise ValueError(f"unknown time_pool {self.time_pool!r}")
+        if self.time_pool is not None and self.sequence_axis is not None:
+            # a local-chunk mean would silently violate the global-mean
+            # contract on a sequence-sharded input; pooling across chunks is
+            # the caller's job (ICALstm's all_gather reduction)
+            raise ValueError("time_pool requires sequence_axis=None")
+        pool = (lambda s: jnp.mean(s, axis=1)) if self.time_pool == "mean" else (lambda s: s)
         per_dir = self.hidden_size // (2 if self.bidirectional else 1)
         fwd_cell = LSTMCell(
             per_dir, self.double_sigmoid_gates, self.use_pallas,
@@ -164,7 +181,7 @@ class BiLSTM(nn.Module):
                 axis_name=self.sequence_axis,
             )
         if not self.bidirectional:
-            return fwd, (h, c)
+            return pool(fwd), (h, c)
         rev_cell = LSTMCell(
             per_dir, self.double_sigmoid_gates, self.use_pallas,
             self.compute_dtype, name="rev"
@@ -182,7 +199,7 @@ class BiLSTM(nn.Module):
                 h0[0], h0[1], axis_name=self.sequence_axis,
             )
         return (
-            jnp.concatenate([fwd, rev], axis=2),
+            jnp.concatenate([pool(fwd), pool(rev)], axis=-1),
             (jnp.concatenate([h, hr], 1), jnp.concatenate([c, cr], 1)),
         )
 
@@ -237,6 +254,10 @@ class ICALstm(nn.Module):
             self.use_pallas,
             self.compute_dtype,
             self.sequence_axis,
+            # dense path: pool inside BiLSTM per direction — same values as
+            # mean-pooling the concat (models.py:109) without materializing
+            # the lane-misaligned [B, T, H_total] sequence concat
+            time_pool=None if self.sequence_axis is not None else "mean",
             name="lstm",
         )(enc)
         if self.sequence_axis is not None:
@@ -246,8 +267,6 @@ class ICALstm(nn.Module):
             o = jax.lax.all_gather(
                 o.sum(axis=1), self.sequence_axis
             ).sum(axis=0) / S
-        else:
-            o = jnp.mean(o, axis=1)  # mean-pool over windows (models.py:109)
         o = o.astype(jnp.float32)  # classifier head + BN stay full precision
 
         # classifier head (models.py:96-104); per-direction width totals

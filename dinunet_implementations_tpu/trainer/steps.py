@@ -223,15 +223,23 @@ def make_train_epoch_fn(
         rounds = steps // local_iterations
         L = rounds * local_iterations
 
-        def to_rounds(a):
-            a = a[:, :L].reshape((k, rounds, local_iterations) + a.shape[2:])
-            return jnp.moveaxis(a, 1, 0)  # [rounds, k, L, B, ...]
+        # split the steps axis in place ([k, rounds, L, B, ...] — a free
+        # reshape) and let each round dynamic-slice its batch out of the
+        # resident epoch arrays (equivalently XLA fuses the moveaxis form;
+        # this form just says it directly).
+        def split_rounds(a):
+            return a[:, :L].reshape((k, rounds, local_iterations) + a.shape[2:])
 
-        xr, yr, wr = to_rounds(x), to_rounds(y), to_rounds(w)
+        x_rounds, y_rounds, w_rounds = (
+            split_rounds(x), split_rounds(y), split_rounds(w)
+        )
 
-        def one_round(carry, batch):
+        def one_round(carry, r):
             params, batch_stats, opt_state, engine_state, rng, rnd = carry
-            xb, yb, wb = batch  # [k, L, B, ...]
+            xb, yb, wb = (
+                jax.lax.dynamic_index_in_dim(a, r, axis=1, keepdims=False)
+                for a in (x_rounds, y_rounds, w_rounds)
+            )  # [k, L, B, ...]
             rng, sub = jax.random.split(rng)
 
             def site_part(es, xs, ys, ws):
@@ -296,7 +304,7 @@ def make_train_epoch_fn(
             state.round,
         )
         (params, stats, opt_state, engine_state, rng, rnd), losses = jax.lax.scan(
-            one_round, carry0, (xr, yr, wr)
+            one_round, carry0, jnp.arange(rounds)
         )
         new_state = TrainState(
             params=params,
