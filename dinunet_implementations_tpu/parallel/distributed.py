@@ -1,0 +1,114 @@
+"""Multi-host (DCN) runtime — scaling the site mesh past one host.
+
+The reference scales out by running one Docker container per site on
+whatever machines the COINSTAC pipeline coordinator can reach, shipping
+JSON payloads over the network every round (reference ``entry.py:5``,
+``compspec.json:284-296``). The TPU-native equivalent keeps the exact same
+trust topology — one coordinator, N workers — but swaps the transport for
+XLA collectives:
+
+- :func:`distributed_init` is the COINSTAC-coordinator equivalent: it brings
+  up JAX's multi-process runtime so every host's chips join one global device
+  set (DCN between hosts, ICI within).
+- :func:`multihost_site_mesh` lays the ``(site, model)`` mesh over that
+  device set **hybrid-style**: the ``model`` (sequence/tensor) axis is packed
+  inside a host's ICI domain where bandwidth is highest, while the ``site``
+  axis spans hosts — so the only traffic that crosses DCN is the once-per-round
+  gradient aggregation, mirroring the reference's site-local-compute /
+  central-aggregation split (SURVEY.md §2.2 "Communication backend").
+
+Everything downstream (trainer/steps.py, engines/) is topology-agnostic:
+collectives take the axis *name*, so the same compiled program runs on a
+single chip, an 8-chip slice, or a multi-host pod — only the mesh changes.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from .mesh import MODEL_AXIS, SITE_AXIS
+
+_initialized = False
+
+
+def distributed_init(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    **kwargs,
+) -> bool:
+    """Join (or skip joining) the multi-host runtime.
+
+    Returns ``True`` when a multi-process runtime was initialized, ``False``
+    for the single-process case (``num_processes`` in (None, 1) with no
+    coordinator given) — callers can branch on it for logging only; nothing
+    else changes downstream.
+
+    With all arguments ``None``, JAX's own cluster autodetection applies
+    (TPU pod metadata, SLURM, etc.), so on a real pod this is simply
+    ``distributed_init(coordinator_address="host0:1234", num_processes=N,
+    process_id=rank)`` or no args at all.
+    """
+    global _initialized
+    if coordinator_address is None and num_processes in (None, 1):
+        return False
+    if _initialized:  # idempotent use — NB: probing jax.process_count()
+        return True   # here would initialize the backend and make
+    # jax.distributed.initialize() below raise ("must be called before any
+    # JAX calls"), so idempotency is tracked by module flag only
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kwargs,
+    )
+    _initialized = True
+    return True
+
+
+def multihost_site_mesh(
+    sites_per_process: int | None = None,
+    model_axis_size: int = 1,
+    devices: list | None = None,
+) -> jax.sharding.Mesh:
+    """A global ``(site, model)`` mesh over every process's devices.
+
+    The ``model`` axis is contiguous within each process's ICI domain; the
+    ``site`` axis tiles processes outer-most, so cross-site collectives (the
+    per-round aggregation) are the only DCN traffic. Single-process callers
+    get the same mesh :func:`parallel.mesh.make_site_mesh` would build.
+
+    ``sites_per_process`` defaults to ``local devices // model_axis_size``.
+    """
+    n_proc = jax.process_count()
+    devices = devices if devices is not None else jax.devices()
+    per_proc = len(devices) // n_proc
+    if sites_per_process is None:
+        sites_per_process = max(per_proc // model_axis_size, 1)
+    need = sites_per_process * model_axis_size
+    if need > per_proc:
+        raise ValueError(
+            f"{sites_per_process} sites × model={model_axis_size} needs "
+            f"{need} devices per process, have {per_proc}"
+        )
+    if need < per_proc:
+        # surplus chips idle (same contract as make_site_mesh's devices[:need]
+        # subset on one host): take each process's leading devices
+        by_proc: dict[int, list] = {}
+        for d in devices:
+            by_proc.setdefault(d.process_index, []).append(d)
+        devices = [d for p in sorted(by_proc) for d in by_proc[p][:need]]
+    if n_proc == 1:
+        arr = np.array(devices).reshape(sites_per_process, model_axis_size)
+        return jax.sharding.Mesh(arr, (SITE_AXIS, MODEL_AXIS))
+    from jax.experimental import mesh_utils
+
+    # per-ICI-slice shape × DCN shape: sites stack across processes (outer),
+    # the model axis never leaves a process
+    arr = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(sites_per_process, model_axis_size),
+        dcn_mesh_shape=(n_proc, 1),
+        devices=devices,
+    )
+    return jax.sharding.Mesh(arr, (SITE_AXIS, MODEL_AXIS))

@@ -74,6 +74,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="resume each fold from its latest checkpoint")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace per fold here")
+    p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                   help="multi-host runs: the jax.distributed coordinator "
+                        "(the COINSTAC-pipeline-coordinator equivalent); "
+                        "every process passes the same address")
+    p.add_argument("--num-processes", type=int, default=None,
+                   help="multi-host runs: total process count")
+    p.add_argument("--process-id", type=int, default=None,
+                   help="multi-host runs: this process's rank")
     p.add_argument("--quiet", action="store_true")
     p.add_argument("--set", dest="overrides", action="append", default=[],
                    metavar="KEY=VALUE",
@@ -97,6 +105,24 @@ def main(argv: list[str] | None = None) -> int:
             overrides[key] = val
     cfg = TrainConfig().with_overrides(overrides)
     verbose = not args.quiet
+
+    mh_flags = (args.coordinator, args.num_processes, args.process_id)
+    if any(f is not None for f in mh_flags):
+        if args.num_processes != 1 and not all(f is not None for f in mh_flags):
+            # a worker with a partial spec must not silently fall back to an
+            # independent single-process run on the full data
+            raise SystemExit(
+                "multi-host runs need all of --coordinator, --num-processes "
+                "and --process-id together (--num-processes 1 runs single-"
+                "process)"
+            )
+        from ..parallel.distributed import distributed_init
+
+        distributed_init(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
 
     if args.site is not None:
         if args.folds is not None or args.resume:

@@ -109,7 +109,21 @@ class FedRunner:
             n_mesh = n // k  # mesh site-axis size; k sites fold per device
             devs = jax.devices()
             cpus = [d for d in devs if d.platform == "cpu"]
-            if len(devs) >= n_mesh * m:
+            if jax.process_count() > 1:
+                # multi-host runtime (distributed_init): hybrid mesh — the
+                # model axis stays on each host's ICI, sites span DCN
+                from ..parallel.distributed import multihost_site_mesh
+
+                if n_mesh % jax.process_count():
+                    raise ValueError(
+                        f"{n_mesh} mesh sites must divide evenly over "
+                        f"{jax.process_count()} processes"
+                    )
+                mesh = multihost_site_mesh(
+                    sites_per_process=n_mesh // jax.process_count(),
+                    model_axis_size=m,
+                )
+            elif len(devs) >= n_mesh * m:
                 mesh = make_site_mesh(n_mesh, devs, model_axis_size=m)
             elif len(cpus) >= n_mesh * m:
                 mesh = host_mesh(n_mesh, model_axis_size=m)

@@ -74,10 +74,9 @@ def run(tile, cdt="bfloat16", chain=CHAIN, repeats=3):
         return time.time() - t0
 
     chain_run(2)  # compile
-    half = chain // 2
-    t_half = min(chain_run(half) for _ in range(repeats))
-    t_full = min(chain_run(chain) for _ in range(repeats))
-    dt = (t_full - t_half) / (chain - half)
+    from bench import least_contended_marginal  # shared clamped estimator
+
+    dt = least_contended_marginal(chain_run, chain, repeats=repeats)
     sps = ROWS / dt
     print(f"B_TILE={tile:4d} cdt={cdt}: {dt*1e3:8.3f} ms/iter  "
           f"({sps:,.0f} rows/s)", flush=True)

@@ -1,0 +1,67 @@
+"""Multi-host (DCN) layer — single-process behavior and mesh topology.
+
+True multi-process execution needs a pod; what IS testable on one host (and
+what these tests pin) is the contract everything else relies on:
+``distributed_init`` no-ops for single-process runs, ``multihost_site_mesh``
+degenerates to the plain ``(site, model)`` mesh, and the mesh it builds
+carries working collectives. The hybrid-DCN branch itself is exercised by the
+same ``mesh_utils.create_hybrid_device_mesh`` JAX ships for pod meshes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from dinunet_implementations_tpu.parallel import (
+    MODEL_AXIS,
+    SITE_AXIS,
+    distributed_init,
+    multihost_site_mesh,
+)
+
+
+def test_single_process_init_is_noop():
+    assert distributed_init() is False
+    assert distributed_init(num_processes=1) is False
+
+
+def test_mesh_shape_and_axis_names():
+    mesh = multihost_site_mesh(sites_per_process=4, model_axis_size=2)
+    assert dict(mesh.shape) == {SITE_AXIS: 4, MODEL_AXIS: 2}
+    assert mesh.axis_names == (SITE_AXIS, MODEL_AXIS)
+
+
+def test_mesh_defaults_fill_the_process():
+    mesh = multihost_site_mesh()
+    assert dict(mesh.shape) == {SITE_AXIS: len(jax.devices()), MODEL_AXIS: 1}
+
+
+def test_mesh_uses_leading_subset_when_devices_surplus():
+    # 3 sites x model=2 on 8 devices: 6 used, 2 idle (same contract as
+    # make_site_mesh's devices[:need] on one host)
+    mesh = multihost_site_mesh(sites_per_process=3, model_axis_size=2)
+    assert dict(mesh.shape) == {SITE_AXIS: 3, MODEL_AXIS: 2}
+    assert list(mesh.devices.flat) == jax.devices()[:6]
+
+
+def test_mesh_rejects_oversubscription():
+    with pytest.raises(ValueError, match="devices per process"):
+        multihost_site_mesh(sites_per_process=5, model_axis_size=2)
+
+
+def test_collectives_run_on_the_mesh():
+    mesh = multihost_site_mesh(sites_per_process=4, model_axis_size=2)
+    x = jnp.arange(8.0).reshape(4, 2)
+
+    out = jax.jit(
+        shard_map(
+            lambda v: jax.lax.psum(v, (SITE_AXIS, MODEL_AXIS)),
+            mesh=mesh,
+            in_specs=P(SITE_AXIS, MODEL_AXIS),
+            out_specs=P(SITE_AXIS, MODEL_AXIS),
+        )
+    )(x)
+    np.testing.assert_allclose(np.asarray(out), np.full((4, 2), x.sum()))
