@@ -101,9 +101,20 @@ class FreeSurferDataset(SiteDataset):
 
     def as_arrays(self) -> SiteArrays:
         n = len(self.indices)
-        feats = [read_aseg_stats(os.path.join(self.path(), f)) for f, _ in self.indices]
+        files = [os.path.join(self.path(), f) for f, _ in self.indices]
+        mat = None
+        if n:
+            # native threaded batch parse (native/fastio.cpp) — the first
+            # file is read in Python both to learn the feature count and to
+            # keep one exercised fallback-path sample per load
+            first = read_aseg_stats(files[0])
+            from .native_io import read_aseg_batch
+
+            mat = read_aseg_batch(files, len(first))
+            if mat is None:  # no compiler / malformed file → pure Python
+                mat = np.stack([first] + [read_aseg_stats(f) for f in files[1:]])
         return SiteArrays(
-            np.stack(feats) if n else np.zeros((0, 0), np.float32),
+            mat if n else np.zeros((0, 0), np.float32),
             np.asarray([y for _, y in self.indices], np.int32),
             np.arange(n, dtype=np.int32),
         )

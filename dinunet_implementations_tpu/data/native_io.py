@@ -1,0 +1,62 @@
+"""ctypes bridge to the native batch TSV reader (native/fastio.cpp).
+
+Replaces the reference's torch-DataLoader native worker pool for the
+FreeSurfer ingest path (reference ``comps/fs/__init__.py:33-39`` +
+``num_workers``): one call parses and max-normalizes every subject file on
+C++ threads. Bit-identical to :func:`data.freesurfer.read_aseg_stats`
+(strtod == Python float(); f64 normalize; f32 cast) — pinned by
+tests/test_native_io.py. Any failure (no compiler, malformed file, ragged
+feature counts) returns ``None`` and callers fall back to the Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+_lib = None
+_tried = False
+
+
+def _load():
+    global _lib, _tried
+    if not _tried:
+        _tried = True
+        from ..native import build_and_load
+
+        lib = build_and_load("fastio")
+        if lib is not None:
+            lib.fastio_read_aseg_batch.restype = ctypes.c_int
+            lib.fastio_read_aseg_batch.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p), ctypes.c_long, ctypes.c_long,
+                ctypes.POINTER(ctypes.c_float), ctypes.c_char_p, ctypes.c_long,
+            ]
+        _lib = lib
+    return _lib
+
+
+def read_aseg_batch(paths: list[str], n_feats: int) -> np.ndarray | None:
+    """Parse ``paths`` into a ``[len(paths), n_feats]`` float32 matrix, or
+    ``None`` when the native path is unavailable or any file fails."""
+    lib = _load()
+    if lib is None or not paths or n_feats <= 0:
+        return None
+    enc = [p.encode() for p in paths]
+    arr = (ctypes.c_char_p * len(enc))(*enc)
+    out = np.empty((len(paths), n_feats), np.float32)
+    errbuf = ctypes.create_string_buffer(512)
+    rc = lib.fastio_read_aseg_batch(
+        arr, len(paths), n_feats,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        errbuf, len(errbuf),
+    )
+    if rc != 0:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "native aseg parse failed (%s); falling back to the Python reader",
+            errbuf.value.decode(errors="replace"),
+        )
+        return None
+    return out

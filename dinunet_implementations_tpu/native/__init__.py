@@ -1,0 +1,50 @@
+"""Native (C++) runtime components.
+
+The reference's only native code is what it inherits from torch — most
+relevantly the DataLoader's native worker pool doing the per-item TSV reads
+(reference ``comps/fs/__init__.py:33-39`` + ``num_workers``,
+``compspec.json:185-192``). This package holds the TPU build's equivalents:
+small C++ components compiled on demand with the system toolchain and loaded
+via ctypes (no pybind11 dependency), each with a pure-Python fallback so the
+framework never hard-requires a compiler at runtime.
+
+Current components:
+- ``fastio.cpp`` — threaded batch parser for FreeSurfer aseg TSVs
+  (:func:`dinunet_implementations_tpu.data.native_io.read_aseg_batch`).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+_SRC_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def build_and_load(name: str) -> ctypes.CDLL | None:
+    """Compile ``native/<name>.cpp`` into a cached shared library and load it.
+
+    The cache key includes the source mtime+size, so edits rebuild. Returns
+    ``None`` on ANY failure (no compiler, compile error, load error) — callers
+    must treat native paths as optional accelerations with Python fallbacks.
+    """
+    src = os.path.join(_SRC_DIR, f"{name}.cpp")
+    try:
+        st = os.stat(src)
+        tag = f"{name}_{st.st_mtime_ns:x}_{st.st_size:x}"
+        lib_path = os.path.join(
+            tempfile.gettempdir(), f"dinunet_native_{tag}.so"
+        )
+        if not os.path.exists(lib_path):
+            tmp = lib_path + f".build{os.getpid()}"
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                 "-o", tmp, src],
+                check=True, capture_output=True, timeout=120,
+            )
+            os.replace(tmp, lib_path)  # atomic publish (concurrent builders)
+        return ctypes.CDLL(lib_path)
+    except Exception:
+        return None

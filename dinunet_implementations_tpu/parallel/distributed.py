@@ -28,6 +28,7 @@ import jax
 import numpy as np
 
 from .mesh import MODEL_AXIS, SITE_AXIS
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 _initialized = False
 
@@ -100,8 +101,9 @@ def multihost_site_mesh(
             by_proc.setdefault(d.process_index, []).append(d)
         devices = [d for p in sorted(by_proc) for d in by_proc[p][:need]]
     if n_proc == 1:
-        arr = np.array(devices).reshape(sites_per_process, model_axis_size)
-        return jax.sharding.Mesh(arr, (SITE_AXIS, MODEL_AXIS))
+        from .mesh import make_site_mesh
+
+        return make_site_mesh(sites_per_process, devices, model_axis_size)
     from jax.experimental import mesh_utils
 
     # per-ICI-slice shape × DCN shape: sites stack across processes (outer),
@@ -112,3 +114,45 @@ def multihost_site_mesh(
         devices=devices,
     )
     return jax.sharding.Mesh(arr, (SITE_AXIS, MODEL_AXIS))
+
+
+def spans_processes(mesh) -> bool:
+    """True when ``mesh`` includes devices of other processes (a real
+    multi-host mesh) — the cases where plain host-local arrays can neither
+    feed a shard_map nor be fetched with ``np.asarray``."""
+    if mesh is None:
+        return False
+    me = jax.process_index()
+    return any(d.process_index != me for d in mesh.devices.flat)
+
+
+def put_site_batch(mesh, arr, dtype=None):
+    """Ship a host-side ``[S, ...]`` per-site batch onto the mesh, split over
+    the site axis.
+
+    Single-process meshes: a plain committed ``device_put``. Multi-host
+    meshes: every process holds the full global batch (the runner loads the
+    same dataset tree on each host) and
+    ``jax.make_array_from_process_local_data`` takes each process's
+    addressable slices — the documented JAX recipe for feeding pjit across
+    hosts."""
+    a = np.asarray(arr)
+    if dtype is not None:
+        a = a.astype(dtype)
+    sh = NamedSharding(mesh, P(SITE_AXIS))
+    if spans_processes(mesh):
+        return jax.make_array_from_process_local_data(sh, a, global_shape=a.shape)
+    return jax.device_put(a, sh)
+
+
+def fetch_site_outputs(tree, mesh):
+    """Bring per-site (``P(site)``-sharded) outputs back to host numpy on
+    every process. Multi-host meshes need a ``process_allgather`` first —
+    ``np.asarray`` on an array spanning non-addressable devices raises."""
+    if not spans_processes(mesh):
+        return jax.tree.map(np.asarray, tree)
+    from jax.experimental import multihost_utils
+
+    return jax.tree.map(
+        np.asarray, multihost_utils.process_allgather(tree, tiled=True)
+    )

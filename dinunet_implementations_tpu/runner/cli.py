@@ -108,13 +108,18 @@ def main(argv: list[str] | None = None) -> int:
 
     mh_flags = (args.coordinator, args.num_processes, args.process_id)
     if any(f is not None for f in mh_flags):
-        if args.num_processes != 1 and not all(f is not None for f in mh_flags):
+        complete = all(f is not None for f in mh_flags)
+        solo = (args.num_processes == 1 and args.coordinator is None
+                and args.process_id is None)
+        if not complete and not solo:
             # a worker with a partial spec must not silently fall back to an
-            # independent single-process run on the full data
+            # independent single-process run on the full data (and a partial
+            # spec reaching jax.distributed.initialize dies with an obscure
+            # error instead of this one)
             raise SystemExit(
                 "multi-host runs need all of --coordinator, --num-processes "
-                "and --process-id together (--num-processes 1 runs single-"
-                "process)"
+                "and --process-id together (--num-processes 1 alone runs "
+                "single-process)"
             )
         from ..parallel.distributed import distributed_init
 

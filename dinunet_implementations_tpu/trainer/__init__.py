@@ -4,6 +4,7 @@ from .metrics import Averages, ClassificationMetrics, is_improvement
 from .steps import (
     FederatedTask,
     TrainState,
+    compile_epoch_aot,
     init_train_state,
     make_eval_fn,
     make_optimizer,

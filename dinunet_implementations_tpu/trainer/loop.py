@@ -80,6 +80,29 @@ class FederatedTrainer:
         self._input_dtype = getattr(model, "compute_dtype", None) or None
         self._cache: dict = {}  # duration bookkeeping, reference-keyed
 
+    def _coordinator(self) -> bool:
+        """Multi-host runs: only process 0 writes logs/checkpoints (every
+        process computes the identical replicated results; concurrent writers
+        to a shared output dir would race)."""
+        return jax.process_index() == 0
+
+    def _put_batch(self, fb):
+        """Device-side epoch arrays: inputs pre-cast to the compute dtype;
+        on a mesh, committed ``P(site)`` arrays (multi-host aware)."""
+        if self.mesh is not None:
+            from ..parallel.distributed import put_site_batch
+
+            return (
+                put_site_batch(self.mesh, fb.inputs, self._input_dtype),
+                put_site_batch(self.mesh, fb.labels),
+                put_site_batch(self.mesh, fb.weights),
+            )
+        return (
+            jnp.asarray(fb.inputs, dtype=self._input_dtype),
+            jnp.asarray(fb.labels),
+            jnp.asarray(fb.weights),
+        )
+
     # -- building blocks -------------------------------------------------
 
     def init_state(self, sample_x, num_sites: int | None = None) -> TrainState:
@@ -96,12 +119,7 @@ class FederatedTrainer:
             seed=self.cfg.seed * 100003 + epoch,
             pad_mode="wrap",
         )
-        state, losses = self.epoch_fn(
-            state,
-            jnp.asarray(fb.inputs, dtype=self._input_dtype),
-            jnp.asarray(fb.labels),
-            jnp.asarray(fb.weights),
-        )
+        state, losses = self.epoch_fn(state, *self._put_batch(fb))
         return state, np.asarray(losses)
 
     @staticmethod
@@ -146,14 +164,12 @@ class FederatedTrainer:
         the eval step already computes per-site probs/loss sums, so per-site
         logs (reference ``local{i}/logs.json``) come for free."""
         fb = plan_eval(sites, batch_size or self.cfg.batch_size)
-        probs, loss_sum, wsum = self.eval_fn(
-            state,
-            jnp.asarray(fb.inputs, dtype=self._input_dtype),
-            jnp.asarray(fb.labels),
-            jnp.asarray(fb.weights),
-        )
-        probs = np.asarray(probs)  # [S, steps, B, C]
-        loss_sum, wsum = np.asarray(loss_sum), np.asarray(wsum)
+        outs = self.eval_fn(state, *self._put_batch(fb))
+        from ..parallel.distributed import fetch_site_outputs
+
+        # [S, steps, B, C] probs + per-site sums; multi-host meshes gather
+        # the P(site)-sharded outputs before the host fetch
+        probs, loss_sum, wsum = fetch_site_outputs(outs, self.mesh)
         loss = float(loss_sum.sum() / max(wsum.sum(), 1.0))
         m = self._add_probs(
             self._new_metrics(probs.shape[-1]), probs, fb.labels, fb.weights
@@ -278,7 +294,7 @@ class FederatedTrainer:
                     ):
                         best_metric, best_epoch, best_state = score, epoch, state
                         since_best = 0
-                        if best_path:  # save-on-best during training
+                        if best_path and self._coordinator():  # save-on-best
                             save_checkpoint(
                                 best_path, best_state,
                                 meta={"best_val_epoch": best_epoch,
@@ -293,7 +309,7 @@ class FederatedTrainer:
                             + (" *" if best_epoch == epoch else "")
                         )
                     stop = since_best >= cfg.patience
-                    if latest_path:  # resume point at each validation boundary
+                    if latest_path and self._coordinator():  # resume point
                         save_checkpoint(
                             latest_path, state,
                             meta={"epoch": epoch, "best_val_epoch": best_epoch,
@@ -418,12 +434,7 @@ class FederatedTrainer:
             fb = plan_epoch(
                 masked, pa.batch_size, seed=self.cfg.seed * 7 + epoch, pad_mode="mask"
             )
-            pre_state, losses = pre_epoch_fn(
-                pre_state,
-                jnp.asarray(fb.inputs, dtype=self._input_dtype),
-                jnp.asarray(fb.labels),
-                jnp.asarray(fb.weights),
-            )
+            pre_state, losses = pre_epoch_fn(pre_state, *self._put_batch(fb))
             if verbose:
                 print(f"[pretrain site {largest}] epoch {epoch}: "
                       f"loss={np.asarray(losses).mean():.4f}")
@@ -438,6 +449,9 @@ class FederatedTrainer:
         )
 
     def _write_outputs(self, results, iter_durations, best_state, fold):
+        if not self._coordinator():
+            return  # every process computes identical replicated results;
+            # only process 0 touches the (shared) output directory
         cfg = self.cfg
         comp = self._cache.get("time_spent_on_computation", [])
         cum = self._cache.get("cumulative_total_duration", [])
