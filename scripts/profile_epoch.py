@@ -1,0 +1,101 @@
+"""Per-op device-time profile of the flagship bench epoch on the real chip.
+
+Captures a ``jax.profiler`` trace of the 32-site ICA-LSTM federated epoch
+(the bench.py configuration) and prints the top device ops by total
+duration — the tool that found the conv-emitter dW_hh lowering, the
+whole-input relayout copy, and the lane-misaligned BiLSTM concat in round 3.
+
+Usage: python scripts/profile_epoch.py [--aot] [--epochs N]
+  --aot  also apply compile_epoch_aot (the bench's resident-input layout)
+"""
+
+import collections
+import glob
+import gzip
+import json
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import bench
+from dinunet_implementations_tpu.engines import make_engine
+from dinunet_implementations_tpu.models import ICALstm
+from dinunet_implementations_tpu.trainer import (
+    FederatedTask,
+    compile_epoch_aot,
+    init_train_state,
+    make_optimizer,
+    make_train_epoch_fn,
+)
+
+TRACE_DIR = "/tmp/dinunet_epoch_trace"
+
+
+def main():
+    epochs = 10
+    if "--epochs" in sys.argv:
+        epochs = int(sys.argv[sys.argv.index("--epochs") + 1])
+    S, steps, B = bench.NUM_SITES, bench.STEPS_PER_EPOCH, bench.BATCH_PER_SITE
+    W, C, WL = bench.WINDOWS, bench.COMPS, bench.WLEN
+    model = ICALstm(input_size=bench.ENC_OUT, hidden_size=bench.HIDDEN,
+                    num_comps=C, window_size=WL, num_cls=2,
+                    compute_dtype="bfloat16")
+    task = FederatedTask(model)
+    engine = make_engine("dSGD")
+    opt = make_optimizer("adam", 1e-3)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(S, steps, B, W, C, WL)).astype(np.float32),
+                    dtype=jnp.bfloat16)
+    y = jnp.asarray((rng.random((S, steps, B)) > 0.5).astype(np.int32))
+    w = jnp.ones((S, steps, B), jnp.float32)
+    state0 = init_train_state(task, engine, opt, jax.random.PRNGKey(0),
+                              x[0, 0], num_sites=S)
+    epoch_fn = make_train_epoch_fn(task, engine, opt, mesh=None,
+                                   local_iterations=1)
+    if "--aot" in sys.argv:
+        epoch_fn, put_x = compile_epoch_aot(epoch_fn, state0, x, y, w)
+        x = put_x(x)
+
+    s = state0
+    for _ in range(2):
+        s, _ = epoch_fn(s, x, y, w)
+    jax.tree.map(np.asarray, s)
+
+    shutil.rmtree(TRACE_DIR, ignore_errors=True)
+    with jax.profiler.trace(TRACE_DIR):
+        s = state0
+        for _ in range(epochs):
+            s, _ = epoch_fn(s, x, y, w)
+        jax.tree.map(np.asarray, s)
+
+    path = glob.glob(os.path.join(
+        TRACE_DIR, "plugins/profile/*/*.trace.json.gz"))[0]
+    with gzip.open(path) as fh:
+        d = json.load(fh)
+    names = {}
+    for e in d.get("traceEvents", []):
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            names[(e["pid"], e["tid"])] = e["args"]["name"]
+    agg = collections.Counter()
+    cnt = collections.Counter()
+    for e in d.get("traceEvents", []):
+        if e.get("ph") != "X":
+            continue
+        tname = str(names.get((e["pid"], e["tid"]), "?"))
+        if "XLA" not in tname and "Module" not in tname:
+            continue
+        agg[e["name"]] += float(e.get("dur", 0))
+        cnt[e["name"]] += 1
+    print(f"top 25 device ops (us over {epochs} epochs; trace: {path})")
+    for n, v in agg.most_common(25):
+        print(f"{v:10.0f}  x{cnt[n]:4d}  {n[:80]}")
+
+
+if __name__ == "__main__":
+    main()
