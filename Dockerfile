@@ -1,0 +1,25 @@
+# Container packaging — the reference ships its computation as a COINSTAC
+# Docker image (reference Dockerfile:1-20: coinstac base + pip install +
+# CMD python entry.py). The TPU build's equivalent below: a plain Python
+# base (TPU runtimes provide their own jax/libtpu pairing — install the
+# matching jax[tpu] wheel for your fleet), the package installed from
+# source, and the CLI as the entry point.
+#
+# The clean-environment install + quick-start this image performs is
+# exercised outside Docker by scripts/package_smoke.sh (wheel build, fresh
+# venv, fixture run) — tests/test_packaging.py keeps it green.
+
+FROM python:3.12-slim
+
+# native toolchain for the optional C++ ingest component (data layer falls
+# back to pure Python when absent — see dinunet_implementations_tpu/native)
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /computation
+COPY . .
+RUN pip install --no-cache-dir .
+# TPU hosts: pip install "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+
+ENTRYPOINT ["dinunet-tpu"]
+CMD ["--help"]

@@ -1,13 +1,18 @@
-"""Analysis tooling: the reference's k-fold pretrain convergence study.
+"""Analysis tooling: the reference's two result notebooks, reproduced in-repo.
 
-Reference ``NB.ipynb`` cells 6-17 compare 10-fold FS-classification trained
-from scratch vs warm-started by pretraining on the largest site
-(``compspec.json:120-127``), reading per-fold ``logs.json`` /
-``test_metrics.csv`` and reporting the mean early-stop epoch (68.5 scratch
-vs 42.7 pretrained in the reference's published run) plus accuracy/F1
-boxplot data. This module reproduces that study in-repo against OUR outputs
-— including re-reading the ``logs.json`` files the runner wrote, which keeps
-the notebook-compatible log schema honest.
+1. :func:`pretrain_study` — reference ``NB.ipynb`` cells 6-17: 10-fold
+   FS-classification trained from scratch vs warm-started by pretraining on
+   the largest site (``compspec.json:120-127``), reading per-fold
+   ``logs.json`` / ``test_metrics.csv`` and reporting the mean early-stop
+   epoch (68.5 scratch vs 42.7 pretrained in the reference's published run)
+   plus accuracy/F1 boxplot data.
+2. :func:`engine_comparison` — reference ``nnlogs.ipynb`` cell 2: per
+   aggregation engine, the test ``[loss, AUC]`` plus total and compute-only
+   wall-clock, parsed from the run's ``logs.json`` (the table SURVEY.md §6
+   uses as the perf baseline).
+
+Both re-read the ``logs.json`` files the runner wrote, which keeps the
+notebook-compatible log schema honest.
 
 Usage::
 
@@ -50,6 +55,59 @@ def _arm_stats(logs: list[dict]) -> dict:
         "mean_test_auc": sum(aucs) / n,
         "mean_test_loss": sum(losses) / n,
     }
+
+
+def engine_comparison(
+    data_path: str,
+    out_dir: str,
+    engines: tuple[str, ...] = ("dSGD", "rankDAD", "powerSGD"),
+    base_cfg: TrainConfig | None = None,
+    fold: int = 0,
+    verbose: bool = False,
+) -> dict:
+    """The ``nnlogs.ipynb`` cell-2 table from our own runs.
+
+    Trains ``data_path`` once per engine, then parses each run's remote
+    ``logs.json`` exactly as the notebook does: test ``[loss, AUC]``,
+    cumulative wall-clock, and summed compute-only time. Returns per-engine
+    rows plus a rendered ``summary_markdown`` (written to
+    ``<out_dir>/engine_comparison.md``).
+    """
+    cfg = base_cfg or TrainConfig(agg_engine="dSGD", epochs=101, patience=35,
+                                  seed=0)
+    rows: dict = {}
+    for engine in engines:
+        arm_out = os.path.join(out_dir, engine)
+        runner = FedRunner(cfg.replace(agg_engine=engine),
+                           data_path=data_path, out_dir=arm_out)
+        runner.run(folds=[fold], verbose=verbose)
+        lg = _read_fold_logs(arm_out, runner.cfg.task_id, [fold])[0]
+        rows[engine] = {
+            "test_metrics": lg["test_metrics"][0],  # [loss, auc]
+            "total_duration": (lg["cumulative_total_duration"] or [0.0])[-1],
+            "computation_time": sum(lg["time_spent_on_computation"]),
+            "best_val_epoch": lg["best_val_epoch"],
+        }
+    lines = [
+        "# Aggregation-engine comparison (nnlogs.ipynb cell 2 equivalent)",
+        "",
+        f"Dataset: `{data_path}`, fold {fold}",
+        "",
+        "| engine | test [loss, AUC] | total s | compute s | best epoch |",
+        "|---|---|---|---|---|",
+    ]
+    for engine, r in rows.items():
+        loss, auc = r["test_metrics"]
+        lines.append(
+            f"| {engine} | [{loss:.5f}, {auc:.5f}] | "
+            f"{r['total_duration']:.1f} | {r['computation_time']:.1f} | "
+            f"{r['best_val_epoch']} |"
+        )
+    report = {"engines": rows, "summary_markdown": "\n".join(lines)}
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "engine_comparison.md"), "w") as fh:
+        fh.write(report["summary_markdown"] + "\n")
+    return report
 
 
 def pretrain_study(

@@ -38,3 +38,24 @@ def test_pretrain_study_shows_faster_convergence(tmp_path):
     assert "| scratch |" in md and "| pretrained |" in md
     csv_text = open(os.path.join(tmp_path, "pretrain_study.csv")).read()
     assert csv_text.count("\n") >= 7  # header + 2 arms x 3 folds
+
+
+def test_engine_comparison_table(tmp_path):
+    """nnlogs.ipynb cell-2 equivalent: per-engine [loss, AUC] + wall-clock
+    parsed back from our logs.json (fast config: 2 engines, few epochs)."""
+    from dinunet_implementations_tpu.analysis import engine_comparison
+    from dinunet_implementations_tpu.core.config import TrainConfig
+
+    cfg = TrainConfig(task_id="FS-Classification", epochs=4,
+                      validation_epochs=2, patience=10, seed=0)
+    report = engine_comparison(
+        FSL, str(tmp_path), engines=("dSGD", "rankDAD"), base_cfg=cfg
+    )
+    assert set(report["engines"]) == {"dSGD", "rankDAD"}
+    for row in report["engines"].values():
+        loss, auc = row["test_metrics"]
+        assert 0.0 <= auc <= 1.0 and loss > 0
+        assert row["computation_time"] > 0
+        assert row["total_duration"] >= row["computation_time"] * 0.5
+    md = open(os.path.join(tmp_path, "engine_comparison.md")).read()
+    assert "| dSGD |" in md and "| rankDAD |" in md
