@@ -34,6 +34,7 @@ from dinunet_implementations_tpu.models import (
 )
 from dinunet_implementations_tpu.trainer import (
     FederatedTask,
+    compile_epoch_aot,
     init_train_state,
     make_optimizer,
     make_train_epoch_fn,
@@ -49,8 +50,10 @@ def measure(name, model, x_shape, sites, engine_name, batch, engine_kw=None,
     task = FederatedTask(model)
     engine = make_engine(engine_name, **(engine_kw or {}))
     opt = make_optimizer("adam", 1e-3)
+    # inputs pre-cast to the model's compute dtype, as bench.py / the trainer
     x = jnp.asarray(
-        rng.normal(size=(sites, STEPS, batch) + x_shape).astype(np.float32)
+        rng.normal(size=(sites, STEPS, batch) + x_shape).astype(np.float32),
+        dtype=getattr(model, "compute_dtype", None),
     )
     y = jnp.asarray((rng.random((sites, STEPS, batch)) > 0.5).astype(np.int32))
     w = jnp.ones((sites, STEPS, batch), jnp.float32)
@@ -58,6 +61,9 @@ def measure(name, model, x_shape, sites, engine_name, batch, engine_kw=None,
         task, engine, opt, jax.random.PRNGKey(0), x[0, 0], num_sites=sites
     )
     epoch_fn = make_train_epoch_fn(task, engine, opt, mesh=None, local_iterations=1)
+    # resident inputs in the executable's preferred layout, as bench.py
+    epoch_fn, put_x = compile_epoch_aot(epoch_fn, state0, x, y, w)
+    x = put_x(x)
 
     def run(n):
         return chain_epochs(epoch_fn, state0, x, y, w, n)

@@ -37,16 +37,113 @@ def from_matrix(mat, like):
     return mat.reshape(like.shape).astype(like.dtype)
 
 
-def _cholqr(Y):
-    """Column-normalized shifted CholeskyQR2 of ``Y [m, r]`` → ``(Q, colnorm)``.
+def _normalize_cols(Y):
+    nc = jnp.linalg.norm(Y, axis=0)
+    # exactly-zero columns take canonical basis vectors, so a zero input
+    # still yields an ORTHONORMAL Q — matching Householder QR's behavior.
+    # powerSGD warm-starts its q factor from the previous round's P; a
+    # P=0 here would make q die permanently (q_new = MᵀP = 0 forever)
+    # while its error-feedback residual grows unflushed (review, r3).
+    fallback = jnp.eye(Y.shape[0], Y.shape[1], dtype=Y.dtype)
+    return jnp.where(nc > 0, Y / jnp.maximum(nc, 1e-30), fallback), nc
+
+
+def _small_cholesky(G):
+    """Unrolled Cholesky of tiny batched SPD matrices ``[..., r, r]``.
+
+    PURE jnp ops, no LAPACK custom-call: the TPU ``cholesky`` custom-call
+    costs ~1 µs per matrix REGARDLESS of batching (measured: [32, 10, 10]
+    ≈ 33 µs, [224, 10, 10] ≈ 231 µs on v5e — the work is sequential per
+    matrix inside the call), and the engines issue it inside every power
+    iteration. An unrolled textbook Cholesky–Banachiewicz is r static steps
+    of fused vector ops, identical math.
+    """
+    r = G.shape[-1]
+    L = jnp.zeros_like(G)
+    for j in range(r):
+        # j == 0 guards: zero-size contractions fail to partition under
+        # shard_map's manual-computation lowering
+        s = G[..., j, j] if j == 0 else (
+            G[..., j, j] - jnp.sum(L[..., j, :j] * L[..., j, :j], axis=-1)
+        )
+        ljj = jnp.sqrt(s)
+        if j + 1 < r:
+            col = G[..., j + 1:, j] if j == 0 else (
+                G[..., j + 1:, j] - jnp.einsum(
+                    "...ik,...k->...i", L[..., j + 1:, :j], L[..., j, :j]
+                )
+            )
+            L = L.at[..., j + 1:, j].set(col / ljj[..., None])
+        L = L.at[..., j, j].set(ljj)
+    return L
+
+
+def _small_tril_inverse(L):
+    """Inverse of tiny batched lower-triangular ``[..., r, r]`` by forward
+    substitution — r static steps, no ``triangular_solve`` custom-call
+    (same per-matrix-cost pathology as :func:`_small_cholesky`)."""
+    r = L.shape[-1]
+    eye = jnp.eye(r, dtype=L.dtype)
+    X = jnp.zeros_like(L)
+    for i in range(r):
+        row = jnp.broadcast_to(eye[i], L.shape[:-2] + (r,))
+        if i > 0:  # zero-size einsum fails under shard_map (see above)
+            row = row - jnp.einsum(
+                "...k,...kj->...j", L[..., i, :i], X[..., :i, :]
+            )
+        X = X.at[..., i, :].set(row / L[..., i, i][..., None])
+    return X
+
+
+def _cholqr_once_multi(Ys, shift):
+    """One column-normalized shifted CholeskyQR round, LOCKSTEP over a group
+    of same-r matrices (possibly different row counts).
+
+    The group's ``[r, r]`` Gram matrices stack and factor through the
+    unrolled :func:`_small_cholesky` + :func:`_small_tril_inverse` — zero
+    custom-calls (profiled ~45% of rankDAD's compression overhead when the
+    LAPACK calls were issued per leaf per iteration on v5e).
+
+    ``Q = Y·L⁻ᵀ`` via the explicit inverse (numerically the same triangular
+    system as solving against ``Yᵀ``, which cannot batch across differing
+    row counts).
+    """
+    pairs = [_normalize_cols(Y) for Y in Ys]
+    Yn = [p[0] for p in pairs]
+    ncs = [p[1] for p in pairs]
+    r = Yn[0].shape[-1]
+    eye = jnp.eye(r, dtype=Yn[0].dtype)
+    Gms = jnp.stack([Y.T @ Y for Y in Yn])  # [L, r, r]
+    tr = jnp.trace(Gms, axis1=-2, axis2=-1)[:, None, None]
+    Gms = Gms + (shift * tr + 1e-30) * eye
+    if jax.default_backend() == "tpu":
+        # on TPU the LAPACK custom-calls pay ~1 µs PER MATRIX regardless of
+        # batching; the unrolled forms are fused vector ops (the engines
+        # call this inside every power iteration). On CPU LAPACK is fine
+        # and the unrolled graph only bloats compile time.
+        Ls = _small_cholesky(Gms)
+        Linv = _small_tril_inverse(Ls)
+    else:
+        Ls = jnp.linalg.cholesky(Gms)
+        Linv = jax.scipy.linalg.solve_triangular(
+            Ls, jnp.broadcast_to(eye, Gms.shape), lower=True
+        )
+    Qs = [Y @ jnp.swapaxes(Linv[i], -1, -2) for i, Y in enumerate(Yn)]
+    return Qs, ncs
+
+
+def _cholqr_multi(Ys):
+    """Column-normalized shifted CholeskyQR2 of each ``Y [m_l, r]`` →
+    ``([Q_l], [colnorm_l])``, lockstep over the group.
 
     TPU-first replacement for ``jnp.linalg.qr``: Householder QR lowers to a
-    long sequential scalar loop on TPU, while this is two matmuls plus an
-    ``[r, r]`` Cholesky + triangular solve per round (r ≤ rank, default 10) —
-    MXU/batch friendly, and (unlike an eigh-based Löwdin orthonormalization,
-    which was tried and reverted) CONTINUOUS in Y: float-noise between the
-    vmapped and unbatched lowerings stays proportional instead of being
-    amplified by near-degenerate eigen-subspace mixing.
+    long sequential scalar loop on TPU, while this is two matmuls plus a
+    batched ``[r, r]`` Cholesky + triangular inverse per round (r ≤ rank,
+    default 10) — MXU/batch friendly, and (unlike an eigh-based Löwdin
+    orthonormalization, which was tried and reverted) CONTINUOUS in Y:
+    float-noise between the vmapped and unbatched lowerings stays
+    proportional instead of being amplified by near-degenerate
+    eigen-subspace mixing.
 
     Each round first normalizes columns, so the trace-relative Cholesky shift
     is a PER-COLUMN relative floor rather than a global one — a naive
@@ -59,68 +156,88 @@ def _cholqr(Y):
     ``colnorm`` is the pre-normalization column-norm vector of the first
     round — the σ-scale convergence proxy.
     """
-    r = Y.shape[1]
-    eye = jnp.eye(r, dtype=Y.dtype)
+    Q1s, colnorms = _cholqr_once_multi(Ys, 1e-6)
+    Q2s, _ = _cholqr_once_multi(Q1s, 1e-7)
+    return Q2s, colnorms
 
-    def once(Y, shift):
-        nc = jnp.linalg.norm(Y, axis=0)
-        # exactly-zero columns take canonical basis vectors, so a zero input
-        # still yields an ORTHONORMAL Q — matching Householder QR's behavior.
-        # powerSGD warm-starts its q factor from the previous round's P; a
-        # P=0 here would make q die permanently (q_new = MᵀP = 0 forever)
-        # while its error-feedback residual grows unflushed (review, r3).
-        fallback = jnp.eye(Y.shape[0], Y.shape[1], dtype=Y.dtype)
-        Y = jnp.where(nc > 0, Y / jnp.maximum(nc, 1e-30), fallback)
-        Gm = Y.T @ Y
-        L = jnp.linalg.cholesky(Gm + (shift * jnp.trace(Gm) + 1e-30) * eye)
-        Q = jax.scipy.linalg.solve_triangular(L, Y.T, lower=True).T
-        return Q, nc
 
-    Q1, colnorm = once(Y, 1e-6)
-    Q2, _ = once(Q1, 1e-7)
-    return Q2, colnorm
+def _cholqr(Y):
+    """Single-matrix convenience over :func:`_cholqr_multi`."""
+    Qs, colnorms = _cholqr_multi([Y])
+    return Qs[0], colnorms[0]
+
+
+def subspace_iteration_multi(Gs, rank: int, num_iters: int, tol: float):
+    """Rank-r factorizations ``G_l ≈ P_l @ Q_lᵀ`` by LOCKSTEP subspace (block
+    power) iteration over a group of matrices sharing
+    ``r = min(rank, m_l, n_l)``.
+
+    Each P_l is [m_l, r] orthonormal, Q_l = G_lᵀ P_l is [n_l, r].
+    Per-member trip counts keep the solo semantics (``dad_tol`` /
+    ``dad_num_pow_iters``): a member stops updating once its own relative
+    σ-estimate change drops below ``tol``; the shared loop runs until every
+    member converged or ``num_iters``. Orthonormalization is the lockstep
+    CholeskyQR2 (:func:`_cholqr_multi`) — one batched Cholesky custom-call
+    per iteration for the WHOLE group instead of one per layer, which is
+    where rankDAD's wall-clock went (see :func:`_cholqr_once_multi`).
+
+    σ estimates come from the orthonormalization's column norms for free —
+    ``‖(G Gᵀ P)ᵢ‖`` estimates σᵢ², so ``sqrt`` puts the convergence test on
+    the same σ scale the reference's ``dad_tol`` means.
+    """
+    Gs = [G.astype(jnp.float32) for G in Gs]
+    L = len(Gs)
+    r = min([rank] + [min(G.shape) for G in Gs])
+    # per-member key from its shape — identical to what each solo run drew
+    omegas = [
+        jax.random.normal(
+            jax.random.PRNGKey(G.shape[0] * 1000003 + G.shape[1]),
+            (G.shape[1], r), jnp.float32,
+        )
+        for G in Gs
+    ]
+    Ps, _ = _cholqr_multi([G @ om for G, om in zip(Gs, omegas)])
+    sigs = jnp.stack(
+        [jnp.linalg.norm(G.T @ P, axis=0) for G, P in zip(Gs, Ps)]
+    )  # [L, r] σ estimates, column order
+
+    def cond(carry):
+        i, _, _, deltas = carry
+        return jnp.logical_and(i < num_iters, jnp.max(deltas) > tol)
+
+    def body(carry):
+        i, Ps, sigs, deltas = carry
+        P_cand, colnorms = _cholqr_multi(
+            [G @ (G.T @ P) for G, P in zip(Gs, Ps)]
+        )
+        sig_new = jnp.sqrt(jnp.stack(colnorms))  # ‖G Gᵀ p‖ ≈ σ² → σ scale
+        delta_new = jnp.linalg.norm(sig_new - sigs, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(sigs, axis=-1), 1e-12
+        )
+        active = deltas > tol  # members still iterating (solo trip counts)
+        Ps = tuple(
+            jnp.where(active[l], P_cand[l], Ps[l]) for l in range(L)
+        )
+        sigs = jnp.where(active[:, None], sig_new, sigs)
+        deltas = jnp.where(active, delta_new, deltas)
+        return i + 1, Ps, sigs, deltas
+
+    # Tie the initial deltas to the Gs so their device-varying annotation
+    # matches the loop body's output under shard_map (per-site G ⇒ per-site
+    # delta).
+    deltas0 = jnp.full((L,), jnp.inf, jnp.float32) + 0.0 * sigs.sum(-1)
+    _, Ps, _, _ = jax.lax.while_loop(
+        cond, body, (jnp.zeros((), jnp.int32), tuple(Ps), sigs, deltas0)
+    )
+    return [(P, G.T @ P) for G, P in zip(Gs, Ps)]
 
 
 def subspace_iteration(G, rank: int, num_iters: int, tol: float, key=None):
-    """Rank-r factorization ``G ≈ P @ Q^T`` by subspace (block power) iteration.
-
-    P is [m, r] orthonormal, Q = G^T P is [n, r]. Early-exits when the relative
-    change of the singular-value estimates drops below ``tol`` (the
-    ``dad_tol`` semantics), else runs ``num_iters`` (``dad_num_pow_iters``).
-
-    Orthonormalization is column-normalized CholeskyQR2 (see :func:`_cholqr`)
-    and the singular-value estimates come from its column norms for free —
-    ``‖(G Gᵀ P)ᵢ‖`` estimates σᵢ², so ``sqrt`` puts the convergence test on
-    the same σ scale the reference's ``dad_tol`` means, without the extra
-    full ``Gᵀ P`` matmul per iteration a direct estimate would cost.
-    """
-    G = G.astype(jnp.float32)
-    m, n = G.shape
-    r = min(rank, m, n)
-    if key is None:
-        key = jax.random.PRNGKey(m * 1000003 + n)
-    omega = jax.random.normal(key, (n, r), jnp.float32)
-    Y = G @ omega  # [m, r]
-    P0, _ = _cholqr(Y)
-    sig0 = jnp.linalg.norm(G.T @ P0, axis=0)  # [r] σ estimates, column order
-
-    def cond(carry):
-        i, _, _, delta = carry
-        return jnp.logical_and(i < num_iters, delta > tol)
-
-    def body(carry):
-        i, P, sig, _ = carry
-        P_new, colnorm = _cholqr(G @ (G.T @ P))
-        sig_new = jnp.sqrt(colnorm)  # ‖G Gᵀ p‖ ≈ σ² → σ scale (see docstring)
-        delta = jnp.linalg.norm(sig_new - sig) / jnp.maximum(jnp.linalg.norm(sig), 1e-12)
-        return i + 1, P_new, sig_new, delta
-
-    # Tie the initial delta to G so its device-varying annotation matches the
-    # loop body's output under shard_map (per-site G ⇒ per-site delta).
-    delta0 = jnp.float32(jnp.inf) + 0.0 * jnp.sum(sig0)
-    _, P, _, _ = jax.lax.while_loop(cond, body, (jnp.zeros((), jnp.int32), P0, sig0, delta0))
-    Q = G.T @ P  # [n, r]
-    return P, Q
+    """Single-matrix rank-r factorization ``G ≈ P @ Qᵀ`` — a group of one
+    over :func:`subspace_iteration_multi` (``key`` kept for signature compat;
+    the per-shape default key is drawn inside the multi path)."""
+    del key
+    return subspace_iteration_multi([G], rank, num_iters, tol)[0]
 
 
 def orthonormalize(P):

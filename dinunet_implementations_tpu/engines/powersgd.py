@@ -57,6 +57,12 @@ def make_powersgd(
     def aggregate(grads, state, weight, axis_name):
         scale = site_weight_scale(weight, axis_name)
 
+        # Per leaf, NOT lockstep (unlike rankDAD): powerSGD's error-feedback
+        # matrix M is a full fp32 gradient copy, and a cross-leaf
+        # orthonormalization barrier would pin every leaf's M live at once —
+        # a whole-model fp32 peak-HBM bump (review finding, r3). The
+        # orthonormalization itself is custom-call-free (lowrank's unrolled
+        # Cholesky), so the per-leaf loop costs no LAPACK launches anyway.
         def agg_leaf(g, q, e):
             if q is None:
                 return (

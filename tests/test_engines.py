@@ -222,3 +222,62 @@ def test_orthonormalize_zero_input_recovers():
     np.testing.assert_allclose(
         np.asarray(P.T @ P), np.eye(4), atol=1e-5
     )
+
+
+def test_subspace_iteration_multi_matches_solo():
+    """Lockstep groups must keep solo semantics: same subspace, same
+    reconstruction, per-member trip counts."""
+    import numpy as np
+
+    from dinunet_implementations_tpu.engines.lowrank import (
+        subspace_iteration,
+        subspace_iteration_multi,
+    )
+
+    rng = np.random.default_rng(0)
+    Gs = [
+        jnp.asarray(rng.normal(size=(40, 24)).astype("float32")),
+        jnp.asarray(rng.normal(size=(64, 16)).astype("float32")),
+        jnp.asarray(rng.normal(size=(24, 48)).astype("float32")),
+    ]
+    multi = subspace_iteration_multi(Gs, 6, 8, 1e-4)
+    for G, (Pm, Qm) in zip(Gs, multi):
+        Ps, Qs_ = subspace_iteration(G, 6, 8, 1e-4)
+        # same projector (bases may differ by rotation only)
+        proj_m = Pm @ Pm.T
+        proj_s = Ps @ Ps.T
+        np.testing.assert_allclose(np.asarray(proj_m), np.asarray(proj_s),
+                                   atol=1e-3)
+        np.testing.assert_allclose(np.asarray(Pm @ Qm.T),
+                                   np.asarray(Ps @ Qs_.T), atol=1e-3)
+        # orthonormality of the lockstep result
+        np.testing.assert_allclose(np.asarray(Pm.T @ Pm), np.eye(6),
+                                   atol=1e-4)
+
+
+def test_small_cholesky_and_inverse_match_lapack():
+    """The TPU-path unrolled Cholesky / triangular inverse (used to avoid
+    the per-matrix-cost LAPACK custom-calls) must match LAPACK numerics."""
+    import numpy as np
+
+    from dinunet_implementations_tpu.engines.lowrank import (
+        _small_cholesky,
+        _small_tril_inverse,
+    )
+
+    rng = np.random.default_rng(0)
+    for shape in [(10, 10), (7, 4, 4), (32, 7, 10, 10)]:
+        r = shape[-1]
+        A = rng.normal(size=shape[:-2] + (r, r + 3)).astype("float32")
+        G = jnp.asarray(
+            A @ np.swapaxes(A, -1, -2) + 0.1 * np.eye(r, dtype="float32")
+        )
+        L = _small_cholesky(G)
+        np.testing.assert_allclose(
+            np.asarray(L), np.linalg.cholesky(np.asarray(G)),
+            atol=3e-5, rtol=1e-4,
+        )
+        X = _small_tril_inverse(L)
+        np.testing.assert_allclose(
+            np.asarray(X @ L), np.broadcast_to(np.eye(r), G.shape), atol=1e-5
+        )
