@@ -26,9 +26,12 @@ Four measured design points (flagship shape, 32 vmapped sites, v5e):
 - **dW lives OUTSIDE the kernel.** The weight gradient is the only cross-row
   reduction in BPTT; accumulating it in-kernel forced 4 extra outer-product
   dots per backward step AND made the kernel's outputs non-row-wise. Instead
-  the backward kernel streams out the gate pre-activation cotangents and dW
-  is one XLA einsum over the saved hidden sequence — a large, MXU-shaped
-  batched matmul.
+  the backward kernel streams out the gate pre-activation cotangents, which
+  concatenate on the FEATURE axis ([T, B, 4H]) so dx/dW_ih/dW_hh are plain
+  696-wide MXU matmuls — the k-batched einsum forms canonicalize into dots
+  XLA lowered through a ~3× slower convolution emitter (round 3 profiling;
+  einsum spelling alone cannot dodge it, only the concat's different
+  structure does).
 - **The backward takes PRE-transposed recurrent weights.** ``w[k].T`` inside
   the kernel re-ran a lane/sublane transpose on every one of the T grid
   steps and made the backward ~20× slower than the forward; transposing once
@@ -414,9 +417,6 @@ def _vjp_fused_bwd(compute_dtype, res, grads):
         *acts, cs, whh4, c0, dhs, dhT, dcT
     )
     cdt = jnp.dtype(cdt_name) if cdt_name else x.dtype
-    # the [4, T, B, H] stack looks like an extra materialization but XLA
-    # fuses it, and the single batched einsum beats four per-gate einsums
-    # (measured 1.10 vs 1.20 ms/iter at the bench shape on v5e)
     # Concatenate the four gate cotangents on the FEATURE axis ([T, B, 4H])
     # so dx / dW_ih / dW_hh are plain 696-wide matmuls. The k-batched einsum
     # forms ('tbh,ktbg->khg' etc.) canonicalize to [4,·,·]-batched dots that
