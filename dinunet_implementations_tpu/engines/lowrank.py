@@ -167,7 +167,8 @@ def _cholqr(Y):
     return Qs[0], colnorms[0]
 
 
-def subspace_iteration_multi(Gs, rank: int, num_iters: int, tol: float):
+def subspace_iteration_multi(Gs, rank: int, num_iters: int, tol: float,
+                             keys=None):
     """Rank-r factorizations ``G_l ≈ P_l @ Q_lᵀ`` by LOCKSTEP subspace (block
     power) iteration over a group of matrices sharing
     ``r = min(rank, m_l, n_l)``.
@@ -188,13 +189,20 @@ def subspace_iteration_multi(Gs, rank: int, num_iters: int, tol: float):
     Gs = [G.astype(jnp.float32) for G in Gs]
     L = len(Gs)
     r = min([rank] + [min(G.shape) for G in Gs])
-    # per-member key from its shape — identical to what each solo run drew
+    # per-member key from its shape — identical to what each solo run drew —
+    # unless the caller supplies explicit keys (``keys[l]`` may be None to
+    # keep the default for that member)
+    if keys is None:
+        keys = [None] * L
+    elif len(keys) != L:
+        raise ValueError(f"keys has {len(keys)} entries for {L} matrices")
     omegas = [
         jax.random.normal(
-            jax.random.PRNGKey(G.shape[0] * 1000003 + G.shape[1]),
+            jax.random.PRNGKey(G.shape[0] * 1000003 + G.shape[1])
+            if k is None else k,
             (G.shape[1], r), jnp.float32,
         )
-        for G in Gs
+        for G, k in zip(Gs, keys)
     ]
     Ps, _ = _cholqr_multi([G @ om for G, om in zip(Gs, omegas)])
     sigs = jnp.stack(
@@ -234,10 +242,12 @@ def subspace_iteration_multi(Gs, rank: int, num_iters: int, tol: float):
 
 def subspace_iteration(G, rank: int, num_iters: int, tol: float, key=None):
     """Single-matrix rank-r factorization ``G ≈ P @ Qᵀ`` — a group of one
-    over :func:`subspace_iteration_multi` (``key`` kept for signature compat;
-    the per-shape default key is drawn inside the multi path)."""
-    del key
-    return subspace_iteration_multi([G], rank, num_iters, tol)[0]
+    over :func:`subspace_iteration_multi`. An explicit ``key`` seeds the
+    random init Ω; ``None`` draws the per-shape default key (what the
+    engines use, so lockstep groups match solo runs)."""
+    return subspace_iteration_multi(
+        [G], rank, num_iters, tol, keys=None if key is None else [key]
+    )[0]
 
 
 def orthonormalize(P):

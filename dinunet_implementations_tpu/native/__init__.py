@@ -23,20 +23,51 @@ import tempfile
 _SRC_DIR = os.path.dirname(os.path.abspath(__file__))
 
 
+def _cache_dir() -> str:
+    """User-owned 0700 cache directory for compiled libraries.
+
+    The library is CDLL-loaded into the training process, so the cache must
+    not live at a predictable world-writable path (e.g. bare /tmp) where
+    another local user could pre-plant a .so (advisor finding r3). We create
+    the directory 0700 and refuse to use it unless it is owned by us and not
+    group/other-writable.
+    """
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    candidates = [
+        os.path.join(base, "dinunet_native"),
+        # fallback when $HOME is unwritable (containers): per-uid tmpdir
+        os.path.join(
+            tempfile.gettempdir(), f"dinunet_native_uid{os.getuid()}"
+        ),
+    ]
+    for path in candidates:
+        try:
+            os.makedirs(path, mode=0o700, exist_ok=True)
+            st = os.stat(path)
+            if st.st_uid == os.getuid() and not (st.st_mode & 0o022):
+                return path
+        except OSError:
+            continue
+    raise RuntimeError("no trustworthy native cache directory")
+
+
 def build_and_load(name: str) -> ctypes.CDLL | None:
     """Compile ``native/<name>.cpp`` into a cached shared library and load it.
 
-    The cache key includes the source mtime+size, so edits rebuild. Returns
-    ``None`` on ANY failure (no compiler, compile error, load error) — callers
-    must treat native paths as optional accelerations with Python fallbacks.
+    The cache key includes the source mtime+size, so edits rebuild. The cache
+    lives in a user-owned 0700 directory (:func:`_cache_dir`) and the .so is
+    re-verified as self-owned and non-world/group-writable before CDLL.
+    Returns ``None`` on ANY failure (no compiler, compile error, load error)
+    — callers must treat native paths as optional accelerations with Python
+    fallbacks.
     """
     src = os.path.join(_SRC_DIR, f"{name}.cpp")
     try:
         st = os.stat(src)
         tag = f"{name}_{st.st_mtime_ns:x}_{st.st_size:x}"
-        lib_path = os.path.join(
-            tempfile.gettempdir(), f"dinunet_native_{tag}.so"
-        )
+        lib_path = os.path.join(_cache_dir(), f"dinunet_native_{tag}.so")
         if not os.path.exists(lib_path):
             tmp = lib_path + f".build{os.getpid()}"
             subprocess.run(
@@ -44,7 +75,11 @@ def build_and_load(name: str) -> ctypes.CDLL | None:
                  "-o", tmp, src],
                 check=True, capture_output=True, timeout=120,
             )
+            os.chmod(tmp, 0o700)  # g++ honors umask; pin owner-only
             os.replace(tmp, lib_path)  # atomic publish (concurrent builders)
+        lst = os.stat(lib_path)
+        if lst.st_uid != os.getuid() or (lst.st_mode & 0o022):
+            return None  # not ours / tamperable — refuse to load
         return ctypes.CDLL(lib_path)
     except Exception:
         return None

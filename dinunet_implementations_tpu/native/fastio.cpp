@@ -83,6 +83,11 @@ std::string parse_one(const char* path, long n_feats, float* out) {
       while (rest < se && (*rest == ' ')) ++rest;
       if (res.ec != std::errc() || res.ptr == s || rest != se)
         return std::string("bad number in ") + path;
+      // NaN/inf would make the max-normalize below diverge from numpy's
+      // NaN-propagating np.max (advisor finding r3) — error out so the
+      // wrapper falls back to the bit-identical Python reader for the batch
+      if (!std::isfinite(v))
+        return std::string("non-finite value in ") + path;
       vals.push_back(v);
     }
     pos = nl + 1;

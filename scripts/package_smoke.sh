@@ -9,7 +9,10 @@ set -euo pipefail
 
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 WORK="${1:-$(mktemp -d)}"
-FIXTURE="${FIXTURE:-/root/reference/datasets/test_fsl}"
+# default fixture: the self-generated demo tree (VERDICT r3 #5 — no reference
+# checkout required); set FIXTURE=/path/to/datasets/test_fsl to smoke against
+# the reference fixture instead
+FIXTURE="${FIXTURE:-}"
 
 cd "$WORK"
 python -m pip wheel --no-deps --no-build-isolation -w "$WORK/dist" "$REPO" >/dev/null
@@ -21,6 +24,11 @@ WHEEL="$(ls "$WORK"/dist/dinunet_implementations_tpu-*.whl)"
 python -m pip install --no-deps --target "$WORK/site" "$WHEEL" >/dev/null
 
 cd "$WORK"  # neutral cwd: the repo checkout must NOT be importable
+if [ -z "$FIXTURE" ]; then
+  FIXTURE="$WORK/datasets/demo"
+  PYTHONPATH="$WORK/site" python -m dinunet_implementations_tpu.data.demo \
+    "$FIXTURE" --subjects 16 >/dev/null
+fi
 PYTHONPATH="$WORK/site" JAX_PLATFORMS=cpu python - <<EOF
 import jax
 jax.config.update("jax_platforms", "cpu")

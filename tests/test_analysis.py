@@ -40,6 +40,7 @@ def test_pretrain_study_shows_faster_convergence(tmp_path):
     assert csv_text.count("\n") >= 7  # header + 2 arms x 3 folds
 
 
+@pytest.mark.golden
 def test_engine_comparison_table(tmp_path):
     """nnlogs.ipynb cell-2 equivalent: per-engine [loss, AUC] + wall-clock
     parsed back from our logs.json (fast config: 2 engines, few epochs)."""

@@ -208,3 +208,55 @@ def test_label_coercion_deviation_and_bug_compat():
     assert coerce_label("1", bug_compatible=True) == 0
     assert coerce_label("true", bug_compatible=True) == 1
     assert coerce_label("yes", bug_compatible=True) == 0
+
+
+def test_demo_tree_fs_layout_and_loadable(tmp_path):
+    """The self-contained demo fixture (VERDICT r3 #5) generates the exact
+    simulator layout the runner discovers, and its data round-trips through
+    the real FS dataset loader."""
+    from dinunet_implementations_tpu.data.demo import make_demo_tree
+    from dinunet_implementations_tpu.runner.fed_runner import (
+        discover_site_dirs,
+        load_site_splits,
+    )
+    from dinunet_implementations_tpu.core.config import (
+        TrainConfig,
+        resolve_site_configs,
+    )
+
+    root = str(tmp_path / "demo")
+    make_demo_tree(root, n_sites=3, subjects=10)
+    dirs = discover_site_dirs(root)
+    assert len(dirs) == 3
+    cfg = TrainConfig(split_ratio=(0.7, 0.15, 0.15))
+    site_cfgs = resolve_site_configs(cfg, root, num_sites=3)
+    assert site_cfgs[1].fs_args.labels_file == "site2_Covariate.csv"
+    folds = load_site_splits(site_cfgs[0], dirs, site_cfgs)
+    assert len(folds) == 1
+    for arrs in folds[0]["train"]:
+        assert arrs.inputs.shape[1] == 66
+        assert arrs.inputs.dtype == np.float32
+        # per-subject row-max normalization applied (values in (0, 1])
+        assert arrs.inputs.max() <= 1.0 + 1e-6
+
+
+def test_demo_tree_ica_layout_and_loadable(tmp_path):
+    from dinunet_implementations_tpu.data.demo import make_demo_tree
+    from dinunet_implementations_tpu.runner.fed_runner import (
+        discover_site_dirs,
+        load_site_splits,
+    )
+    from dinunet_implementations_tpu.core.config import (
+        TrainConfig,
+        resolve_site_configs,
+    )
+
+    root = str(tmp_path / "demo_ica")
+    make_demo_tree(root, "ICA-Classification", n_sites=2, subjects=8)
+    dirs = discover_site_dirs(root)
+    cfg = TrainConfig(task_id="ICA-Classification", split_ratio=(0.7, 0.15, 0.15))
+    site_cfgs = resolve_site_configs(cfg, root, num_sites=2)
+    folds = load_site_splits(site_cfgs[0], dirs, site_cfgs)
+    # [subjects, windows, comps, window_size] per site
+    x = folds[0]["train"][0].inputs
+    assert x.ndim == 4 and x.shape[1] == 8 and x.shape[3] == 10

@@ -152,6 +152,23 @@ def test_subspace_iteration_exact_on_lowrank():
     np.testing.assert_allclose(np.asarray(P @ Q.T), G, atol=1e-3)
 
 
+def test_subspace_iteration_explicit_key_used():
+    """A caller-supplied PRNG key must actually seed the init Ω (advisor
+    finding r3: it was silently discarded): factorization quality holds with
+    an explicit key, and on a full-rank wide matrix stopped after a single
+    iteration (where Ω still matters) the result differs from the default."""
+    rng = np.random.default_rng(11)
+    G = jnp.asarray(
+        (rng.normal(size=(20, 3)) @ rng.normal(size=(3, 15))).astype(np.float32)
+    )
+    P, Q = subspace_iteration(G, 3, 20, 1e-10, key=jax.random.PRNGKey(123))
+    np.testing.assert_allclose(np.asarray(P @ Q.T), np.asarray(G), atol=1e-3)
+    Gf = jnp.asarray(rng.normal(size=(8, 24)).astype(np.float32))
+    P_d, _ = subspace_iteration(Gf, 4, 1, 0.0)
+    P_k, _ = subspace_iteration(Gf, 4, 1, 0.0, key=jax.random.PRNGKey(123))
+    assert not np.allclose(np.asarray(P_d), np.asarray(P_k))
+
+
 def test_subspace_iteration_tol_early_exit():
     """A huge tol stops after the first refinement (initial delta is inf, so
     exactly one iteration runs) — same result as num_iters=1, under jit."""
