@@ -107,11 +107,19 @@ def multihost_site_mesh(
     from jax.experimental import mesh_utils
 
     # per-ICI-slice shape × DCN shape: sites stack across processes (outer),
-    # the model axis never leaves a process
+    # the model axis never leaves a process. The DCN granule is the TPU
+    # slice when slices map 1:1 to processes (the usual pod config — gives
+    # ICI-topology-aware ordering within each slice); otherwise the process
+    # itself (mesh_utils' documented fallback for platforms without usable
+    # slice_index — e.g. multi-process CPU, where every device reports
+    # slice 0 and slice-granule mode would reject the (n_proc, 1) shape).
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    by_process = None in slice_ids or len(slice_ids) != n_proc
     arr = mesh_utils.create_hybrid_device_mesh(
         mesh_shape=(sites_per_process, model_axis_size),
         dcn_mesh_shape=(n_proc, 1),
         devices=devices,
+        process_is_granule=by_process,
     )
     return jax.sharding.Mesh(arr, (SITE_AXIS, MODEL_AXIS))
 

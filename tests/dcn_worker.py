@@ -1,0 +1,84 @@
+"""Worker process for the live multi-process DCN test (test_distributed.py).
+
+Each invocation is one "host" of a 2-process jax.distributed CPU cluster
+(the COINSTAC one-container-per-site execution model, reference
+``entry.py:5`` / ``compspec.json:284-295``, collapsed to one coordinated
+JAX runtime):
+
+    python dcn_worker.py <port> <num_processes> <process_id> \
+        <data_path> <out_dir> <report_path>
+
+With ``num_processes=1`` the same script runs the single-process reference
+run the test compares against. The report JSON records the per-epoch losses
+(bit-compared across processes and topologies), whether the mesh actually
+spans processes, and how many times this process invoked the log writer —
+proving the process-0-only output contract.
+"""
+
+import json
+import os
+import sys
+
+port, nproc, pid, data_path, out_dir, report = sys.argv[1:7]
+nproc, pid = int(nproc), int(pid)
+
+import jax
+
+# config knobs, not env vars: sitecustomize imports jax at interpreter start
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 4)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dinunet_implementations_tpu.parallel import distributed_init  # noqa: E402
+
+multi = distributed_init(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=nproc, process_id=pid,
+) if nproc > 1 else distributed_init()
+
+import dinunet_implementations_tpu.trainer.loop as loop_mod  # noqa: E402
+from dinunet_implementations_tpu import TrainConfig  # noqa: E402
+from dinunet_implementations_tpu.parallel.distributed import (  # noqa: E402
+    spans_processes,
+)
+from dinunet_implementations_tpu.runner import FedRunner  # noqa: E402
+
+writes = {"logs": 0, "ckpt": 0}
+_orig_logs = loop_mod.write_logs_json
+_orig_ckpt = loop_mod.save_checkpoint
+
+
+def _count_logs(*a, **k):
+    writes["logs"] += 1
+    return _orig_logs(*a, **k)
+
+
+def _count_ckpt(*a, **k):
+    writes["ckpt"] += 1
+    return _orig_ckpt(*a, **k)
+
+
+loop_mod.write_logs_json = _count_logs
+loop_mod.save_checkpoint = _count_ckpt
+
+cfg = TrainConfig(
+    task_id="FS-Classification", epochs=4, validation_epochs=2, patience=10,
+    batch_size=8, split_ratio=(0.7, 0.15, 0.15), seed=0,
+)
+runner = FedRunner(cfg, data_path=data_path, out_dir=out_dir)
+res = runner.run(verbose=False)[0]
+
+with open(report, "w") as fh:
+    json.dump({
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "global_devices": len(jax.devices()),
+        "local_devices": len(jax.local_devices()),
+        "multi": bool(multi),
+        "mesh_spans_processes": spans_processes(runner.mesh),
+        "mesh_shape": dict(runner.mesh.shape),
+        "epoch_losses": [float(x) for x in res["epoch_losses"]],
+        "test_metrics": res["test_metrics"],
+        "n_log_writes": writes["logs"],
+        "n_ckpt_writes": writes["ckpt"],
+    }, fh)
