@@ -126,6 +126,26 @@ class LSTMCell(nn.Module):
         return jnp.swapaxes(hs, 0, 1), (hT, cT)
 
 
+class _LSTMCellParams(nn.Module):
+    """Parameter-only twin of :class:`LSTMCell` — declares the exact same
+    param tree (names, shapes, inits) without running the recurrence, so the
+    fused bidirectional kernel (one pallas_call spanning both directions,
+    ops/lstm_pallas.py) can own the compute while checkpoints/params remain
+    interchangeable with the per-direction cell modules."""
+
+    in_dim: int
+    hidden: int
+
+    @nn.compact
+    def __call__(self):
+        D, H = self.in_dim, self.hidden
+        w_ih = self.param("w_ih", TorchLinearInit.kernel, (D, 4 * H))
+        b_ih = self.param("b_ih", TorchLinearInit.bias_for(D), (4 * H,))
+        w_hh = self.param("w_hh", TorchLinearInit.kernel, (H, 4 * H))
+        b_hh = self.param("b_hh", TorchLinearInit.bias_for(H), (4 * H,))
+        return w_ih, b_ih + b_hh, w_hh
+
+
 class BiLSTM(nn.Module):
     """Bidirectional wrapper (reference ``comps/icalstm/models.py:48-66``):
     ``hidden_size`` is the *total* width, split across directions.
@@ -164,6 +184,36 @@ class BiLSTM(nn.Module):
             raise ValueError("time_pool requires sequence_axis=None")
         pool = (lambda s: jnp.mean(s, axis=1)) if self.time_pool == "mean" else (lambda s: s)
         per_dir = self.hidden_size // (2 if self.bidirectional else 1)
+
+        use_pallas = (
+            self.use_pallas if self.use_pallas is not None else _auto_pallas()
+        ) and not self.double_sigmoid_gates
+        if self.bidirectional and use_pallas and self.time_pool == "mean":
+            # fused bidirectional kernel: ONE pallas sweep advances both
+            # directions (rev reads x through a time-flipped index map) and
+            # the VJP runs flip-free. Param trees are identical to the
+            # per-cell path (_LSTMCellParams). Restricted to the mean-pooled
+            # path because the kernel returns hs_r in x-time convention —
+            # the pool is time-order-invariant, while the sequence-returning
+            # path must preserve the reference's no-flip-back concat order.
+            # (time_pool == "mean" implies sequence_axis is None, checked
+            # above.)
+            from ..ops.lstm_pallas import bilstm_pool_forward_fused
+
+            pf = _LSTMCellParams(x.shape[-1], per_dir, name="fwd")()
+            pr = _LSTMCellParams(x.shape[-1], per_dir, name="rev")()
+            h02 = None if h0 is None else jnp.stack([h0[0], h0[0]])
+            c02 = None if h0 is None else jnp.stack([h0[1], h0[1]])
+            pooled, (hT2, cT2) = bilstm_pool_forward_fused(
+                x, pf, pr, h02, c02,
+                compute_dtype=compute_dtype_of(self.compute_dtype),
+            )
+            return (
+                pooled,
+                (jnp.concatenate([hT2[0], hT2[1]], 1),
+                 jnp.concatenate([cT2[0], cT2[1]], 1)),
+            )
+
         fwd_cell = LSTMCell(
             per_dir, self.double_sigmoid_gates, self.use_pallas,
             self.compute_dtype, name="fwd"
