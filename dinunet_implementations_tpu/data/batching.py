@@ -84,7 +84,13 @@ def plan_epoch(
         per_site.append(_site_batches(s, batch_size, order, drop_last))
 
     steps = max(len(b) for b in per_site)
-    assert steps > 0, f"no site yields a batch (batch_size={batch_size}, drop_last={drop_last})"
+    assert steps > 0, (
+        f"no site yields a batch: batch_size={batch_size} exceeds every "
+        f"site's sample count {[len(s) for s in sites]} with "
+        f"drop_last={drop_last} — lower batch_size to at most "
+        f"{max(len(s) for s in sites)} (FederatedTrainer.fit clamps this "
+        "automatically)"
+    )
 
     inputs = np.zeros((S, steps, batch_size) + feat_shape, np.float32)
     labels = np.zeros((S, steps, batch_size), np.int32)

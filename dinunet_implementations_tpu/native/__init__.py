@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import stat
 import subprocess
 import tempfile
 
@@ -45,8 +46,20 @@ def _cache_dir() -> str:
     for path in candidates:
         try:
             os.makedirs(path, mode=0o700, exist_ok=True)
-            st = os.stat(path)
-            if st.st_uid == os.getuid() and not (st.st_mode & 0o022):
+            # lstat + symlink rejection (advisor r4): os.stat follows
+            # symlinks, so a pre-planted link at the predictable /tmp
+            # fallback pointing at a victim-owned 0700 directory would pass
+            # the uid/mode check and redirect our .so writes there.
+            st = os.lstat(path)
+            # S_ISDIR on the lstat result covers the symlink case too (a
+            # symlink's mode is S_IFLNK) and keeps uid/mode/type checks on
+            # ONE inode snapshot — separate islink/isdir calls could each
+            # observe different filesystem states.
+            if (
+                stat.S_ISDIR(st.st_mode)
+                and st.st_uid == os.getuid()
+                and not (st.st_mode & 0o022)
+            ):
                 return path
         except OSError:
             continue

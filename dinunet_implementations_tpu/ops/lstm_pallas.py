@@ -1323,6 +1323,11 @@ def _vjp_pool_bwd(compute_dtype, res, grads):
         cdt_name, xT, wih2, b2, whh2, h02p, hsf, hsr, outs
     )
     dx = jnp.swapaxes(dxT, 0, 1)[:B].astype(x_wit.dtype)
+    # The kernel streams are row-padded to the batch tile; dx is sliced back
+    # above, and the carry cotangents need the same trim (pad rows carry
+    # exactly-zero gradient, so slicing is exact).
+    dh02 = dh02[:, :B]
+    dc02 = dc02[:, :B]
     return dx, dwih2, db2, dwhh2, dh02, dc02
 
 

@@ -207,6 +207,53 @@ class FederatedTrainer:
             return self.test_only(test_sites, fold=fold)
         t_start = time.time()
         self._num_sites = len(train_sites)
+        # Heterogeneous-site guard (VERDICT r4 #6): with drop_last train
+        # batching, a site smaller than batch_size yields ZERO batches and
+        # contributes nothing (or, if every site is small, plan_epoch
+        # asserts). Clamp to the smallest non-empty site's train split so any
+        # demo-sized tree trains, and say so.
+        sizes = [
+            (len(a), len(b), len(c))
+            for a, b, c in zip(train_sites, val_sites, test_sites)
+        ]
+        for name, split_sites in (("train", train_sites), ("test", test_sites)):
+            if not any(len(s) for s in split_sites):
+                raise ValueError(
+                    f"the {name} split is empty at every site (site train/"
+                    f"val/test sizes: {sizes}; split_ratio="
+                    f"{cfg.split_ratio}) — use more subjects per site or a "
+                    "split_ratio that gives each split at least one sample "
+                    "somewhere"
+                )
+        # Empty validation EVERYWHERE is a supported configuration
+        # (kfold_splits k==2 has no fold left for validation, splits.py:41-45):
+        # skip validation-based selection and keep the final state.
+        has_val = any(len(s) for s in val_sites)
+        min_site = min((len(s) for s in train_sites if len(s)), default=0)
+        if 0 < min_site < cfg.batch_size:
+            # Heterogeneous-site guard (VERDICT r4 #6): with drop_last train
+            # batching, a site smaller than batch_size yields ZERO batches
+            # and contributes nothing (or, if every site is small, plan_epoch
+            # asserts). Clamp so any demo-sized tree trains, and say so.
+            # replace(), not in-place: self.cfg is shared with the caller
+            # (FedRunner hands one config object to every fold's trainer).
+            if verbose:
+                print(
+                    f"[warn] batch_size={cfg.batch_size} exceeds the smallest "
+                    f"site's train split ({min_site} samples); clamping "
+                    f"batch_size to {min_site} for this fold (drop_last "
+                    "batching would starve that site). Pass a batch_size <= "
+                    f"{min_site} to silence this."
+                )
+            cfg = self.cfg = cfg.replace(batch_size=min_site)
+        if verbose:
+            for i, s in enumerate(train_sites):
+                if not len(s):
+                    print(
+                        f"[warn] site {i} has an empty train split "
+                        f"(train/val/test sizes: {sizes[i]}) — it will "
+                        "contribute nothing to training this fold"
+                    )
         state = self.init_state(jnp.ones((cfg.batch_size,) + train_sites[0].inputs.shape[1:], jnp.float32))
 
         latest_path = best_path = None
@@ -287,27 +334,37 @@ class FederatedTrainer:
                 iter_durations.extend([(time.time() - e_start) / rounds] * rounds)
 
                 if epoch % cfg.validation_epochs == 0:
-                    val_avg, val_metrics = self.evaluate(state, val_sites)
-                    score = val_metrics.value(monitor) if monitor != "loss" else val_avg.avg
-                    if is_improvement(
-                        score, best_metric, direction if monitor != "loss" else "minimize"
-                    ):
-                        best_metric, best_epoch, best_state = score, epoch, state
-                        since_best = 0
-                        if best_path and self._coordinator():  # save-on-best
-                            save_checkpoint(
-                                best_path, best_state,
-                                meta={"best_val_epoch": best_epoch,
-                                      "best_val_metric": best_metric, "fold": fold},
+                    if has_val:
+                        val_avg, val_metrics = self.evaluate(state, val_sites)
+                        score = val_metrics.value(monitor) if monitor != "loss" else val_avg.avg
+                        if is_improvement(
+                            score, best_metric, direction if monitor != "loss" else "minimize"
+                        ):
+                            best_metric, best_epoch, best_state = score, epoch, state
+                            since_best = 0
+                            if best_path and self._coordinator():  # save-on-best
+                                save_checkpoint(
+                                    best_path, best_state,
+                                    meta={"best_val_epoch": best_epoch,
+                                          "best_val_metric": best_metric, "fold": fold},
+                                )
+                        else:
+                            since_best += cfg.validation_epochs
+                        if verbose:
+                            print(
+                                f"[fold {fold}] epoch {epoch}: train_loss={losses.mean():.4f} "
+                                + self._format_val_line(val_avg, val_metrics, monitor)
+                                + (" *" if best_epoch == epoch else "")
                             )
                     else:
-                        since_best += cfg.validation_epochs
-                    if verbose:
-                        print(
-                            f"[fold {fold}] epoch {epoch}: train_loss={losses.mean():.4f} "
-                            + self._format_val_line(val_avg, val_metrics, monitor)
-                            + (" *" if best_epoch == epoch else "")
-                        )
+                        # no validation anywhere (kfold k==2): the latest
+                        # state is the selected state; no early stopping
+                        best_epoch, best_state = epoch, state
+                        if verbose:
+                            print(
+                                f"[fold {fold}] epoch {epoch}: "
+                                f"train_loss={losses.mean():.4f} (no validation split)"
+                            )
                     stop = since_best >= cfg.patience
                     if latest_path and self._coordinator():  # resume point
                         save_checkpoint(
@@ -337,9 +394,12 @@ class FederatedTrainer:
         # validation_epochs), best_state would be the untrained init — run a
         # final validation so the trained weights compete for selection.
         if best_metric is None and cfg.epochs > 0:
-            val_avg, val_metrics = self.evaluate(state, val_sites)
-            score = val_metrics.value(monitor) if monitor != "loss" else val_avg.avg
-            best_metric, best_epoch, best_state = score, stop_epoch, state
+            if has_val:
+                val_avg, val_metrics = self.evaluate(state, val_sites)
+                score = val_metrics.value(monitor) if monitor != "loss" else val_avg.avg
+                best_metric, best_epoch, best_state = score, stop_epoch, state
+            else:
+                best_epoch, best_state = stop_epoch, state
 
         # --- test with the best state (reference: best-epoch checkpoint)
         results = self._test_results(best_state, test_sites, best_epoch,

@@ -131,3 +131,29 @@ def test_native_cache_dir_is_private():
     st = os.stat(d)
     assert st.st_uid == os.getuid()
     assert not (st.st_mode & 0o022), oct(st.st_mode)
+
+
+def test_native_cache_dir_rejects_symlink(monkeypatch, tmp_path):
+    """Advisor r4: a pre-planted symlink at the predictable fallback path
+    (pointing at a victim-owned 0700 dir that passes the stat check) must be
+    rejected — the check uses lstat + islink, not stat."""
+    from dinunet_implementations_tpu import native
+
+    victim = tmp_path / "victim"
+    victim.mkdir(mode=0o700)
+    fake_home = tmp_path / "home"  # unwritable cache base → fallback used
+    link = tmp_path / f"dinunet_native_uid{os.getuid()}"
+    link.symlink_to(victim)
+    monkeypatch.setenv("XDG_CACHE_HOME", str(fake_home / "nope" / "deep"))
+    monkeypatch.setattr(
+        native.tempfile, "gettempdir", lambda: str(tmp_path)
+    )
+    # the XDG candidate IS creatable here (makedirs makes parents), so force
+    # it to fail by pointing it at a file
+    (fake_home).write_text("not a dir")
+    with pytest.raises(RuntimeError, match="no trustworthy"):
+        native._cache_dir()
+    # and with the planted link removed, the fallback works again
+    link.unlink()
+    d = native._cache_dir()
+    assert os.path.realpath(d) == os.path.realpath(str(link))

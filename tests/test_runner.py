@@ -193,3 +193,16 @@ def test_fed_runner_explicit_fold_ids_write_correct_dirs(tmp_path):
     r.run(folds=[1], verbose=False)
     assert os.path.isdir(tmp_path / "remote/simulatorRun/FS-Classification/fold_1")
     assert not os.path.isdir(tmp_path / "remote/simulatorRun/FS-Classification/fold_0")
+
+
+def test_fed_runner_kfold_k2_empty_validation(tmp_path):
+    """kfold k==2 has no validation fold by design (splits.py:41-45): fit
+    must skip validation-based selection (final state selected, no early
+    stop) instead of crashing — review finding r5."""
+    cfg = TrainConfig(epochs=2, num_folds=2)
+    r = FedRunner(cfg, data_path=FSL, out_dir=str(tmp_path))
+    results = r.run(folds=[0], verbose=False)
+    assert len(results) == 1
+    assert results[0]["best_val_metric"] is None
+    assert results[0]["best_val_epoch"] == 2  # final epoch selected
+    assert 0 <= results[0]["test_scores"]["auc"] <= 1
