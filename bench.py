@@ -104,7 +104,7 @@ def flops_per_sample() -> float:
     return 3.0 * (enc + lstm + head)
 
 
-def measure_tpu() -> float:
+def measure_tpu(fused_bidir: bool | None = None, repeats: int = 5) -> float:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -121,9 +121,12 @@ def measure_tpu() -> float:
 
     # bf16 matmuls AND streamed activations with f32 carries/accumulation;
     # the fused Pallas kernel keeps W_ih/W_hh resident in VMEM and streams
-    # the raw x once per step (ops/lstm_pallas.py)
+    # the raw x once per step (ops/lstm_pallas.py). fused_bidir=False is the
+    # A/B arm: two single-direction kernel sweeps instead of the fused
+    # bidirectional pooled kernel (VERDICT r4 #1b).
     model = ICALstm(input_size=ENC_OUT, hidden_size=HIDDEN, num_comps=COMPS,
-                    window_size=WLEN, num_cls=2, compute_dtype="bfloat16")
+                    window_size=WLEN, num_cls=2, compute_dtype="bfloat16",
+                    fused_bidir=fused_bidir)
     task = FederatedTask(model)
     engine = make_engine("dSGD")
     opt = make_optimizer("adam", 1e-3)
@@ -154,7 +157,7 @@ def measure_tpu() -> float:
     # so more samples raise the odds of catching an uncontended one
     dt = least_contended_marginal(
         lambda k: chain_epochs(epoch_fn, state0, x, y, w, k), TIMED_EPOCHS,
-        repeats=5,
+        repeats=repeats,
     )
 
     n_chips = 1  # the folded site axis runs on one chip
@@ -196,6 +199,19 @@ def main():
             baseline = measure_cpu_baseline()
         except Exception:
             pass
+    if "--ab-bidir" in sys.argv:
+        # A/B the fused bidirectional pooled kernel against two
+        # single-direction sweeps, same process, interleaved endpoints are
+        # not needed — each arm uses the least-contended-minimum estimator.
+        for arm, fused in (("fused-bidir", True), ("per-direction", False)):
+            v = measure_tpu(fused_bidir=fused, repeats=3)
+            print(json.dumps({
+                "metric": f"samples/sec/chip (flagship, {arm})",
+                "arm": arm, "value": round(v, 2),
+                "unit": "samples/sec/chip",
+                "mfu": round(v * flops_per_sample() / V5E_BF16_PEAK_FLOPS, 4),
+            }), flush=True)
+        return
     value = measure_tpu()
     print(json.dumps({
         "metric": "samples/sec/chip (ICA-LSTM, 32 sites, full federated round)",

@@ -43,9 +43,46 @@ from dinunet_implementations_tpu.trainer import (
 TIMED_EPOCHS = 16
 STEPS = 2
 
+V5E_BF16_PEAK_FLOPS = 197e12
+
+
+# --- per-config matmul-FLOP models (fwd ≈ listed matmuls; train ≈ 3× fwd
+# for fwd+bwd). MFU = samples/sec × FLOPs/sample / v5e bf16 peak; the
+# fs-mlp config streams f32, so its mfu reads low against the bf16 peak by
+# construction (stated rather than rescaled).
+
+
+def mlp_flops_per_sample(dims=(66, 256, 128, 64, 32, 2)) -> float:
+    return 3.0 * sum(2 * a * b for a, b in zip(dims[:-1], dims[1:]))
+
+
+def ica_flops_per_sample() -> float:
+    from bench import flops_per_sample
+
+    return flops_per_sample()
+
+
+def smri_flops_per_sample(channels=(16, 32, 64, 128)) -> float:
+    """space_to_depth path: 64³×1 → 32³×8, then four stride-2 3³ convs."""
+    f, vox, cin = 0.0, 16**3, 8  # conv_0 output grid is 16³
+    for c in channels:
+        f += 2 * vox * 27 * cin * c
+        cin, vox = c, vox // 8
+    return 3.0 * f
+
+
+def multimodal_flops_per_sample(
+    T=100, E=256, L=4, mlp_ratio=4, enc_in=1000, n_ica=98, fs_in=66
+) -> float:
+    """1 CLS + 1 FS token + 98 ICA tokens through 4 pre-LN blocks."""
+    per_tok = (2 * 3 * E * E) + (2 * E * E) + (2 * 2 * mlp_ratio * E * E)
+    attn_per_tok = 4 * T * E  # logits + weighted sum over T keys
+    embed = n_ica * 2 * enc_in * E + 2 * fs_in * E
+    return 3.0 * (L * T * (per_tok + attn_per_tok) + embed)
+
 
 def measure(name, model, x_shape, sites, engine_name, batch, engine_kw=None,
-            timed_epochs=TIMED_EPOCHS):
+            timed_epochs=TIMED_EPOCHS, flops_sample=None):
     rng = np.random.default_rng(0)
     task = FederatedTask(model)
     engine = make_engine(engine_name, **(engine_kw or {}))
@@ -105,6 +142,11 @@ def measure(name, model, x_shape, sites, engine_name, batch, engine_kw=None,
             )
         else:
             record["value"] = round(sites * STEPS * batch / dt, 2)
+            if flops_sample:
+                record["mfu"] = round(
+                    record["value"] * flops_sample / V5E_BF16_PEAK_FLOPS, 4
+                )
+                record["flops_per_sample"] = round(flops_sample)
     print(json.dumps(record), flush=True)
     return record.get("value")
 
@@ -118,24 +160,27 @@ def main():
 
     # 1. FS MLP 2-site dSGD (compspec defaults: 66 → (256,128,64,32) → 2)
     measure("fs-mlp-2site", MSANNet(), (66,), 2, "dSGD", 16,
-            timed_epochs=epochs)
+            timed_epochs=epochs, flops_sample=mlp_flops_per_sample())
     # 2. ICA-LSTM 4-site dSGD (HCP shape)
     ica = ICALstm(input_size=256, hidden_size=348, num_comps=100,
                   window_size=10, num_cls=2, compute_dtype="bfloat16")
     measure("ica-lstm-4site", ica, (98, 100, 10), 4, "dSGD", 16,
-            timed_epochs=epochs)
+            timed_epochs=epochs, flops_sample=ica_flops_per_sample())
     # 3. ICA-LSTM 32-site rankDAD
     measure("ica-lstm-32site-rankdad", ica, (98, 100, 10), 32, "rankDAD", 16,
-            engine_kw=dad, timed_epochs=epochs)
+            engine_kw=dad, timed_epochs=epochs,
+            flops_sample=ica_flops_per_sample())
     # 4. 3D-CNN sMRI 8-site dSGD (64³ T1w volumes; space-to-depth + bf16
     #    convs — 6.9× over the naive single-channel f32 layout on v5e)
     measure("smri-3dcnn-8site",
             SMRI3DNet(num_cls=2, compute_dtype="bfloat16", space_to_depth=True),
-            (64, 64, 64, 1), 8, "dSGD", 4, timed_epochs=max(epochs // 2, 2))
+            (64, 64, 64, 1), 8, "dSGD", 4, timed_epochs=max(epochs // 2, 2),
+            flops_sample=smri_flops_per_sample())
     # 5. Multimodal transformer 64-site dSGD (fs 66 + 98 ICA windows of 1000)
     mm = MultimodalNet(fs_input_size=66, num_comps=100, window_size=10)
     measure("multimodal-64site", mm, (66 + 98 * 1000,), 64, "dSGD", 8,
-            timed_epochs=max(epochs // 2, 2))
+            timed_epochs=max(epochs // 2, 2),
+            flops_sample=multimodal_flops_per_sample())
 
 
 if __name__ == "__main__":

@@ -110,6 +110,77 @@ def engine_comparison(
     return report
 
 
+def write_study_figures(out_dir: str, score_rows: list, epoch_rows: list) -> list[str]:
+    """Emit the pretrain study's two boxplot figures (reference ``NB.ipynb``
+    cells 8-11: ``assets/perf_box.png`` — accuracy/F1 per experiment —
+    and ``assets/pretrain_box.png`` — stop epoch per experiment).
+
+    ``score_rows``: ``[experiment, score_name, value]`` triples (the
+    notebook's ``SCORE`` table); ``epoch_rows``: ``[experiment, epoch]``
+    pairs (its ``EPOCH`` table). Uses matplotlib when importable (Agg
+    backend, no display) and returns the written paths; returns ``[]`` when
+    matplotlib is unavailable (the markdown/CSV artifacts always exist).
+    """
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except Exception:  # matplotlib genuinely optional
+        return []
+    assets = os.path.join(out_dir, "assets")
+    os.makedirs(assets, exist_ok=True)
+    paths = []
+
+    experiments = list(dict.fromkeys(r[0] for r in score_rows))
+    scores = list(dict.fromkeys(r[1] for r in score_rows))
+    fig, ax = plt.subplots(figsize=(8, 5))
+    width, colors = 0.18, ["#4c72b0", "#dd8452", "#55a868", "#c44e52"]
+    for si, score in enumerate(scores):
+        data = [
+            [r[2] for r in score_rows if r[0] == e and r[1] == score]
+            for e in experiments
+        ]
+        pos = [i + (si - (len(scores) - 1) / 2) * (width * 1.2)
+               for i in range(len(experiments))]
+        bp = ax.boxplot(data, positions=pos, widths=width, showmeans=True,
+                        patch_artist=True)
+        for box in bp["boxes"]:
+            box.set_facecolor(colors[si % len(colors)])
+    ax.set_xticks(range(len(experiments)))
+    ax.set_xticklabels(experiments)
+    ax.set_ylabel("Value")
+    ax.set_title("Test performance: scratch vs pre-training "
+                 "k-fold boxplot (higher is better)")
+    ax.legend(
+        handles=[plt.Rectangle((0, 0), 1, 1, fc=colors[i % len(colors)])
+                 for i in range(len(scores))],
+        labels=scores,
+    )
+    p = os.path.join(assets, "perf_box.png")
+    fig.savefig(p, dpi=120, bbox_inches="tight")
+    plt.close(fig)
+    paths.append(p)
+
+    experiments = list(dict.fromkeys(r[0] for r in epoch_rows))
+    fig, ax = plt.subplots(figsize=(8, 5))
+    ax.boxplot(
+        [[r[1] for r in epoch_rows if r[0] == e] for e in experiments],
+        widths=0.25, showmeans=True,
+    )
+    # set labels via the axis, not the boxplot kwarg: the kwarg was renamed
+    # labels→tick_labels in matplotlib 3.9, so neither spelling spans versions
+    ax.set_xticks(range(1, len(experiments) + 1))
+    ax.set_xticklabels(experiments)
+    ax.set_ylabel("Stopped on epoch")
+    ax.set_title("Train from scratch vs with pre-training "
+                 "k-fold boxplot (lower is better)")
+    p = os.path.join(assets, "pretrain_box.png")
+    fig.savefig(p, dpi=120, bbox_inches="tight")
+    plt.close(fig)
+    paths.append(p)
+    return paths
+
+
 def pretrain_study(
     data_path: str,
     out_dir: str,
@@ -149,6 +220,19 @@ def pretrain_study(
         logs = _read_fold_logs(arm_out, runner.cfg.task_id, fold_ids)
         stats = _arm_stats(logs)
         stats["fold_ids"] = fold_ids
+        # per-fold accuracy/F1, read from test_metrics.csv EXACTLY as
+        # NB.ipynb cell 6 does (line 1, columns 1 and 2)
+        accs, f1s = [], []
+        for k in fold_ids:
+            path = os.path.join(
+                fold_dir(arm_out, "remote", runner.cfg.task_id, k),
+                "test_metrics.csv",
+            )
+            line = open(path).readlines()[1].split(",")
+            accs.append(float(line[1]))
+            f1s.append(float(line[2]))
+        stats["test_accuracies"] = accs
+        stats["test_f1s"] = f1s
         for lg, res in zip(logs, results):
             assert lg["best_val_epoch"] == res["best_val_epoch"], (
                 "logs.json disagrees with the in-memory result"
@@ -184,6 +268,18 @@ def pretrain_study(
     ]
     report["summary_markdown"] = "\n".join(lines)
     os.makedirs(out_dir, exist_ok=True)
+    # the notebook's SCORE/EPOCH tables (cells 6, 10) → boxplot figures
+    label = {"scratch": "Acc. from scratch", "pretrained": "Acc. with pre-training"}
+    elabel = {"scratch": "Convergence from scratch.",
+              "pretrained": "Convergence with pre-training."}
+    score_rows, epoch_rows = [], []
+    for name, stats in report["arms"].items():
+        for a, f in zip(stats["test_accuracies"], stats["test_f1s"]):
+            score_rows.append([label[name], "Accuracy", a])
+            score_rows.append([label[name], "F1", f])
+        for e in stats["best_val_epochs"]:
+            epoch_rows.append([elabel[name], e])
+    report["figures"] = write_study_figures(out_dir, score_rows, epoch_rows)
     with open(os.path.join(out_dir, "pretrain_study.md"), "w") as fh:
         fh.write(report["summary_markdown"] + "\n")
     with open(os.path.join(out_dir, "pretrain_study.csv"), "w", newline="") as fh:

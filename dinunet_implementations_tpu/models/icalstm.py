@@ -163,6 +163,14 @@ class BiLSTM(nn.Module):
     use_pallas: bool | None = None
     compute_dtype: str | None = None
     sequence_axis: str | None = None
+    # True opts in to the fused bidirectional pooled kernel (one pallas
+    # sweep advancing both directions, site-native residuals under vmap —
+    # ops/lstm_pallas.py). Default (None/False) runs the per-direction
+    # kernels: the r5 A/B on the flagship 32-site bench measured the fused
+    # path 27% SLOWER (80,531 vs 110,009 samples/sec/chip,
+    # docs/bench_ab_bidir_r5.jsonl) despite its fewer relayout copies, so
+    # the measured winner is the default and the fused path is the A/B arm.
+    fused_bidir: bool | None = None
     # time_pool="mean": return the time-mean [B, H_total] instead of the
     # hidden sequence. Numerically identical to mean-pooling the concat
     # (column blocks reduce independently), but the [B, T, 2*per_dir] concat
@@ -188,7 +196,8 @@ class BiLSTM(nn.Module):
         use_pallas = (
             self.use_pallas if self.use_pallas is not None else _auto_pallas()
         ) and not self.double_sigmoid_gates
-        if self.bidirectional and use_pallas and self.time_pool == "mean":
+        if (self.bidirectional and use_pallas and self.time_pool == "mean"
+                and self.fused_bidir is True):
             # fused bidirectional kernel: ONE pallas sweep advances both
             # directions (rev reads x through a time-flipped index map) and
             # the VJP runs flip-free. Param trees are identical to the
@@ -266,6 +275,7 @@ class ICALstm(nn.Module):
     dropout_rate: float = 0.25
     use_pallas: bool | None = None  # None = auto (kernel on accelerators)
     compute_dtype: str | None = None  # "bfloat16" = mixed precision (f32 accum)
+    fused_bidir: bool | None = None  # True = opt-in fused bidir kernel (A/B loser, see BiLSTM)
     # Sequence parallelism (TPU extension, SURVEY.md §2.2): a bound mesh axis
     # name (parallel.mesh.MODEL_AXIS) shards the window axis S across that
     # axis — the encoder runs on the local chunk, the BiLSTM relays its carry
@@ -304,6 +314,7 @@ class ICALstm(nn.Module):
             self.use_pallas,
             self.compute_dtype,
             self.sequence_axis,
+            fused_bidir=self.fused_bidir,
             # dense path: pool inside BiLSTM per direction — same values as
             # mean-pooling the concat (models.py:109) without materializing
             # the lane-misaligned [B, T, H_total] sequence concat

@@ -9,13 +9,18 @@ cross-chunk dependencies:
 - :func:`ring_attention` — blockwise attention with online-softmax
   accumulation while K/V blocks rotate around the ring via ``ppermute``
   (the standard ring-attention recipe; memory per device is O(T/n)).
-- :func:`ring_lstm` — the LSTM carry relayed around the ring: device s
-  computes its chunk in wavefront stage s and hands (h, c) to device s+1.
-  A recurrence is inherently sequential, so a single sequence incurs n-stage
-  latency (each stage runs on every device SPMD-uniformly; outputs are
-  selected by stage) — what it buys is *memory* scaling: n× longer sequences
-  than fit on one device. Batched workloads overlap stages across
-  microbatches.
+- :func:`ring_lstm` — the LSTM carry relayed around the ring: device d
+  computes microbatch j's chunk in wavefront stage ``j + d`` and hands
+  (h, c) to device d+1. A recurrence is inherently sequential, so a single
+  sequence incurs n-stage latency; splitting the batch into ``m``
+  microbatches pipelines the wavefront so devices work on different
+  microbatches concurrently. Per-device row-steps are ``(m + n - 1)·B/m``
+  vs the dense ``B`` — an overhead factor of ``(m + n - 1)/m`` (→ 1 as m
+  grows), NOT the n× of the unpipelined masked wavefront (``m=1``), which
+  recomputes every stage on every device. What the ring buys is *memory*
+  scaling (n× longer sequences than fit on one device) at modest extra
+  FLOPs; the microbatch count trades pipeline overhead against MXU row
+  utilization (B/m rows per kernel call).
 
 All functions run inside ``shard_map``/``vmap`` with a bound axis name.
 """
@@ -77,44 +82,113 @@ def ring_attention(q, k, v, axis_name: str | None = MODEL_AXIS):
     return out.astype(q.dtype)
 
 
-def ring_lstm(cell_fn, x_local, h0, c0, axis_name: str = MODEL_AXIS):
-    """Run an LSTM over a time-sharded sequence by relaying the carry.
+def _auto_microbatches(B: int, n: int) -> int:
+    """Pick the microbatch count that minimizes hardware row-tile work:
+    ``(m + n - 1)`` stages × ``ceil((B/m)/8)`` sublane tiles per stage (rows
+    tile to 8 on the MXU, so a 1-row call costs a full tile). Ties break
+    toward smaller ``m`` (fewer ppermute rounds). m=1 — the masked
+    wavefront — wins naturally when B is a single tile; capped at 4n (the
+    pipeline is full by then)."""
+    if n <= 1:
+        return 1
+
+    def tile_cost(m):
+        return (m + n - 1) * -(-(B // m) // 8)
+
+    return min(
+        (m for m in range(1, min(4 * n, B) + 1) if B % m == 0),
+        key=lambda m: (tile_cost(m), m),
+    )
+
+
+def ring_lstm(cell_fn, x_local, h0, c0, axis_name: str = MODEL_AXIS,
+              microbatches: int | None = None):
+    """Run an LSTM over a time-sharded sequence by relaying the carry around
+    the ring, pipelined over batch microbatches (wavefront overlap).
 
     ``cell_fn(x_chunk, (h, c)) -> (hs_chunk, (hT, cT))`` — any full-sequence
     cell (e.g. a bound ``LSTMCell``). ``x_local`` is this device's
-    ``[B, T_local, D]`` chunk; ``h0``/``c0`` seed device 0.
+    ``[B, T_local, D]`` chunk; ``h0``/``c0`` [B, H] seed the sequence start.
 
-    Returns ``(hs_local [B, T_local, H], (hT, cT))`` where the terminal carry
-    is valid on every device (broadcast from the last ring position).
+    The batch splits into ``m = microbatches`` slices (``None`` → heuristic,
+    :func:`_auto_microbatches`). Microbatch j's chunk-d rows are computed on
+    device d at wavefront stage ``j + d`` (``m + n - 1`` stages total), so
+    devices work on *different* microbatches concurrently instead of
+    recomputing every stage SPMD-uniformly and masking — per-device
+    row-steps are ``(m + n - 1)·B/m`` vs the masked wavefront's ``n·B``
+    (``m=1`` reproduces exactly that masked behavior). Stages at the
+    pipeline fill/drain still execute (SPMD uniformity) on clamped dummy
+    slices whose writes are masked out.
+
+    Returns ``(hs_local [B, T_local, H], (hT, cT))`` where the terminal
+    carry is valid on every device (broadcast from the last ring position).
     """
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
+    B = x_local.shape[0]
+    m = _auto_microbatches(B, n) if microbatches is None else microbatches
+    if B % m:
+        raise ValueError(f"microbatches={m} must divide the batch ({B})")
+    mb = B // m
 
-    carry = (h0, c0)
+    def fresh(j):  # h0/c0 rows seeding microbatch j (clamped at fill/drain)
+        row = jnp.clip(j, 0, m - 1) * mb
+        return (
+            jax.lax.dynamic_slice_in_dim(h0, row, mb, 0),
+            jax.lax.dynamic_slice_in_dim(c0, row, mb, 0),
+        )
+
+    # device 0 seeds microbatch 0 at stage 0; everyone else idles until the
+    # wavefront arrives (their stage-0 compute is masked garbage)
+    carry = jax.tree.map(
+        lambda f: jnp.where(idx == 0, f, jnp.zeros_like(f)), fresh(0)
+    )
     out = None
-    for s in range(n):  # n is static (mesh size)
-        hs, (hT, cT) = cell_fn(x_local, carry)
-        sel = idx == s
-        out = jnp.where(sel[..., None, None], hs, out if out is not None else jnp.zeros_like(hs))
-        # relay the carry produced at stage s to stage s+1's device
+    finals = None
+    # Python loop over stages (static: m + n - 1 is mesh/config-determined):
+    # cell_fn is typically a bound flax submodule, which cannot be called
+    # inside a lax.scan body from a compact parent.
+    for s in range(m + n - 1):
+        j = s - idx  # the microbatch this device advances at stage s
+        valid = (j >= 0) & (j < m)
+        row = jnp.clip(j, 0, m - 1) * mb
+        x_mb = jax.lax.dynamic_slice_in_dim(x_local, row, mb, 0)
+        hs, (hT, cT) = cell_fn(x_mb, carry)
+        if out is None:
+            out = jnp.zeros((B,) + hs.shape[1:], hs.dtype)
+            finals = (
+                jnp.zeros((B,) + hT.shape[1:], hT.dtype),
+                jnp.zeros((B,) + cT.shape[1:], cT.dtype),
+            )
+        out = jnp.where(
+            valid,
+            jax.lax.dynamic_update_slice_in_dim(out, hs.astype(out.dtype), row, 0),
+            out,
+        )
+        # the last ring position finishes microbatch j: record its terminal
+        done = valid & (idx == n - 1)
+        finals = jax.tree.map(
+            lambda f, t: jnp.where(
+                done,
+                jax.lax.dynamic_update_slice_in_dim(f, t.astype(f.dtype), row, 0),
+                f,
+            ),
+            finals, (hT, cT),
+        )
+        # relay microbatch j's carry to device d+1 (stage s+1); device 0
+        # instead seeds the NEXT microbatch fresh
         send = jax.tree.map(
-            lambda t: jnp.where(sel[..., None], t, jnp.zeros_like(t)), (hT, cT)
+            lambda t: jnp.where(valid, t, jnp.zeros_like(t)), (hT, cT)
         )
         recv = jax.tree.map(
             lambda t: jax.lax.ppermute(t, axis_name, _ring_perm(n)), send
         )
-        take = idx == (s + 1) % n
         carry = jax.tree.map(
-            lambda new, old: jnp.where(take[..., None], new, old), recv, carry
+            lambda f, r: jnp.where(idx == 0, f, r), fresh(s + 1), recv
         )
-    # After stage n-1 the final carry was relayed to device 0 ("take" index
-    # (n-1+1) % n == 0); broadcast it to every device via a masked psum.
-    is0 = idx == 0
+    # only device n-1 wrote finals; a psum broadcasts them everywhere
     final = jax.tree.map(
-        lambda t: jax.lax.psum(
-            jnp.where(is0[..., None], t, jnp.zeros_like(t)), axis_name
-        ),
-        carry,
+        lambda t: jax.lax.psum(t, axis_name) if n > 1 else t, finals
     )
     return out, final
 

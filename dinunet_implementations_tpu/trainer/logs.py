@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import time
 import zipfile
 
@@ -77,17 +78,42 @@ def write_test_metrics_csv(dirpath: str, fold: int, metrics: dict) -> str:
     return path
 
 
-def zip_global_results(out_dir: str, remote_site: str = "remote") -> str:
+def zip_global_results(
+    out_dir: str, remote_site: str = "remote", num_sites: int = 0,
+    task_id: str | None = None,
+) -> str:
     """Zip the remote's result tree into the transfer output, like the
-    reference remote does (``nnlogs.ipynb`` cell 2 finds a ``.zip`` next to
-    the task dir and extracts ``fold_k/logs.json`` from it)."""
+    reference remote does, and distribute a copy into each local site's
+    output dir (the COINSTAC remote's transfer lands in every site's
+    output). ``nnlogs.ipynb`` cell 2 walks a site dir, finds the ``.zip``
+    NEXT TO the task dir, and extracts ``fold_k/logs.json`` from it — so
+    the zip lives inside ``simulatorRun/``, beside ``<task_id>/``, and
+    archive paths start at the FOLD level (``fold_k/...``).
+
+    ``task_id`` selects which task dir to archive (two tasks sharing one
+    out_dir would otherwise collide on ``fold_k/`` archive names); ``None``
+    falls back to the single task dir present and raises when ambiguous.
+    """
     remote_dir = os.path.join(out_dir, remote_site, "simulatorRun")
-    zpath = os.path.join(out_dir, remote_site, "global_results.zip")
+    if task_id is None:
+        tasks = [t for t in sorted(os.listdir(remote_dir))
+                 if os.path.isdir(os.path.join(remote_dir, t))]
+        if len(tasks) != 1:
+            raise ValueError(
+                f"out_dir holds {len(tasks)} task dirs {tasks}; pass task_id"
+            )
+        task_id = tasks[0]
+    task_dir = os.path.join(remote_dir, task_id)
+    zpath = os.path.join(remote_dir, "global_results.zip")
     with zipfile.ZipFile(zpath, "w") as zf:
-        for root, _, files in os.walk(remote_dir):
+        for root, _, files in os.walk(task_dir):
             for f in files:
                 full = os.path.join(root, f)
-                # archive paths start at the task level: <task>/fold_k/...
-                rel = os.path.relpath(full, remote_dir)
-                zf.write(full, rel)
+                zf.write(full, os.path.relpath(full, task_dir))
+    for i in range(num_sites):
+        site_dir = os.path.join(out_dir, f"local{i}", "simulatorRun")
+        if os.path.isdir(site_dir):
+            shutil.copyfile(
+                zpath, os.path.join(site_dir, "global_results.zip")
+            )
     return zpath

@@ -542,4 +542,6 @@ class FederatedTrainer:
             meta={"best_val_epoch": results["best_val_epoch"],
                   "best_val_metric": results["best_val_metric"], "fold": fold},
         )
-        zip_global_results(self.out_dir)
+        zip_global_results(
+            self.out_dir, num_sites=self._num_sites, task_id=cfg.task_id
+        )

@@ -15,6 +15,7 @@ pytestmark = pytest.mark.skipif(
 )
 
 
+@pytest.mark.slow
 def test_cli_federated_run(tmp_path, capsys):
     rc = main([
         "--data-path", FSL, "--task", "FS-Classification",
@@ -39,6 +40,7 @@ def test_cli_single_site(tmp_path, capsys):
     assert 0 <= rec["test_auc"] <= 1
 
 
+@pytest.mark.slow
 def test_cli_resume_and_folds(tmp_path, capsys):
     args = [
         "--data-path", FSL, "--epochs", "2", "--batch-size", "8",
@@ -67,6 +69,7 @@ def test_cli_rejects_unknown_task():
         build_parser().parse_args(["--data-path", ".", "--task", "nope"])
 
 
+@pytest.mark.slow
 def test_cli_site_mode_with_mode_flag(tmp_path, capsys):
     """Review regression (r3): --site + --mode must not double-pass 'mode'."""
     # train first so mode=test has a checkpoint... simpler: just train with

@@ -272,6 +272,7 @@ def test_plan_epoch_starvation_message():
         plan_epoch(sites, batch_size=16)
 
 
+@pytest.mark.slow
 def test_demo_tree_small_subjects_trains_with_default_batch(tmp_path):
     """VERDICT r4 #6 crash path: `--subjects 12` + the CLI default
     batch_size=16 used to die with 'no site yields a batch'; the trainer now

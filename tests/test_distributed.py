@@ -158,6 +158,7 @@ def _run_dcn_workers(data_path, out_dir, reports, nproc, timeout=420):
     return [json.load(open(rep)) for rep in reports]
 
 
+@pytest.mark.slow
 def test_two_process_dcn_runtime_live(tmp_path):
     """The multi-host runtime executes for real: identical losses on every
     process AND vs the single-process run, with exactly one process writing
@@ -210,6 +211,7 @@ def test_two_process_dcn_runtime_live(tmp_path):
     )
 
 
+@pytest.mark.slow
 def test_trainer_on_mesh_with_committed_batches():
     """The put/fetch plumbing drives a real federated fit on a host mesh and
     matches the vmap (mesh=None) path's losses."""

@@ -74,6 +74,7 @@ def test_dsgd_equals_pooled():
     )
 
 
+@pytest.mark.slow
 def test_rankdad_full_rank_equals_pooled():
     """With rank >= min(m, n) the power iteration is exact → rankDAD == dSGD."""
     tree, w = _tree(1), _weights()
@@ -97,6 +98,7 @@ def test_rankdad_low_rank_compresses():
     np.testing.assert_allclose(agg["k"], expect["k"], atol=1e-4)
 
 
+@pytest.mark.slow
 def test_powersgd_error_feedback_converges():
     """Error-feedback property: a single compressed round is lossy, but the
     *time-averaged* updates converge to the true gradient — telescoping gives
@@ -138,6 +140,7 @@ def test_powersgd_error_feedback_converges():
     np.testing.assert_allclose(avg24["dense"]["bias"], expect["dense"]["bias"], rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_powersgd_bias_dense_exact():
     tree, w = _tree(4), _weights()
     agg = _run_engine("powerSGD", tree, w, dad_reduction_rank=2)
@@ -179,6 +182,7 @@ def test_subspace_iteration_tol_early_exit():
     np.testing.assert_allclose(np.asarray(P1 @ Q1.T), np.asarray(P2 @ Q2.T), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_engines_precision16_still_close():
     tree, w = _tree(7), _weights()
     for name in ("dSGD", "rankDAD", "powerSGD"):
@@ -241,6 +245,7 @@ def test_orthonormalize_zero_input_recovers():
     )
 
 
+@pytest.mark.slow
 def test_subspace_iteration_multi_matches_solo():
     """Lockstep groups must keep solo semantics: same subspace, same
     reconstruction, per-member trip counts."""
@@ -272,6 +277,7 @@ def test_subspace_iteration_multi_matches_solo():
                                    atol=1e-4)
 
 
+@pytest.mark.slow
 def test_small_cholesky_and_inverse_match_lapack():
     """The TPU-path unrolled Cholesky / triangular inverse (used to avoid
     the per-matrix-cost LAPACK custom-calls) must match LAPACK numerics."""

@@ -27,6 +27,7 @@ def _tiny_multimodal():
     )
 
 
+@pytest.mark.slow
 def test_multimodal_forward_and_grad():
     model = _tiny_multimodal()
     B, S = 4, 5
@@ -51,6 +52,7 @@ def test_multimodal_forward_and_grad():
     assert any(float(jnp.abs(g).max()) > 0 for g in leaves)
 
 
+@pytest.mark.slow
 def test_multimodal_token_count_static_under_jit():
     """CLS + 1 FS token + S ICA tokens; jit must see static shapes."""
     model = _tiny_multimodal()
@@ -62,6 +64,7 @@ def test_multimodal_token_count_static_under_jit():
     assert fwd(variables, x).shape == (2, 2)
 
 
+@pytest.mark.slow
 def test_smri3d_forward_and_grad():
     model = SMRI3DNet(channels=(4, 8), num_cls=2)
     x = jnp.asarray(
@@ -124,6 +127,7 @@ def _make_smri_tree(root, n_sites=2, subjects=16, shape=(8, 8, 8), seed=11):
     (root / "inputspec.json").write_text(json.dumps(spec))
 
 
+@pytest.mark.slow
 def test_smri_fed_runner_end_to_end(tmp_path):
     _make_smri_tree(tmp_path)
     cfg = TrainConfig(
@@ -181,6 +185,7 @@ def _make_multimodal_tree(root, n_sites=2, subjects=14, fs_dim=6, comps=3,
     (root / "inputspec.json").write_text(json.dumps(spec))
 
 
+@pytest.mark.slow
 def test_multimodal_fed_runner_end_to_end(tmp_path):
     _make_multimodal_tree(tmp_path)
     cfg = TrainConfig(
@@ -199,6 +204,7 @@ def test_multimodal_fed_runner_end_to_end(tmp_path):
     assert log["agg_engine"] == "dSGD"
 
 
+@pytest.mark.slow
 def test_multimodal_bf16_tracks_f32():
     """Mixed precision for the transformer: bf16 matmuls with f32
     softmax/LayerNorm must track the f32 forward within bf16 tolerance."""

@@ -59,6 +59,7 @@ def _assert_states_match(a, b, atol=1e-6):
     )
 
 
+@pytest.mark.slow
 def test_folded_matches_per_device_and_vmap():
     """4 sites on a 2-device mesh (2 folded per device) == 4 sites on a
     4-device mesh == 4 sites vmapped on one device."""
@@ -72,6 +73,7 @@ def test_folded_matches_per_device_and_vmap():
     _assert_states_match(s_fold, s_vmap)
 
 
+@pytest.mark.slow
 def test_folded_rankdad_matches_per_device():
     """rankDAD's factor all_gather must span the (site, fold) axis pair
     (parallel/collectives.py site_all_gather tuple path)."""
@@ -83,6 +85,7 @@ def test_folded_rankdad_matches_per_device():
     _assert_states_match(s_fold, s_full, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_folded_powersgd_keeps_per_site_error_feedback():
     """powerSGD's error-feedback residual is per-site engine state; folding
     must keep one residual per SITE (not per device)."""
@@ -113,6 +116,7 @@ def test_folded_eval_matches_per_device():
     np.testing.assert_array_equal(np.asarray(wf), np.asarray(wd))
 
 
+@pytest.mark.slow
 def test_fed_runner_sites_per_device(tmp_path):
     """cfg.sites_per_device=5 folds the 5-site FS fixture onto a 1-device
     site mesh; results still come out per site."""
@@ -144,6 +148,7 @@ def test_fed_runner_rejects_nondivisible_fold(tmp_path):
         )
 
 
+@pytest.mark.slow
 def test_folded_eval_with_model_axis():
     """Eval on a (2 site × 2 model) mesh with 4 sites folded 2-per-device —
     the one folding/model-axis combination the train tests don't cover."""

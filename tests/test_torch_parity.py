@@ -24,6 +24,7 @@ import importlib.util
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 import torch
 import torch.nn.functional as F
 
@@ -76,6 +77,7 @@ def _torch_params_as_tree(tm):
     return out
 
 
+@pytest.mark.slow
 def test_federated_dsgd_adam_round_matches_torch():
     rng = np.random.default_rng(0)
     x = rng.normal(size=(SITES, 1, B, IN)).astype(np.float32)
@@ -134,6 +136,7 @@ def test_federated_dsgd_adam_round_matches_torch():
         )
 
 
+@pytest.mark.slow
 def test_unequal_site_batches_weighted_average_matches_torch():
     """Heterogeneous site sizes (the 73-120 subject spread, SURVEY §7): the
     jax engine weights by example count; torch mirror must too."""

@@ -286,6 +286,7 @@ def test_final_validation_when_epochs_below_cadence():
     assert res["best_val_epoch"] == 2
 
 
+@pytest.mark.slow
 def test_pretrain_uses_exact_gradients_with_compressed_engine():
     """ADVICE regression: warm start must run on dSGD even when the federated
     phase uses a compressed engine (and must not crash on engine-state shapes)."""
@@ -302,6 +303,7 @@ def test_pretrain_uses_exact_gradients_with_compressed_engine():
     assert np.isfinite(res["epoch_losses"]).all()
 
 
+@pytest.mark.slow
 def test_powersgd_residual_survives_epoch_boundary():
     """Review finding regression: powerSGD's per-site error-feedback residual
     must NOT be collapsed to site 0's copy between epoch_fn calls."""
@@ -368,6 +370,7 @@ def test_mode_test_without_checkpoint_raises(tmp_path):
         tr.fit(_toy_sites(2), _toy_sites(2, n=16), _toy_sites(2, n=16), verbose=False)
 
 
+@pytest.mark.slow
 def test_resume_matches_uninterrupted(tmp_path):
     """Kill a fit mid-fold, resume — same final metrics as an uninterrupted
     run (VERDICT #5 done-criterion)."""
@@ -393,6 +396,7 @@ def test_resume_matches_uninterrupted(tmp_path):
                                atol=1e-6)
 
 
+@pytest.mark.slow
 def test_pretrained_path_warm_start(tmp_path):
     """cfg.pretrained_path loads params from a saved checkpoint (the
     previously-dead load_params path)."""
