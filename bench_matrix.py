@@ -170,11 +170,15 @@ def main():
     measure("ica-lstm-32site-rankdad", ica, (98, 100, 10), 32, "rankDAD", 16,
             engine_kw=dad, timed_epochs=epochs,
             flops_sample=ica_flops_per_sample())
-    # 4. 3D-CNN sMRI 8-site dSGD (64³ T1w volumes; space-to-depth + bf16
-    #    convs — 6.9× over the naive single-channel f32 layout on v5e)
+    # 4. 3D-CNN sMRI 8-site dSGD (64³ T1w volumes; space-to-depth folded in
+    #    the DATA PIPELINE as the runner does — pre-folded 32³×8 inputs, the
+    #    model runs space_to_depth=False with identical params. Measured
+    #    2.0–2.6× over the in-model per-step fold (r5,
+    #    docs/bench_smri_s2d_ab_r5.jsonl); that fold itself was 3.7–6.9×
+    #    over the naive single-channel layout (r3).
     measure("smri-3dcnn-8site",
-            SMRI3DNet(num_cls=2, compute_dtype="bfloat16", space_to_depth=True),
-            (64, 64, 64, 1), 8, "dSGD", 4, timed_epochs=max(epochs // 2, 2),
+            SMRI3DNet(num_cls=2, compute_dtype="bfloat16", space_to_depth=False),
+            (32, 32, 32, 8), 8, "dSGD", 4, timed_epochs=max(epochs // 2, 2),
             flops_sample=smri_flops_per_sample())
     # 5. Multimodal transformer 64-site dSGD (fs 66 + 98 ICA windows of 1000)
     mm = MultimodalNet(fs_input_size=66, num_comps=100, window_size=10)

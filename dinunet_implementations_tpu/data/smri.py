@@ -14,6 +14,33 @@ from .api import SiteArrays, SiteDataset
 from .ica import ICADataHandle, load_timecourses
 
 
+def space_to_depth_222_np(vols: np.ndarray) -> np.ndarray:
+    """Host-side twin of ``models.cnn3d.space_to_depth_222``: ``[N, D, H, W]``
+    (or trailing singleton channel) → ``[N, D/2, H/2, W/2, 8]`` with voxel
+    ``(2i+di, 2j+dj, 2k+dk)`` in channel ``di·4 + dj·2 + dk``. Applied ONCE
+    at dataset load: the per-step in-model fold cost 2.0–2.6× whole-epoch
+    throughput in layout copies on the 8-site bench
+    (docs/bench_smri_s2d_ab_r5.jsonl; the fold itself is cheap — re-doing
+    it on a [S, B, 64³, 1] resident array every step is not). Channel-order
+    parity with the model fold is pinned by ``tests/test_extensions.py``."""
+    if vols.ndim == 5:
+        if vols.shape[-1] != 1:
+            raise ValueError(
+                f"space_to_depth needs single-channel volumes, got C="
+                f"{vols.shape[-1]}"
+            )
+        vols = vols[..., 0]
+    N, D, H, W = vols.shape
+    if any(d % 2 for d in (D, H, W)):
+        raise ValueError(
+            f"space_to_depth needs even spatial dims, got {(D, H, W)}"
+        )
+    v = vols.reshape(N, D // 2, 2, H // 2, 2, W // 2, 2)
+    return np.ascontiguousarray(
+        np.transpose(v, (0, 1, 3, 5, 2, 4, 6))
+    ).reshape(N, D // 2, H // 2, W // 2, 8)
+
+
 class SMRIDataset(SiteDataset):
     def __init__(self, **kw):
         super().__init__(**kw)
@@ -23,6 +50,11 @@ class SMRIDataset(SiteDataset):
         self.data = np.asarray(
             load_timecourses(self.path(cache_key="data_file")), np.float32
         )
+        # pipeline-level fold (SMRI3DArgs.space_to_depth): the model is then
+        # built with space_to_depth=False — identical architecture/params,
+        # no per-step relayout (see space_to_depth_222_np)
+        if self.cache.get("space_to_depth"):
+            self.data = space_to_depth_222_np(self.data)
         self.indices += [list(f) for f in files]
 
     def __getitem__(self, ix) -> dict:

@@ -53,17 +53,26 @@ class SMRI3DNet(nn.Module):
         if x.ndim == 4:
             x = x[..., None]
         if self.space_to_depth:
-            # fail loudly rather than silently skipping the fold: a no-op
-            # here would mean a different architecture than configured (and
-            # an opaque conv shape error later if a trained model meets
-            # odd-sized data)
-            if x.shape[-1] != 1 or any(d % 2 for d in x.shape[1:4]):
+            if x.shape[-1] == 8:
+                # already folded by the data pipeline
+                # (data/smri.py:space_to_depth_222_np) — 8 channels cannot
+                # occur on this path otherwise (raw input must be
+                # single-channel), so the flag keeps meaning "the s2d
+                # architecture" whether or not the dataset pre-folds
+                pass
+            elif x.shape[-1] != 1 or any(d % 2 for d in x.shape[1:4]):
+                # fail loudly rather than silently skipping the fold: a
+                # no-op here would mean a different architecture than
+                # configured (and an opaque conv shape error later if a
+                # trained model meets odd-sized data)
                 raise ValueError(
                     "space_to_depth needs single-channel input with even "
-                    f"spatial dims; got shape {x.shape[1:]}. Pad/crop the "
-                    "volumes or set space_to_depth=False."
+                    f"spatial dims (or pipeline-prefolded 8-channel input); "
+                    f"got shape {x.shape[1:]}. Pad/crop the volumes or set "
+                    "space_to_depth=False."
                 )
-            x = space_to_depth_222(x)
+            else:
+                x = space_to_depth_222(x)
         cdt = compute_dtype_of(self.compute_dtype)
         for i, ch in enumerate(self.channels):
             x = nn.Conv(ch, kernel_size=(3, 3, 3), strides=(2, 2, 2),

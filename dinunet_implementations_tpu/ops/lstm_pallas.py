@@ -877,9 +877,14 @@ bilstm_recurrence_fused.defvjp(_vjp_bidir_fwd, _vjp_bidir_bwd)
 
 
 # ---------------------------------------------------------------------------
-# pooled bidirectional op — the model's fused hot path (ICALstm mean-pools
-# the hidden sequence, reference ``models.py:109``). Two structural ideas on
-# top of the bidirectional kernels above:
+# pooled bidirectional op — ICALstm's opt-in fused path (mean-pool of the
+# hidden sequence, reference ``models.py:109``). NOTE (r5): the flagship A/B
+# measured this fused path 27% SLOWER than two single-direction sweeps
+# (80,531 vs 110,009 samples/sec/chip, docs/bench_ab_bidir_r5.jsonl), so the
+# per-direction path is the model default and this op is reached only via
+# ``ICALstm(fused_bidir=True)``. Two structural ideas on top of the
+# bidirectional kernels above (kept for the record and for shapes where the
+# trade may flip):
 #
 # 1. The mean-pool lives INSIDE the op: the forward kernel accumulates the
 #    time-sum in VMEM scratch and emits [B, H] per direction (the hidden

@@ -64,6 +64,12 @@ def _build_smri3d(cfg: TrainConfig):
     return SMRI3DNet(
         channels=tuple(a.channels), num_cls=a.num_class,
         compute_dtype=a.compute_dtype or None,
+        # The fold itself is applied ONCE in the data pipeline
+        # (data/smri.py:space_to_depth_222_np; 2.0-2.6x end-to-end vs the
+        # per-step in-model fold, docs/bench_smri_s2d_ab_r5.jsonl). The
+        # model still takes the flag: it recognizes pre-folded 8-channel
+        # input and no-ops, but keeps honoring the configured architecture
+        # if a custom dataset_cls bypasses the pipeline fold.
         space_to_depth=a.space_to_depth,
     )
 

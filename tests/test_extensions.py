@@ -329,3 +329,57 @@ def test_smri3d_space_to_depth_rejects_invalid_input():
     with pytest.raises(ValueError, match="space_to_depth"):
         m.init({"params": key, "dropout": key}, jnp.ones((2, 8, 8, 8, 3)),
                train=False)
+
+
+def test_space_to_depth_np_matches_model_fold():
+    """Pipeline fold (data/smri.py) == model fold (cnn3d) channel-for-channel,
+    and the two training programs are numerically identical."""
+    from dinunet_implementations_tpu.data.smri import space_to_depth_222_np
+    from dinunet_implementations_tpu.models.cnn3d import space_to_depth_222
+
+    rng = np.random.default_rng(12)
+    vols = rng.normal(size=(3, 8, 8, 8)).astype(np.float32)
+    np.testing.assert_array_equal(
+        space_to_depth_222_np(vols),
+        np.asarray(space_to_depth_222(jnp.asarray(vols)[..., None])),
+    )
+    # trailing singleton channel accepted; odd dims rejected
+    np.testing.assert_array_equal(
+        space_to_depth_222_np(vols[..., None]), space_to_depth_222_np(vols)
+    )
+    with pytest.raises(ValueError, match="even spatial dims"):
+        space_to_depth_222_np(vols[:, :7])
+
+    m_in = SMRI3DNet(channels=(4, 8), num_cls=2, space_to_depth=True)
+    m_pre = SMRI3DNet(channels=(4, 8), num_cls=2, space_to_depth=False)
+    raw = jnp.asarray(vols)[..., None]
+    pre = jnp.asarray(space_to_depth_222_np(vols))
+    v = m_in.init({"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(0)},
+                  raw, train=True)
+    out_in = m_in.apply(v, raw, train=False)
+    out_pre = m_pre.apply(v, pre, train=False)  # SAME params restore
+    np.testing.assert_allclose(np.asarray(out_in), np.asarray(out_pre), atol=1e-6)
+    # the s2d-flagged model recognizes pre-folded 8-channel input (no-op
+    # fold) — covers a custom dataset_cls that folds, or the registry path
+    out_both = m_in.apply(v, pre, train=False)
+    np.testing.assert_allclose(np.asarray(out_both), np.asarray(out_in), atol=1e-6)
+    # multi-channel raw volumes are rejected, not silently truncated
+    with pytest.raises(ValueError, match="single-channel"):
+        space_to_depth_222_np(np.repeat(vols[..., None], 2, axis=-1))
+
+
+@pytest.mark.slow
+def test_smri_fed_runner_space_to_depth_pipeline(tmp_path):
+    """SMRI3DArgs.space_to_depth=True folds in the DATA pipeline (dataset
+    load) and builds the model unfolded — the e2e run must train."""
+    _make_smri_tree(tmp_path)
+    cfg = TrainConfig(
+        task_id="sMRI-3D-Classification", epochs=2, batch_size=8,
+        split_ratio=(0.6, 0.2, 0.2),
+    )
+    cfg.smri3d_args.space_to_depth = True
+    from dinunet_implementations_tpu.runner import FedRunner
+
+    res = FedRunner(cfg, data_path=str(tmp_path),
+                    out_dir=str(tmp_path / "out")).run(verbose=False)[0]
+    assert 0 <= res["test_metrics"][0][1] <= 1
