@@ -242,6 +242,11 @@ class TrainConfig:
     # transformer uses ring attention (runner/registry.py wires both). Needs
     # num_sites × model_axis_size devices.
     model_axis_size: int = 1
+    # ring-LSTM wavefront pipelining (parallel/sequence.py): number of batch
+    # microbatches per ring stage. 0 = auto (minimize 8-row MXU tile work);
+    # 1 = the unpipelined masked wavefront; must divide the batch size.
+    # Only meaningful with model_axis_size > 1 on an LSTM task.
+    sequence_microbatches: int = 0
     # non-empty → wrap each fit() in jax.profiler.trace(profile_dir) and
     # write a TensorBoard-compatible device trace there (SURVEY.md §5: the
     # reference only has wall-clock duration lists; this is the TPU upgrade)

@@ -163,6 +163,8 @@ class BiLSTM(nn.Module):
     use_pallas: bool | None = None
     compute_dtype: str | None = None
     sequence_axis: str | None = None
+    # ring-LSTM wavefront microbatches (parallel/sequence.py): 0 = auto
+    sequence_microbatches: int = 0
     # True opts in to the fused bidirectional pooled kernel (one pallas
     # sweep advancing both directions, site-native residuals under vmap —
     # ops/lstm_pallas.py). Default (None/False) runs the per-direction
@@ -238,6 +240,7 @@ class BiLSTM(nn.Module):
             fwd, (h, c) = ring_lstm(
                 lambda xc, carry: fwd_cell(xc, carry), x, h0[0], h0[1],
                 axis_name=self.sequence_axis,
+                microbatches=self.sequence_microbatches or None,
             )
         if not self.bidirectional:
             return pool(fwd), (h, c)
@@ -256,6 +259,7 @@ class BiLSTM(nn.Module):
                 lambda xc, carry: rev_cell(xc, carry),
                 reverse_sequence(x, self.sequence_axis, axis=1),
                 h0[0], h0[1], axis_name=self.sequence_axis,
+                microbatches=self.sequence_microbatches or None,
             )
         return (
             jnp.concatenate([pool(fwd), pool(rev)], axis=-1),
@@ -276,6 +280,7 @@ class ICALstm(nn.Module):
     use_pallas: bool | None = None  # None = auto (kernel on accelerators)
     compute_dtype: str | None = None  # "bfloat16" = mixed precision (f32 accum)
     fused_bidir: bool | None = None  # True = opt-in fused bidir kernel (A/B loser, see BiLSTM)
+    sequence_microbatches: int = 0  # ring wavefront microbatches; 0 = auto
     # Sequence parallelism (TPU extension, SURVEY.md §2.2): a bound mesh axis
     # name (parallel.mesh.MODEL_AXIS) shards the window axis S across that
     # axis — the encoder runs on the local chunk, the BiLSTM relays its carry
@@ -315,6 +320,7 @@ class ICALstm(nn.Module):
             self.compute_dtype,
             self.sequence_axis,
             fused_bidir=self.fused_bidir,
+            sequence_microbatches=self.sequence_microbatches,
             # dense path: pool inside BiLSTM per direction — same values as
             # mean-pooling the concat (models.py:109) without materializing
             # the lane-misaligned [B, T, H_total] sequence concat

@@ -127,8 +127,10 @@ def ring_lstm(cell_fn, x_local, h0, c0, axis_name: str = MODEL_AXIS,
     idx = jax.lax.axis_index(axis_name)
     B = x_local.shape[0]
     m = _auto_microbatches(B, n) if microbatches is None else microbatches
-    if B % m:
-        raise ValueError(f"microbatches={m} must divide the batch ({B})")
+    if m < 1 or B % m:
+        raise ValueError(
+            f"microbatches={m} must be >= 1 and divide the batch ({B})"
+        )
     mb = B // m
 
     def fresh(j):  # h0/c0 rows seeding microbatch j (clamped at fill/drain)

@@ -56,6 +56,7 @@ def _build_icalstm(cfg: TrainConfig):
         # model_axis_size > 1 → window axis sharded over the mesh model axis
         # (ring LSTM; parallel/sequence.py)
         sequence_axis=MODEL_AXIS if cfg.model_axis_size > 1 else None,
+        sequence_microbatches=cfg.sequence_microbatches,
     )
 
 

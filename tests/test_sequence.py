@@ -240,3 +240,43 @@ def test_ring_lstm_overlap_flop_reduction():
     # analytic: masked = 2·B row-steps, piped = (8+1)/8·B → ~1.78x; XLA's
     # count includes the fixed dense head so demand a bit less
     assert piped * 1.5 < masked, (masked, piped)
+
+
+@pytest.mark.slow
+def test_ring_microbatches_reachable_from_config():
+    """TrainConfig.sequence_microbatches threads through the registry to the
+    ring path and reproduces the auto result exactly."""
+    from dinunet_implementations_tpu.core.config import TrainConfig
+    from dinunet_implementations_tpu.runner.registry import get_task
+
+    cfg = TrainConfig(task_id="ICA-Classification", model_axis_size=2,
+                      sequence_microbatches=4)
+    model = get_task(cfg.task_id).build_model(cfg)
+    assert model.sequence_microbatches == 4
+    assert model.sequence_axis is not None
+
+    # and through a real 2-device ring: explicit m == auto == dense
+    rng = np.random.default_rng(7)
+    B, T, D, H = 8, 8, 4, 6
+    cell = LSTMCell(hidden_size=H, use_pallas=False)
+    x = jnp.asarray(rng.normal(size=(B, T, D)).astype(np.float32))
+    params = cell.init(jax.random.PRNGKey(0), x)
+    dense_hs, _ = cell.apply(params, x)
+    mesh = _model_mesh(2)
+    h0 = jnp.zeros((B, H), jnp.float32)
+
+    def run(m):
+        def shard_fn(x_local, h0, c0):
+            hs, fin = ring_lstm(
+                lambda xc, c: cell.apply(params, xc, c), x_local, h0, c0,
+                axis_name=MODEL_AXIS, microbatches=m,
+            )
+            return hs
+        return shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(P(None, MODEL_AXIS), P(), P()),
+            out_specs=P(None, MODEL_AXIS), check_vma=False,
+        )(x, h0, h0)
+
+    np.testing.assert_allclose(np.asarray(run(4)), np.asarray(dense_hs), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(run(None)), np.asarray(run(4)), atol=1e-6)
