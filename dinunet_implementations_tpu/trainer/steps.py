@@ -164,6 +164,7 @@ def make_train_epoch_fn(
     optimizer: optax.GradientTransformation,
     mesh=None,
     local_iterations: int = 1,
+    rounds_scan_xs: bool = True,
 ):
     """Build the jitted epoch function.
 
@@ -224,9 +225,10 @@ def make_train_epoch_fn(
         L = rounds * local_iterations
 
         # split the steps axis in place ([k, rounds, L, B, ...] — a free
-        # reshape) and let each round dynamic-slice its batch out of the
-        # resident epoch arrays (equivalently XLA fuses the moveaxis form;
-        # this form just says it directly).
+        # reshape). Each round's block then arrives either as rounds-leading
+        # scan xs (default; see the moveaxis note below) or via a per-round
+        # dynamic-slice on axis 1 (rounds_scan_xs=False, the measured-slower
+        # A/B arm kept for re-benchmarks).
         def split_rounds(a):
             return a[:, :L].reshape((k, rounds, local_iterations) + a.shape[2:])
 
@@ -234,12 +236,15 @@ def make_train_epoch_fn(
             split_rounds(x), split_rounds(y), split_rounds(w)
         )
 
-        def one_round(carry, r):
+        def one_round(carry, xs):
             params, batch_stats, opt_state, engine_state, rng, rnd = carry
-            xb, yb, wb = (
-                jax.lax.dynamic_index_in_dim(a, r, axis=1, keepdims=False)
-                for a in (x_rounds, y_rounds, w_rounds)
-            )  # [k, L, B, ...]
+            if rounds_scan_xs:
+                xb, yb, wb = xs  # [k, L, B, ...] — this round's block
+            else:
+                xb, yb, wb = (
+                    jax.lax.dynamic_index_in_dim(a, xs, axis=1, keepdims=False)
+                    for a in (x_rounds, y_rounds, w_rounds)
+                )
             rng, sub = jax.random.split(rng)
 
             def site_part(es, xs, ys, ws):
@@ -303,8 +308,23 @@ def make_train_epoch_fn(
             jax.random.fold_in(state.rng, state.round),
             state.round,
         )
+        # Scan over rounds-LEADING xs instead of dynamic-indexing axis 1 of
+        # the resident arrays per round: lax.scan slices its xs' leading
+        # axis, which is contiguous, and under compile_epoch_aot's AUTO
+        # input layouts XLA can choose a rounds-major storage order that
+        # makes the moveaxis a layout assignment rather than a copy
+        # (interleaved A/B on the flagship: +9.5%/+21%,
+        # docs/bench_scanxs_ab_r5.jsonl; the r4 profile showed the strided
+        # per-round slice costing 3-7x its raw bytes). Without AOT layouts
+        # (plain jit, as the Trainer uses) the moveaxis may materialize one
+        # whole-epoch copy — still no more bytes than the strided slices it
+        # replaces, and the scan's own leading-axis slices are then free.
+        xs = (
+            tuple(jnp.moveaxis(a, 1, 0) for a in (x_rounds, y_rounds, w_rounds))
+            if rounds_scan_xs else jnp.arange(rounds)
+        )
         (params, stats, opt_state, engine_state, rng, rnd), losses = jax.lax.scan(
-            one_round, carry0, jnp.arange(rounds)
+            one_round, carry0, xs
         )
         new_state = TrainState(
             params=params,

@@ -505,3 +505,36 @@ def test_checkpoint_load_pre_meta_format(tmp_path):
         state.params,
         restored.params,
     )
+
+
+def test_rounds_scan_xs_arms_bitwise_identical():
+    """The epoch's two round-delivery forms — rounds-leading scan xs (the
+    measured-faster default, docs/bench_scanxs_ab_r5.jsonl) and the
+    per-round dynamic-index A/B arm — must produce identical states and
+    losses, so the benchmark arm can't silently rot."""
+    S, steps, B, D = 3, 4, 8, 6
+    task = FederatedTask(MSANNet(in_size=D, hidden_sizes=(8, 4)))
+    engine = make_engine("dSGD")
+    opt = make_optimizer("adam", 1e-3)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(S, steps, B, D)).astype(np.float32))
+    y = jnp.asarray((rng.random((S, steps, B)) > 0.5).astype(np.int32))
+    w = jnp.ones((S, steps, B), jnp.float32)
+    state0 = init_train_state(
+        task, engine, opt, jax.random.PRNGKey(0), x[0, 0], num_sites=S
+    )
+    outs = {}
+    for flag in (True, False):
+        fn = make_train_epoch_fn(
+            task, engine, opt, mesh=None, local_iterations=2,
+            rounds_scan_xs=flag,
+        )
+        st, losses = fn(state0, x, y, w)
+        outs[flag] = (st, losses)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        outs[True][0].params, outs[False][0].params,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(outs[True][1]), np.asarray(outs[False][1])
+    )
