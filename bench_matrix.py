@@ -180,8 +180,13 @@ def main():
             SMRI3DNet(num_cls=2, compute_dtype="bfloat16", space_to_depth=False),
             (32, 32, 32, 8), 8, "dSGD", 4, timed_epochs=max(epochs // 2, 2),
             flops_sample=smri_flops_per_sample())
-    # 5. Multimodal transformer 64-site dSGD (fs 66 + 98 ICA windows of 1000)
-    mm = MultimodalNet(fs_input_size=66, num_comps=100, window_size=10)
+    # 5. Multimodal transformer 64-site dSGD (fs 66 + 98 ICA windows of
+    #    1000). bf16 like the other heavy configs: paired A/B measured
+    #    1.8× over the f32 stream (docs/bench_mm_bf16_ab_r5.jsonl) —
+    #    accuracy tracking pinned by tests/test_extensions.py
+    #    (test_multimodal_bf16_tracks_f32).
+    mm = MultimodalNet(fs_input_size=66, num_comps=100, window_size=10,
+                       compute_dtype="bfloat16")
     measure("multimodal-64site", mm, (66 + 98 * 1000,), 64, "dSGD", 8,
             timed_epochs=max(epochs // 2, 2),
             flops_sample=multimodal_flops_per_sample())

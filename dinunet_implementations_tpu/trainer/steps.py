@@ -317,8 +317,11 @@ def make_train_epoch_fn(
         # docs/bench_scanxs_ab_r5.jsonl; the r4 profile showed the strided
         # per-round slice costing 3-7x its raw bytes). Without AOT layouts
         # (plain jit, as the Trainer uses) the moveaxis may materialize one
-        # whole-epoch copy — still no more bytes than the strided slices it
-        # replaces, and the scan's own leading-axis slices are then free.
+        # whole-epoch copy — no more bytes MOVED than the strided slices it
+        # replaces, but the copy coexists with the (non-donated) original,
+        # so peak HBM residency grows by ~1x the epoch-input size. For
+        # epoch inputs big enough for that to matter (multi-GB), pass
+        # rounds_scan_xs=False.
         xs = (
             tuple(jnp.moveaxis(a, 1, 0) for a in (x_rounds, y_rounds, w_rounds))
             if rounds_scan_xs else jnp.arange(rounds)
