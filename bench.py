@@ -40,6 +40,7 @@ FLOPs at the measured throughput).
 """
 
 import json
+import statistics
 import sys
 import time
 
@@ -94,17 +95,128 @@ def least_contended_marginal(run_chain, n: int, repeats: int = 3,
     return max((min(fulls) - t_half) / (n - half), 1e-9)
 
 
-def flops_per_sample() -> float:
-    """Matmul FLOPs for one training sample (fwd ≈ enc + biLSTM + head;
-    train ≈ 3× fwd for fwd+bwd)."""
-    h = HIDDEN // 2  # per direction
-    enc = WINDOWS * ENC_IN * ENC_OUT * 2
-    lstm = WINDOWS * 2 * (ENC_OUT * 4 * h + h * 4 * h) * 2  # both directions
-    head = HIDDEN * 256 * 2 + 256 * 64 * 2 + 64 * 2 * 2
+def marginal_distribution(pairs, n: int, pre_full: float | None = None) -> dict:
+    """Distribution summary over N paired (half-chain, full-chain) timings.
+
+    ``pairs`` is a list of ``(T(n/2+1), T(n+1))`` wall-clock observations.
+    The headline ``marginal_seconds_per_epoch`` is the least-contended
+    estimator (endpoint minima — module docstring step 3); the
+    ``per_observation`` marginals pair each observation's own endpoints,
+    giving the contention distribution that retires single-observation
+    claims: ``min``/``median``/``spread`` (max − min) are all in
+    seconds/epoch. An observation whose half chain was contended can come
+    out non-positive (full ≤ half); those are recorded verbatim in
+    ``per_observation`` and counted in ``contended``, but EXCLUDED from the
+    min/median/spread summary — a clamped near-zero marginal would
+    otherwise masquerade as an absurd throughput outlier. If even the
+    ENDPOINT-MIN estimate is non-positive (every full chain beat by a half
+    chain — heavy contention), the record is flagged ``unreliable`` rather
+    than reporting the clamp as a measurement. The headline is the number to
+    cite, the spread is the error bar.
+
+    ``pre_full`` feeds an already-observed full-chain timing into the
+    HEADLINE's endpoint minimum only (valid for a min estimator; saves a
+    chain) — it is NOT paired into the distribution, whose observations must
+    be adjacent in time.
+    """
+    half = n // 2
+    denom = n - half
+    halves = [h for h, _ in pairs]
+    fulls = [f for _, f in pairs]
+    per_obs = [(f - h) / denom for h, f in pairs]
+    valid = [v for v in per_obs if v > 0]
+    headline = (min(fulls + ([pre_full] if pre_full is not None else []))
+                - min(halves)) / denom
+    out = {
+        "marginal_seconds_per_epoch": max(headline, 1e-9),
+        "observations": len(pairs),
+        "per_observation": [round(v, 9) for v in per_obs],
+        "contended": len(per_obs) - len(valid),
+    }
+    if headline <= 0:
+        out["unreliable"] = True
+    if valid:
+        out.update(
+            min=min(valid), median=statistics.median(valid),
+            spread=max(valid) - min(valid),
+        )
+    return out
+
+
+def throughput_stats(dist: dict, samples_per_epoch: float) -> dict:
+    """Convert a :func:`marginal_distribution` summary to samples/sec/chip:
+    ``value`` from the least-contended headline; min/median over the VALID
+    (positive-marginal) per-observation points (min throughput = slowest
+    observation); ``spread`` = max − min. Contended (non-positive)
+    observations are excluded from the summary and surfaced as a count; an
+    ``unreliable`` distribution (even the endpoint-min estimate was
+    contention-dominated) reports ``value: None`` instead of the 1e-9
+    clamp's absurd implied throughput."""
+    per = [samples_per_epoch / v for v in dist["per_observation"] if v > 0]
+    out = {
+        "value": (None if dist.get("unreliable") else round(
+            samples_per_epoch / dist["marginal_seconds_per_epoch"], 2)),
+        "observations": dist["observations"],
+        "contended": dist.get("contended", 0),
+    }
+    if dist.get("unreliable"):
+        out["unreliable"] = True
+    if per:
+        out.update(
+            min=round(min(per), 2),
+            median=round(statistics.median(per), 2),
+            spread=round(max(per) - min(per), 2),
+        )
+    return out
+
+
+def interleaved_ab(run_chains: dict, n: int, obs: int = 5) -> dict:
+    """Paired interleaved A/B over named arms, N observations per arm.
+
+    ``run_chains[name](k)`` must return wall-clock seconds for a k-epoch
+    fully-materialized chain of that arm (arms pre-compiled by their first
+    call). Per observation round, every arm's half chain is timed
+    back-to-back, then every arm's full chain, with the arm ORDER alternating
+    between rounds — a minutes-long contention window lands on all arms
+    instead of one (sequential whole-arm A/Bs flipped sign between runs, r5).
+    Returns ``{name: marginal_distribution(...)}``.
+    """
+    names = list(run_chains)
+    pairs = {k: [] for k in names}
+    halves = {}
+    for i in range(obs):
+        order = names if i % 2 == 0 else names[::-1]
+        for k in order:
+            halves[k] = run_chains[k](n // 2 + 1)
+        for k in order:
+            pairs[k].append((halves[k], run_chains[k](n + 1)))
+    return {k: marginal_distribution(v, n) for k, v in pairs.items()}
+
+
+def flops_per_sample_dims(windows: int, enc_in: int, enc_out: int,
+                          hidden: int) -> float:
+    """Matmul FLOPs for one training sample at arbitrary flagship-family
+    dims (fwd ≈ enc + biLSTM + head; train ≈ 3× fwd for fwd+bwd)."""
+    h = hidden // 2  # per direction
+    enc = windows * enc_in * enc_out * 2
+    lstm = windows * 2 * (enc_out * 4 * h + h * 4 * h) * 2  # both directions
+    head = hidden * 256 * 2 + 256 * 64 * 2 + 64 * 2 * 2
     return 3.0 * (enc + lstm + head)
 
 
-def measure_tpu(fused_bidir: bool | None = None, repeats: int = 5) -> float:
+def flops_per_sample() -> float:
+    """Matmul FLOPs for one training sample at the flagship HCP dims."""
+    return flops_per_sample_dims(WINDOWS, ENC_IN, ENC_OUT, HIDDEN)
+
+
+def _setup_epoch(engine_name: str = "dSGD", engine_kw: dict | None = None,
+                 fused_bidir: bool | None = None, dims: dict | None = None):
+    """Build the compiled flagship epoch for one bench arm.
+
+    Returns ``(run_chain, samples_per_epoch)``: ``run_chain(k)`` times a
+    k-epoch fully-materialized chain (compile happens on the first call —
+    call ``run_chain(1)`` once to warm up before timing). ``dims`` overrides
+    the flagship model/data dims (``--small`` harness-validation mode)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -119,26 +231,33 @@ def measure_tpu(fused_bidir: bool | None = None, repeats: int = 5) -> float:
         make_train_epoch_fn,
     )
 
+    d = dict(sites=NUM_SITES, steps=STEPS_PER_EPOCH, batch=BATCH_PER_SITE,
+             windows=WINDOWS, comps=COMPS, wlen=WLEN, enc_out=ENC_OUT,
+             hidden=HIDDEN, compute_dtype="bfloat16")
+    d.update(dims or {})
+
     # bf16 matmuls AND streamed activations with f32 carries/accumulation;
     # the fused Pallas kernel keeps W_ih/W_hh resident in VMEM and streams
     # the raw x once per step (ops/lstm_pallas.py). fused_bidir=False is the
     # A/B arm: two single-direction kernel sweeps instead of the fused
     # bidirectional pooled kernel (VERDICT r4 #1b).
-    model = ICALstm(input_size=ENC_OUT, hidden_size=HIDDEN, num_comps=COMPS,
-                    window_size=WLEN, num_cls=2, compute_dtype="bfloat16",
-                    fused_bidir=fused_bidir)
+    model = ICALstm(input_size=d["enc_out"], hidden_size=d["hidden"],
+                    num_comps=d["comps"], window_size=d["wlen"], num_cls=2,
+                    compute_dtype=d["compute_dtype"], fused_bidir=fused_bidir)
     task = FederatedTask(model)
-    engine = make_engine("dSGD")
+    engine = make_engine(engine_name, **(engine_kw or {}))
     opt = make_optimizer("adam", 1e-3)
 
-    S, steps, B = NUM_SITES, STEPS_PER_EPOCH, BATCH_PER_SITE
+    S, steps, B = d["sites"], d["steps"], d["batch"]
     rng = np.random.default_rng(0)
     # ship inputs pre-cast to the model's compute dtype (what the input
     # pipeline does for a bf16 model): halves the resident input footprint
     # and removes XLA's whole-input convert+layout copy from the epoch
     x = jnp.asarray(
-        rng.normal(size=(S, steps, B, WINDOWS, COMPS, WLEN)).astype(np.float32),
-        dtype=jnp.bfloat16,
+        rng.normal(
+            size=(S, steps, B, d["windows"], d["comps"], d["wlen"])
+        ).astype(np.float32),
+        dtype=jnp.bfloat16 if d["compute_dtype"] == "bfloat16" else None,
     )
     y = jnp.asarray((rng.random((S, steps, B)) > 0.5).astype(np.int32))
     w = jnp.ones((S, steps, B), jnp.float32)
@@ -152,17 +271,88 @@ def measure_tpu(fused_bidir: bool | None = None, repeats: int = 5) -> float:
     epoch_fn, put_x = compile_epoch_aot(epoch_fn, state0, x, y, w)
     x = put_x(x)
 
-    chain_epochs(epoch_fn, state0, x, y, w, 1)  # compile + lazy-runtime warmup
-    # 5 repeats per endpoint for the headline: contended windows last minutes,
-    # so more samples raise the odds of catching an uncontended one
-    dt = least_contended_marginal(
-        lambda k: chain_epochs(epoch_fn, state0, x, y, w, k), TIMED_EPOCHS,
-        repeats=repeats,
-    )
+    def run_chain(k: int) -> float:
+        return chain_epochs(epoch_fn, state0, x, y, w, k)
 
-    n_chips = 1  # the folded site axis runs on one chip
-    samples = S * steps * B
-    return samples / dt / n_chips
+    return run_chain, S * steps * B
+
+
+def measure_tpu(fused_bidir: bool | None = None, repeats: int = 5,
+                with_distribution: bool = False):
+    run_chain, samples = _setup_epoch(fused_bidir=fused_bidir)
+    run_chain(1)  # compile + lazy-runtime warmup
+    # N paired observations per endpoint: contended windows last minutes, so
+    # more samples raise the odds of catching an uncontended one; the pairs
+    # also give the min/median/spread distribution the JSON now carries
+    pairs = [
+        (run_chain(TIMED_EPOCHS // 2 + 1), run_chain(TIMED_EPOCHS + 1))
+        for _ in range(repeats)
+    ]
+    dist = marginal_distribution(pairs, TIMED_EPOCHS)
+    # n_chips = 1: the folded site axis runs on one chip, so per-chip ==
+    # absolute. value is None when every observation was contention-dominated
+    # (throughput_stats unreliable gate).
+    stats = throughput_stats(dist, samples)
+    if with_distribution:
+        return stats["value"], stats
+    return stats["value"]
+
+
+# rankDAD A/B arms (--ab-rankdad): the r6 levers against the r5 baseline and
+# the dSGD ceiling. "warm" = warm-started subspaces (engine-state Ω, the
+# default); "bf16-iter" = mixed-precision power iteration via the bf16 wire;
+# "cold-f32" = the r5 behavior (stateless, f32 everything).
+RANKDAD_AB_ARMS = {
+    "dsgd-ceiling": ("dSGD", {}),
+    "rankdad-cold-f32": ("rankDAD", dict(
+        dad_reduction_rank=10, dad_num_pow_iters=5, dad_tol=1e-3,
+        dad_warm_start=False)),
+    "rankdad-warm-f32": ("rankDAD", dict(
+        dad_reduction_rank=10, dad_num_pow_iters=5, dad_tol=1e-3,
+        dad_warm_start=True)),
+    "rankdad-warm-bf16-iter": ("rankDAD", dict(
+        dad_reduction_rank=10, dad_num_pow_iters=5, dad_tol=1e-3,
+        dad_warm_start=True, precision_bits="16")),
+}
+
+
+def measure_rankdad_ab(obs: int = 5, n: int = TIMED_EPOCHS,
+                       dims: dict | None = None) -> list[dict]:
+    """Paired interleaved A/B of the rankDAD levers (one JSON record per
+    arm). All arms compile up front; observations interleave per round
+    (:func:`interleaved_ab`)."""
+    import jax
+
+    chains = {}
+    samples = None
+    for arm, (engine, kw) in RANKDAD_AB_ARMS.items():
+        chains[arm], samples = _setup_epoch(engine, kw, dims=dims)
+        chains[arm](1)  # compile + warm up before any timing starts
+    dists = interleaved_ab(chains, n, obs=obs)
+    records = []
+    for arm, dist in dists.items():
+        engine, kw = RANKDAD_AB_ARMS[arm]
+        rec = {
+            "metric": "samples/sec/chip (ICA-LSTM federated round, interleaved A/B)",
+            "arm": arm,
+            "engine": engine,
+            "engine_kw": kw,
+            "sites": (dims or {}).get("sites", NUM_SITES),
+            "backend": jax.default_backend(),
+            "chain_epochs": n,
+            "samples_per_sec": throughput_stats(dist, samples),
+            "unit": "samples/sec/chip",
+        }
+        if dims:
+            rec["dims"] = dims
+        elif rec["samples_per_sec"]["value"] is not None:
+            # flagship dims: the MFU model applies
+            rec["mfu"] = round(
+                rec["samples_per_sec"]["value"] * flops_per_sample()
+                / V5E_BF16_PEAK_FLOPS, 4,
+            )
+        records.append(rec)
+    return records
 
 
 def measure_cpu_baseline() -> float:
@@ -192,6 +382,10 @@ def measure_cpu_baseline() -> float:
     return iters * B / (time.time() - t)
 
 
+SMALL_DIMS = dict(sites=32, steps=2, batch=4, windows=6, comps=8, wlen=4,
+                  enc_out=16, hidden=16, compute_dtype="bfloat16")
+
+
 def main():
     baseline = CPU_BASELINE_SAMPLES_PER_SEC
     if "--live-baseline" in sys.argv:
@@ -199,27 +393,47 @@ def main():
             baseline = measure_cpu_baseline()
         except Exception:
             pass
+    if "--ab-rankdad" in sys.argv:
+        # paired interleaved A/B of the rankDAD levers, one JSON line per
+        # arm (≥5 observations each; see docs/bench_rankdad_ab_r6.jsonl).
+        # --small shrinks the model to harness-validation dims (records the
+        # dims + backend so the artifact cannot be mistaken for a TPU
+        # flagship number).
+        obs = int(sys.argv[sys.argv.index("--obs") + 1]) if "--obs" in sys.argv else 5
+        n = (int(sys.argv[sys.argv.index("--epochs") + 1])
+             if "--epochs" in sys.argv else TIMED_EPOCHS)
+        dims = SMALL_DIMS if "--small" in sys.argv else None
+        for rec in measure_rankdad_ab(obs=obs, n=n, dims=dims):
+            print(json.dumps(rec), flush=True)
+        return
     if "--ab-bidir" in sys.argv:
         # A/B the fused bidirectional pooled kernel against two
         # single-direction sweeps, same process, interleaved endpoints are
         # not needed — each arm uses the least-contended-minimum estimator.
         for arm, fused in (("fused-bidir", True), ("per-direction", False)):
-            v = measure_tpu(fused_bidir=fused, repeats=3)
-            print(json.dumps({
+            v, stats = measure_tpu(fused_bidir=fused, repeats=3,
+                                   with_distribution=True)
+            rec = {
                 "metric": f"samples/sec/chip (flagship, {arm})",
-                "arm": arm, "value": round(v, 2),
+                "arm": arm, "value": v,
                 "unit": "samples/sec/chip",
-                "mfu": round(v * flops_per_sample() / V5E_BF16_PEAK_FLOPS, 4),
-            }), flush=True)
+                "samples_per_sec": stats,
+            }
+            if v is not None:
+                rec["mfu"] = round(v * flops_per_sample() / V5E_BF16_PEAK_FLOPS, 4)
+            print(json.dumps(rec), flush=True)
         return
-    value = measure_tpu()
-    print(json.dumps({
+    value, stats = measure_tpu(with_distribution=True)
+    rec = {
         "metric": "samples/sec/chip (ICA-LSTM, 32 sites, full federated round)",
-        "value": round(value, 2),
+        "value": value,
         "unit": "samples/sec/chip",
-        "vs_baseline": round(value / baseline, 2),
-        "mfu": round(value * flops_per_sample() / V5E_BF16_PEAK_FLOPS, 4),
-    }))
+        "samples_per_sec": stats,  # min/median/spread over the N observations
+    }
+    if value is not None:
+        rec["vs_baseline"] = round(value / baseline, 2)
+        rec["mfu"] = round(value * flops_per_sample() / V5E_BF16_PEAK_FLOPS, 4)
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":

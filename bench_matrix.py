@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bench import chain_epochs, least_contended_marginal
+from bench import chain_epochs, marginal_distribution, throughput_stats
 
 from dinunet_implementations_tpu.engines import make_engine
 from dinunet_implementations_tpu.models import (
@@ -123,16 +123,24 @@ def measure(name, model, x_shape, sites, engine_name, batch, engine_kw=None,
         "metric": "samples/sec/chip (full federated round)",
         "unit": "samples/sec/chip",
     }
+    if engine_kw:
+        record["engine_kw"] = engine_kw
     if d <= 0.2:
         # marginal time is inside the latency jitter even at the epoch cap —
         # refuse to print an inflated number (the failure mode this bench
         # methodology exists to eliminate)
         record.update(value=None, unreliable=True, marginal_seconds=round(d, 4))
     else:
-        # final measurement with the shared least-contended estimator
-        # (bench.py) at the calibrated chain length; the calibration's full
-        # chain rides along as a pre-observed endpoint sample
-        dt = least_contended_marginal(run, n, pre_full=tN)
+        # final measurement: N paired (half, full) observations at the
+        # calibrated chain length → least-contended headline + min/median/
+        # spread distribution (bench.py marginal_distribution). The
+        # calibration's full chain feeds the HEADLINE's endpoint minimum only
+        # (valid for a min estimator; saves one chain) — pairing it with a
+        # half chain run minutes later would mix contention windows inside
+        # one "paired" observation.
+        pairs = [(run(n // 2 + 1), run(n + 1)) for _ in range(3)]
+        dist = marginal_distribution(pairs, n, pre_full=tN)
+        dt = dist["marginal_seconds_per_epoch"]
         # the reliability gate must judge the estimate actually reported,
         # not the discarded calibration delta
         if dt * (n - n // 2) <= 0.2:
@@ -141,8 +149,10 @@ def measure(name, model, x_shape, sites, engine_name, batch, engine_kw=None,
                 marginal_seconds=round(dt * (n - n // 2), 4),
             )
         else:
-            record["value"] = round(sites * STEPS * batch / dt, 2)
-            if flops_sample:
+            stats = throughput_stats(dist, sites * STEPS * batch)
+            record["value"] = stats["value"]
+            record["samples_per_sec"] = stats
+            if flops_sample and record["value"] is not None:
                 record["mfu"] = round(
                     record["value"] * flops_sample / V5E_BF16_PEAK_FLOPS, 4
                 )

@@ -71,6 +71,10 @@ class FSArgs:
     dad_reduction_rank: int = 10
     dad_num_pow_iters: int = 5
     dad_tol: float = 1e-3
+    # warm-start rankDAD's subspace Ω from the previous round (engine state;
+    # engines/rankdad.py) — the tol early-exit then fires after 1-2 power
+    # iterations instead of dad_num_pow_iters. False = stateless cold starts.
+    dad_warm_start: bool = True
     split_files: tuple = ()
     # reproduce the reference's string-label bug bit-for-bit: EVERY string
     # maps via (s.lower() == 'true'), so "1" → 0 (comps/fs/__init__.py:25-26);
@@ -104,6 +108,7 @@ class ICAArgs:
     dad_reduction_rank: int = 10
     dad_num_pow_iters: int = 5
     dad_tol: float = 1e-3
+    dad_warm_start: bool = True  # see FSArgs.dad_warm_start
     split_files: tuple = ()
     # parity-only fields: present in compspec.json:261-264 but never read by
     # the reference trainers (grep: no seq_len/components_file use in comps/)
@@ -132,6 +137,7 @@ class SMRI3DArgs:
     dad_reduction_rank: int = 10
     dad_num_pow_iters: int = 5
     dad_tol: float = 1e-3
+    dad_warm_start: bool = True  # see FSArgs.dad_warm_start
     split_files: tuple = ()
 
 
@@ -161,6 +167,7 @@ class MultimodalArgs:
     dad_reduction_rank: int = 10
     dad_num_pow_iters: int = 5
     dad_tol: float = 1e-3
+    dad_warm_start: bool = True  # see FSArgs.dad_warm_start
     split_files: tuple = ()
 
 
@@ -247,6 +254,12 @@ class TrainConfig:
     # 1 = the unpipelined masked wavefront; must divide the batch size.
     # Only meaningful with model_axis_size > 1 on an LSTM task.
     sequence_microbatches: int = 0
+    # rounds-leading scan xs for the epoch loop (trainer/steps.py): the
+    # default trades ~1x the epoch-input size in peak HBM residency for
+    # +9.5-21% throughput (docs/bench_scanxs_ab_r5.jsonl). False switches to
+    # the per-round dynamic-slice arm — the escape hatch for multi-GB epoch
+    # inputs where that residency bump matters more than the speed.
+    rounds_scan_xs: bool = True
     # non-empty → wrap each fit() in jax.profiler.trace(profile_dir) and
     # write a TensorBoard-compatible device trace there (SURVEY.md §5: the
     # reference only has wall-clock duration lists; this is the TPU upgrade)

@@ -50,9 +50,11 @@ class SMRIDataset(SiteDataset):
         self.data = np.asarray(
             load_timecourses(self.path(cache_key="data_file")), np.float32
         )
-        # pipeline-level fold (SMRI3DArgs.space_to_depth): the model is then
-        # built with space_to_depth=False — identical architecture/params,
-        # no per-step relayout (see space_to_depth_222_np)
+        # pipeline-level fold (SMRI3DArgs.space_to_depth): the model KEEPS the
+        # flag (runner/registry.py builds SMRI3DNet with space_to_depth=True)
+        # and recognizes the pre-folded 8-channel input as a no-op — same
+        # architecture/params as an in-model fold, none of the per-step
+        # relayout cost (see space_to_depth_222_np)
         if self.cache.get("space_to_depth"):
             self.data = space_to_depth_222_np(self.data)
         self.indices += [list(f) for f in files]

@@ -1,3 +1,10 @@
 from .base import Engine, available_engines, make_engine
 from . import dsgd, powersgd, rankdad  # noqa: F401 — register engines
-from .lowrank import is_compressible, orthonormalize, subspace_iteration, to_matrix
+from .lowrank import (
+    is_compressible,
+    orthonormalize,
+    subspace_iteration,
+    subspace_iteration_grouped,
+    subspace_iteration_multi,
+    to_matrix,
+)

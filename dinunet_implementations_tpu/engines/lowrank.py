@@ -22,6 +22,30 @@ def is_compressible(g, min_rank_dim: int = 2) -> bool:
     return g.ndim >= 2 and min(_matrix_shape(g)) >= min_rank_dim
 
 
+def lp_matmul(a, b, dtype=None):
+    """``a @ b``, optionally with both operands cast to a low-precision
+    ``dtype`` (bf16) while ACCUMULATING in f32 (``preferred_element_type``) —
+    the MXU-native mixed-precision contraction. ``dtype=None`` is a plain f32
+    matmul. Used for the LARGE power-iteration products ``G@Ω`` / ``GᵀP`` /
+    ``G(GᵀP)``; the tiny ``[r, r]`` Gram/Cholesky stays f32 regardless (its
+    conditioning drives the CholeskyQR shift analysis in
+    :func:`_cholqr_multi`, and it is not where the FLOPs are)."""
+    if dtype is None:
+        return a @ b
+    return jnp.matmul(
+        a.astype(dtype), b.astype(dtype), preferred_element_type=jnp.float32
+    )
+
+
+def default_omega(G, r: int, key=None):
+    """The per-shape default random init Ω ``[n, r]`` — the draw every solo
+    run makes, and the value the rankDAD engine stores at ``init`` so its
+    first warm-started round is bit-identical to a cold start."""
+    if key is None:
+        key = jax.random.PRNGKey(G.shape[0] * 1000003 + G.shape[1])
+    return jax.random.normal(key, (G.shape[1], r), jnp.float32)
+
+
 def _matrix_shape(g):
     m = 1
     for d in g.shape[:-1]:
@@ -167,77 +191,141 @@ def _cholqr(Y):
     return Qs[0], colnorms[0]
 
 
-def subspace_iteration_multi(Gs, rank: int, num_iters: int, tol: float,
-                             keys=None):
-    """Rank-r factorizations ``G_l ≈ P_l @ Q_lᵀ`` by LOCKSTEP subspace (block
-    power) iteration over a group of matrices sharing
-    ``r = min(rank, m_l, n_l)``.
+def subspace_iteration_grouped(groups, num_iters: int, tol: float,
+                               matmul_dtype=None):
+    """Rank-r factorizations ``G ≈ P @ Qᵀ`` for SEVERAL same-rank groups in
+    ONE shared ``lax.while_loop``.
 
-    Each P_l is [m_l, r] orthonormal, Q_l = G_lᵀ P_l is [n_l, r].
-    Per-member trip counts keep the solo semantics (``dad_tol`` /
-    ``dad_num_pow_iters``): a member stops updating once its own relative
-    σ-estimate change drops below ``tol``; the shared loop runs until every
-    member converged or ``num_iters``. Orthonormalization is the lockstep
-    CholeskyQR2 (:func:`_cholqr_multi`) — one batched Cholesky custom-call
-    per iteration for the WHOLE group instead of one per layer, which is
-    where rankDAD's wall-clock went (see :func:`_cholqr_once_multi`).
+    ``groups`` is a list of ``(Gs, rank, omegas)`` triples: each group's
+    members share ``r = min(rank, m_l, n_l)``; ``omegas`` is a per-member
+    list of warm-start subspaces ``[n_l, r]`` (``None`` entries draw the
+    :func:`default_omega` for that member, i.e. a cold start; ``omegas=None``
+    cold-starts the whole group). Returns one ``[(P_l, Q_l), ...]`` list per
+    group, order preserved.
+
+    Why one loop: rankDAD's leaves fall into a handful of effective-rank
+    classes (the flagship ICA-LSTM has r=10 for every big kernel plus r=2 for
+    the [64, 2] head), and one ``lax.while_loop`` per class SERIALIZES the
+    classes — XLA runs whiles one after another, so the tiny r=2 class adds
+    its full trip latency to the r=10 class's. Here every class shares a
+    single loop (audit, r6): per-class work is emitted side by side in one
+    body, the trip count is the max over all members, and per-member trip
+    semantics are kept by the same active-mask freezing as before. Within a
+    class the ``[r, r]`` Gram matrices still stack and factor through the
+    unrolled batched Cholesky (:func:`_cholqr_once_multi`).
+
+    ``matmul_dtype=jnp.bfloat16`` runs the LARGE products (``G@Ω``, ``GᵀP``,
+    ``G(GᵀP)``, the final ``Q``) as bf16×bf16→f32 MXU contractions
+    (:func:`lp_matmul`); orthonormalization and the σ-convergence test stay
+    f32. Warm starts make this safe in practice: bf16 noise perturbs the
+    iterate, but the subspace is re-refined every round from the previous
+    round's Ω.
 
     σ estimates come from the orthonormalization's column norms for free —
     ``‖(G Gᵀ P)ᵢ‖`` estimates σᵢ², so ``sqrt`` puts the convergence test on
-    the same σ scale the reference's ``dad_tol`` means.
+    the same σ scale the reference's ``dad_tol`` means. A member stops
+    updating once its own relative σ-estimate change drops below ``tol``.
     """
-    Gs = [G.astype(jnp.float32) for G in Gs]
+    mm = lp_matmul
+    prepped = []  # (Gs_f32, omegas_f32) per group, ranks clamped
+    for Gs, rank, omegas in groups:
+        Gs = [G.astype(jnp.float32) for G in Gs]
+        r = min([rank] + [min(G.shape) for G in Gs])
+        if omegas is None:
+            omegas = [None] * len(Gs)
+        elif len(omegas) != len(Gs):
+            raise ValueError(
+                f"omegas has {len(omegas)} entries for {len(Gs)} matrices"
+            )
+        oms = [
+            default_omega(G, r) if om is None else om.astype(jnp.float32)
+            for G, om in zip(Gs, omegas)
+        ]
+        prepped.append((Gs, oms))
+
+    init_Ps, init_sigs, init_deltas = [], [], []
+    for Gs, oms in prepped:
+        Ps, _ = _cholqr_multi([mm(G, om, matmul_dtype) for G, om in zip(Gs, oms)])
+        sigs = jnp.stack(
+            [jnp.linalg.norm(mm(G.T, P, matmul_dtype), axis=0)
+             for G, P in zip(Gs, Ps)]
+        )  # [L, r] σ estimates, column order
+        # Tie the initial deltas to the Gs so their device-varying annotation
+        # matches the loop body's output under shard_map (per-site G ⇒
+        # per-site delta).
+        deltas0 = jnp.full((len(Gs),), jnp.inf, jnp.float32) + 0.0 * sigs.sum(-1)
+        init_Ps.append(tuple(Ps))
+        init_sigs.append(sigs)
+        init_deltas.append(deltas0)
+
+    def cond(carry):
+        i, _, _, deltas = carry
+        worst = jnp.max(jnp.stack([jnp.max(d) for d in deltas]))
+        return jnp.logical_and(i < num_iters, worst > tol)
+
+    def body(carry):
+        i, Ps_all, sigs_all, deltas_all = carry
+        out_Ps, out_sigs, out_deltas = [], [], []
+        for (Gs, _), Ps, sigs, deltas in zip(
+            prepped, Ps_all, sigs_all, deltas_all
+        ):
+            P_cand, colnorms = _cholqr_multi(
+                [mm(G, mm(G.T, P, matmul_dtype), matmul_dtype)
+                 for G, P in zip(Gs, Ps)]
+            )
+            sig_new = jnp.sqrt(jnp.stack(colnorms))  # ‖G Gᵀ p‖ ≈ σ² → σ scale
+            delta_new = jnp.linalg.norm(sig_new - sigs, axis=-1) / jnp.maximum(
+                jnp.linalg.norm(sigs, axis=-1), 1e-12
+            )
+            active = deltas > tol  # members still iterating (solo trip counts)
+            out_Ps.append(tuple(
+                jnp.where(active[l], P_cand[l], Ps[l]) for l in range(len(Gs))
+            ))
+            out_sigs.append(jnp.where(active[:, None], sig_new, sigs))
+            out_deltas.append(jnp.where(active, delta_new, deltas))
+        return i + 1, tuple(out_Ps), tuple(out_sigs), tuple(out_deltas)
+
+    _, Ps_all, _, _ = jax.lax.while_loop(
+        cond, body,
+        (jnp.zeros((), jnp.int32), tuple(init_Ps), tuple(init_sigs),
+         tuple(init_deltas)),
+    )
+    return [
+        [(P, mm(G.T, P, matmul_dtype)) for G, P in zip(Gs, Ps)]
+        for (Gs, _), Ps in zip(prepped, Ps_all)
+    ]
+
+
+def subspace_iteration_multi(Gs, rank: int, num_iters: int, tol: float,
+                             keys=None, omegas=None, matmul_dtype=None):
+    """Rank-r factorizations ``G_l ≈ P_l @ Q_lᵀ`` by LOCKSTEP subspace (block
+    power) iteration over ONE group of matrices sharing
+    ``r = min(rank, m_l, n_l)`` — a group of one over
+    :func:`subspace_iteration_grouped`.
+
+    Each P_l is [m_l, r] orthonormal, Q_l = G_lᵀ P_l is [n_l, r].
+    ``keys[l]`` overrides the PRNG key for member l's default Ω draw;
+    ``omegas[l]`` supplies the subspace itself (warm start) and wins over
+    ``keys[l]``. ``None`` entries keep the per-shape default — identical to
+    what each solo run drew.
+    """
     L = len(Gs)
-    r = min([rank] + [min(G.shape) for G in Gs])
-    # per-member key from its shape — identical to what each solo run drew —
-    # unless the caller supplies explicit keys (``keys[l]`` may be None to
-    # keep the default for that member)
     if keys is None:
         keys = [None] * L
     elif len(keys) != L:
         raise ValueError(f"keys has {len(keys)} entries for {L} matrices")
-    omegas = [
-        jax.random.normal(
-            jax.random.PRNGKey(G.shape[0] * 1000003 + G.shape[1])
-            if k is None else k,
-            (G.shape[1], r), jnp.float32,
-        )
-        for G, k in zip(Gs, keys)
+    r = min([rank] + [min(G.shape) for G in Gs])
+    if omegas is None:
+        omegas = [None] * L
+    elif len(omegas) != L:
+        raise ValueError(f"omegas has {len(omegas)} entries for {L} matrices")
+    oms = [
+        om if om is not None else default_omega(jnp.asarray(G), r, k)
+        for G, om, k in zip(Gs, omegas, keys)
     ]
-    Ps, _ = _cholqr_multi([G @ om for G, om in zip(Gs, omegas)])
-    sigs = jnp.stack(
-        [jnp.linalg.norm(G.T @ P, axis=0) for G, P in zip(Gs, Ps)]
-    )  # [L, r] σ estimates, column order
-
-    def cond(carry):
-        i, _, _, deltas = carry
-        return jnp.logical_and(i < num_iters, jnp.max(deltas) > tol)
-
-    def body(carry):
-        i, Ps, sigs, deltas = carry
-        P_cand, colnorms = _cholqr_multi(
-            [G @ (G.T @ P) for G, P in zip(Gs, Ps)]
-        )
-        sig_new = jnp.sqrt(jnp.stack(colnorms))  # ‖G Gᵀ p‖ ≈ σ² → σ scale
-        delta_new = jnp.linalg.norm(sig_new - sigs, axis=-1) / jnp.maximum(
-            jnp.linalg.norm(sigs, axis=-1), 1e-12
-        )
-        active = deltas > tol  # members still iterating (solo trip counts)
-        Ps = tuple(
-            jnp.where(active[l], P_cand[l], Ps[l]) for l in range(L)
-        )
-        sigs = jnp.where(active[:, None], sig_new, sigs)
-        deltas = jnp.where(active, delta_new, deltas)
-        return i + 1, Ps, sigs, deltas
-
-    # Tie the initial deltas to the Gs so their device-varying annotation
-    # matches the loop body's output under shard_map (per-site G ⇒ per-site
-    # delta).
-    deltas0 = jnp.full((L,), jnp.inf, jnp.float32) + 0.0 * sigs.sum(-1)
-    _, Ps, _, _ = jax.lax.while_loop(
-        cond, body, (jnp.zeros((), jnp.int32), tuple(Ps), sigs, deltas0)
-    )
-    return [(P, G.T @ P) for G, P in zip(Gs, Ps)]
+    return subspace_iteration_grouped(
+        [(Gs, rank, oms)], num_iters, tol, matmul_dtype=matmul_dtype
+    )[0]
 
 
 def subspace_iteration(G, rank: int, num_iters: int, tol: float, key=None):

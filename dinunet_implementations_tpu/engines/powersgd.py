@@ -21,9 +21,15 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..parallel.collectives import payload_dtype, site_weight_scale
+from ..parallel.collectives import payload_dtype, site_weight_scale, wire_compress
 from .base import Engine, register_engine
-from .lowrank import from_matrix, is_compressible, orthonormalize, to_matrix
+from .lowrank import (
+    from_matrix,
+    is_compressible,
+    lp_matmul,
+    orthonormalize,
+    to_matrix,
+)
 
 
 @register_engine("powerSGD")
@@ -34,6 +40,10 @@ def make_powersgd(
     **_unused,
 ) -> Engine:
     pdtype = payload_dtype(precision_bits)
+    # same mixed-precision playbook as rankDAD (engines/rankdad.py): a bf16
+    # wire also runs the big M@q / MᵀP products as bf16×bf16→f32 MXU
+    # contractions; orthonormalization stays f32. "16-ieee"/"32" keep f32.
+    mm_dtype = jnp.bfloat16 if pdtype == jnp.bfloat16 else None
 
     def init(grads):
         leaves, treedef = jax.tree.flatten(grads)
@@ -74,11 +84,13 @@ def make_powersgd(
             # wire-compress to the payload dtype, then accumulate in fp32
             # (policy in parallel/collectives.py: psum never runs in bf16)
             P = jax.lax.psum(
-                (M @ q * scale).astype(pdtype).astype(jnp.float32), axis_name
+                wire_compress(lp_matmul(M, q, mm_dtype) * scale, pdtype),
+                axis_name,
             )
             P = orthonormalize(P)
             q_new = jax.lax.psum(
-                (M.T @ P * scale).astype(pdtype).astype(jnp.float32), axis_name
+                wire_compress(lp_matmul(M.T, P, mm_dtype) * scale, pdtype),
+                axis_name,
             )
             G_hat = P @ q_new.T
             e_new = M - G_hat

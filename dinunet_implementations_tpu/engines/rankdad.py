@@ -11,6 +11,28 @@ TPU shape of the exchange (SURVEY.md §2.2): ``all_gather`` of the
 ``r·(m+n)`` per site instead of ``m·n`` — followed by one batched einsum
 reconstruction, which XLA maps straight onto the MXU. 1-D leaves (biases, BN
 scales) are aggregated densely like dSGD.
+
+Perf structure (r6 — the rankDAD-32 gap work):
+
+- **Warm-started subspaces**: per-leaf Ω ``[n, r]`` persists in the engine
+  state (the same per-site threading powerSGD's Q/error-feedback uses,
+  ``trainer/steps.py``) and seeds the next round's power iteration with the
+  previous round's right factor. Adjacent rounds' gradients share most of
+  their top-r subspace, so the tol-based early exit fires after 1-2
+  refinements instead of ``dad_num_pow_iters`` — the knob becomes a cap, not
+  a cost. At ``init`` Ω holds the cold-start default draw
+  (``lowrank.default_omega``), making round one bit-identical to a cold
+  start. ``dad_warm_start=False`` restores stateless behavior.
+- **Mixed-precision power iteration**: ``precision_bits="16"`` (the bf16
+  wire) also runs the large ``G@Ω``/``GᵀP``/``G(GᵀP)`` products as
+  bf16×bf16→f32 MXU contractions; the tiny ``[r, r]`` Gram/Cholesky stays
+  f32 (``lowrank.lp_matmul``). ``"16-ieee"`` keeps f32 math — it exists for
+  bit-compat with the reference's fp16 wire, not for speed.
+- **One while_loop, one gather**: all effective-rank classes factorize in a
+  single shared ``lax.while_loop`` (``lowrank.subspace_iteration_grouped``;
+  one loop per class serialized on-device), and each class's factors ship in
+  ONE packed ``all_gather`` (``collectives.site_all_gather_packed``) instead
+  of two launches per leaf.
 """
 
 from __future__ import annotations
@@ -18,12 +40,17 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..parallel.collectives import payload_dtype, site_all_gather, site_weight_scale
+from ..parallel.collectives import (
+    payload_dtype,
+    site_all_gather_packed,
+    site_weight_scale,
+)
 from .base import Engine, register_engine
 from .lowrank import (
+    default_omega,
     from_matrix,
     is_compressible,
-    subspace_iteration_multi,
+    subspace_iteration_grouped,
     to_matrix,
 )
 
@@ -34,53 +61,92 @@ def make_rankdad(
     dad_num_pow_iters: int = 5,
     dad_tol: float = 1e-3,
     precision_bits="32",
+    dad_warm_start: bool = True,
     **_unused,
 ) -> Engine:
     pdtype = payload_dtype(precision_bits)
+    # bf16 wire ⇒ bf16 power-iteration matmuls (see module docstring);
+    # "16-ieee"/"32" keep f32 math.
+    mm_dtype = jnp.bfloat16 if pdtype == jnp.bfloat16 else None
+
+    def _effective_rank(g) -> int:
+        m, n = to_matrix(g).shape
+        return min(dad_reduction_rank, m, n)
 
     def init(grads):
-        return {}
+        if not dad_warm_start:
+            return {}
+        leaves, treedef = jax.tree.flatten(grads)
+        # Ω starts as the cold-start default draw, so the first warm round is
+        # bit-identical to a cold start; None for dense (1-D) leaves, exactly
+        # like powerSGD's q/e state layout.
+        oms = [
+            default_omega(to_matrix(g), _effective_rank(g))
+            if is_compressible(g) else None
+            for g in leaves
+        ]
+        return {"omega": jax.tree.unflatten(treedef, oms)}
 
     def aggregate(grads, state, weight, axis_name):
         scale = site_weight_scale(weight, axis_name)
-
-        def reconstruct(g, P, Q):
-            # weight one factor so the gathered reconstruction sums to the
-            # weighted mean; cast payload like the reference's precision_bits
-            P_pay = P.astype(pdtype)
-            Q_pay = (Q * scale).astype(pdtype)
-            P_all = site_all_gather(P_pay, axis_name)  # [S, m, r]
-            Q_all = site_all_gather(Q_pay, axis_name)  # [S, n, r]
-            G_hat = jnp.einsum(
-                "smr,snr->mn",
-                P_all.astype(jnp.float32),
-                Q_all.astype(jnp.float32),
-            )
-            return from_matrix(G_hat, g)
-
         leaves, treedef = jax.tree.flatten(grads)
+        omegas = (
+            treedef.flatten_up_to(state["omega"])
+            if dad_warm_start else [None] * len(leaves)
+        )
         out: list = [None] * len(leaves)
+        new_oms = list(omegas)
         # layers sharing an effective rank factorize in LOCKSTEP so the tiny
-        # [r, r] Cholesky custom-calls batch across the group (engine
-        # wall-clock was dominated by issuing them per layer per iteration —
-        # see lowrank._cholqr_once_multi)
+        # [r, r] Cholesky work batches across the group; ALL groups then share
+        # one while_loop (subspace_iteration_grouped) so rank classes don't
+        # serialize against each other.
         groups: dict[int, list[int]] = {}
         for i, g in enumerate(leaves):
             if is_compressible(g):
-                m, n = to_matrix(g).shape
-                groups.setdefault(min(dad_reduction_rank, m, n), []).append(i)
+                groups.setdefault(_effective_rank(g), []).append(i)
             else:
                 # dense dSGD path for 1-D leaves (biases, BN affines)
                 out[i] = jax.lax.psum(
                     g.astype(jnp.float32) * scale, axis_name
                 ).astype(g.dtype)
-        for r, idxs in groups.items():
-            pqs = subspace_iteration_multi(
-                [to_matrix(leaves[i]) for i in idxs],
-                r, dad_num_pow_iters, dad_tol,
-            )
-            for i, (P, Q) in zip(idxs, pqs):
-                out[i] = reconstruct(leaves[i], P, Q)
-        return jax.tree.unflatten(treedef, out), state
+        order = sorted(groups.items())
+        results = subspace_iteration_grouped(
+            [
+                ([to_matrix(leaves[i]) for i in idxs], r,
+                 [omegas[i] for i in idxs])
+                for r, idxs in order
+            ],
+            dad_num_pow_iters, dad_tol, matmul_dtype=mm_dtype,
+        )
+        for (r, idxs), pqs in zip(order, results):
+            # weight one factor so the gathered reconstruction sums to the
+            # weighted mean; cast payloads like the reference's
+            # precision_bits, and ship the whole rank group in ONE packed
+            # gather (P_0, Q_0, P_1, Q_1, ... interleaved)
+            parts = []
+            for P, Q in pqs:
+                parts.append(P.astype(pdtype))
+                parts.append((Q * scale).astype(pdtype))
+            gathered = site_all_gather_packed(parts, axis_name)
+            for k, (i, (P, Q)) in enumerate(zip(idxs, pqs)):
+                G_hat = jnp.einsum(
+                    "smr,snr->mn",
+                    gathered[2 * k].astype(jnp.float32),      # [S, m, r]
+                    gathered[2 * k + 1].astype(jnp.float32),  # [S, n, r]
+                )
+                out[i] = from_matrix(G_hat, leaves[i])
+                if dad_warm_start:
+                    # next round's subspace guess: this round's (per-site,
+                    # unweighted) right factor Q = GᵀP. Y₀ = G@Q ≈ G(GᵀP) —
+                    # one power refinement for free at init. A zero gradient
+                    # leaves Q=0; the CholeskyQR zero-column fallback then
+                    # re-seeds from canonical basis vectors, so the subspace
+                    # recovers the round the gradient returns.
+                    new_oms[i] = Q
+        new_state = (
+            {"omega": jax.tree.unflatten(treedef, new_oms)}
+            if dad_warm_start else state
+        )
+        return jax.tree.unflatten(treedef, out), new_state
 
     return Engine("rankDAD", init, aggregate)

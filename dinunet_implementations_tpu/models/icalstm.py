@@ -31,6 +31,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ..core.jaxcompat import axis_size
 from .layers import BatchNorm, TorchLinearInit, compute_dtype_of, dense
 
 
@@ -298,7 +299,7 @@ class ICALstm(nn.Module):
         if self.sequence_axis is not None:
             from ..parallel.sequence import shard_sequence
 
-            n = jax.lax.axis_size(self.sequence_axis)
+            n = axis_size(self.sequence_axis)
             if S % n:
                 raise ValueError(
                     f"sequence parallelism needs windows ({S}) divisible by "

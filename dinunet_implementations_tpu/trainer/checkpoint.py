@@ -55,12 +55,19 @@ def save_checkpoint(path: str, state: TrainState, meta: dict | None = None) -> s
 
 def load_checkpoint(path: str, like: TrainState, with_meta: bool = False):
     """Restore into the structure of ``like`` (shapes/treedef must match).
-    ``with_meta=True`` also returns the embedded (atomically-paired) meta."""
+    ``with_meta=True`` also returns the embedded (atomically-paired) meta.
+
+    The ENGINE state restores tolerantly: its structure is an engine
+    implementation detail (powerSGD's q/e, rankDAD's warm-start Ω — absent
+    entirely in checkpoints saved before r6, or when ``dad_warm_start``
+    differs between save and resume), and a mismatch falls back to ``like``'s
+    freshly-initialized engine state with a warning instead of failing the
+    whole resume. That cold-restarts the warm-start/error-feedback carry —
+    mathematically safe — while params/optimizer/rng resume exactly."""
     template = {
         "params": like.params,
         "batch_stats": like.batch_stats,
         "opt_state": like.opt_state,
-        "engine_state": like.engine_state,
         "rng": like.rng,
         "round": like.round,
     }
@@ -69,13 +76,26 @@ def load_checkpoint(path: str, like: TrainState, with_meta: bool = False):
     # meta_json restored tolerantly: checkpoints written before it existed
     # (pre-0.2.0) must still resume rather than fail the template match
     meta_json = raw.pop("meta_json", None)
+    eng_raw = raw.pop("engine_state", None)
     restored = flax.serialization.from_state_dict(template, raw)
     restored["meta_json"] = meta_json
+    try:
+        engine_state = flax.serialization.from_state_dict(
+            like.engine_state, eng_raw
+        )
+    except (KeyError, TypeError, ValueError):
+        print(
+            f"[warn] checkpoint {path}: stored engine state does not match "
+            "the current engine's structure (engine or its knobs — e.g. "
+            "dad_warm_start — changed since the save); resuming with fresh "
+            "engine state."
+        )
+        engine_state = like.engine_state
     state = TrainState(
         params=restored["params"],
         batch_stats=restored["batch_stats"],
         opt_state=restored["opt_state"],
-        engine_state=restored["engine_state"],
+        engine_state=engine_state,
         rng=jnp.asarray(restored["rng"]),
         round=jnp.asarray(restored["round"]),
     )

@@ -69,7 +69,8 @@ class FederatedTrainer:
         )
         self.optimizer = make_optimizer(cfg.optimizer, cfg.learning_rate)
         self.epoch_fn = make_train_epoch_fn(
-            self.task, self.engine, self.optimizer, mesh, cfg.local_iterations
+            self.task, self.engine, self.optimizer, mesh, cfg.local_iterations,
+            rounds_scan_xs=cfg.rounds_scan_xs,
         )
         self.eval_fn = make_eval_fn(self.task, mesh)
         # ship inputs to the device pre-cast to the model's compute dtype
@@ -207,11 +208,8 @@ class FederatedTrainer:
             return self.test_only(test_sites, fold=fold)
         t_start = time.time()
         self._num_sites = len(train_sites)
-        # Heterogeneous-site guard (VERDICT r4 #6): with drop_last train
-        # batching, a site smaller than batch_size yields ZERO batches and
-        # contributes nothing (or, if every site is small, plan_epoch
-        # asserts). Clamp to the smallest non-empty site's train split so any
-        # demo-sized tree trains, and say so.
+        # Fail fast on splits that are empty at EVERY site; per-site emptiness
+        # and too-small sites are handled below (warning / batch-size clamp).
         sizes = [
             (len(a), len(b), len(c))
             for a, b, c in zip(train_sites, val_sites, test_sites)
@@ -235,8 +233,11 @@ class FederatedTrainer:
             # batching, a site smaller than batch_size yields ZERO batches
             # and contributes nothing (or, if every site is small, plan_epoch
             # asserts). Clamp so any demo-sized tree trains, and say so.
-            # replace(), not in-place: self.cfg is shared with the caller
-            # (FedRunner hands one config object to every fold's trainer).
+            # The clamp stays in the LOCAL cfg only — self.cfg is shared with
+            # the caller (FedRunner hands one config object to every fold's
+            # trainer), and a fold with small sites must not shrink the batch
+            # for later folds (ADVICE r5). The clamped batch size is threaded
+            # explicitly to run_epoch/evaluate below.
             if verbose:
                 print(
                     f"[warn] batch_size={cfg.batch_size} exceeds the smallest "
@@ -245,7 +246,7 @@ class FederatedTrainer:
                     "batching would starve that site). Pass a batch_size <= "
                     f"{min_site} to silence this."
                 )
-            cfg = self.cfg = cfg.replace(batch_size=min_site)
+            cfg = cfg.replace(batch_size=min_site)
         if verbose:
             for i, s in enumerate(train_sites):
                 if not len(s):
@@ -323,7 +324,9 @@ class FederatedTrainer:
         try:
             for epoch in range(start_epoch, cfg.epochs + 1):
                 e_start = time.time()
-                state, losses = self.run_epoch(state, train_sites, epoch)
+                state, losses = self.run_epoch(
+                    state, train_sites, epoch, batch_size=cfg.batch_size
+                )
                 epoch_losses.append(float(losses.mean()))
                 # per-iteration durations (reference local_iter_duration is
                 # per-round, NB.ipynb cells 34-36). All rounds of an epoch run in
@@ -335,7 +338,9 @@ class FederatedTrainer:
 
                 if epoch % cfg.validation_epochs == 0:
                     if has_val:
-                        val_avg, val_metrics = self.evaluate(state, val_sites)
+                        val_avg, val_metrics = self.evaluate(
+                            state, val_sites, batch_size=cfg.batch_size
+                        )
                         score = val_metrics.value(monitor) if monitor != "loss" else val_avg.avg
                         if is_improvement(
                             score, best_metric, direction if monitor != "loss" else "minimize"
@@ -395,7 +400,9 @@ class FederatedTrainer:
         # final validation so the trained weights compete for selection.
         if best_metric is None and cfg.epochs > 0:
             if has_val:
-                val_avg, val_metrics = self.evaluate(state, val_sites)
+                val_avg, val_metrics = self.evaluate(
+                    state, val_sites, batch_size=cfg.batch_size
+                )
                 score = val_metrics.value(monitor) if monitor != "loss" else val_avg.avg
                 best_metric, best_epoch, best_state = score, stop_epoch, state
             else:
@@ -403,7 +410,8 @@ class FederatedTrainer:
 
         # --- test with the best state (reference: best-epoch checkpoint)
         results = self._test_results(best_state, test_sites, best_epoch,
-                                     best_metric, stop_epoch, epoch_losses)
+                                     best_metric, stop_epoch, epoch_losses,
+                                     batch_size=cfg.batch_size)
         if self.out_dir:
             self._write_outputs(results, iter_durations, best_state, fold)
         results["state"] = best_state
@@ -438,10 +446,14 @@ class FederatedTrainer:
         return results
 
     def _test_results(self, state, test_sites, best_epoch, best_metric,
-                      stop_epoch, epoch_losses) -> dict:
+                      stop_epoch, epoch_losses, batch_size=None) -> dict:
+        # batch_size threads the fold-local clamp (fit) through to the test
+        # eval: values are identical either way (plan_eval mask-pads), but
+        # reusing the validation evals' batch shape avoids a second XLA
+        # compilation of the eval step at the unclamped shape.
         monitor = self.cfg.monitor_metric
         test_avg, test_metrics, site_results = self.evaluate(
-            state, test_sites, per_site=True
+            state, test_sites, batch_size=batch_size, per_site=True
         )
         monitored = test_metrics.value(monitor) if monitor != "loss" else test_avg.avg
         return {
@@ -478,7 +490,8 @@ class FederatedTrainer:
         # during warm-up would diverge from the reference's plain local SGD.
         pre_engine = make_engine("dSGD", precision_bits=self.cfg.precision_bits)
         pre_epoch_fn = make_train_epoch_fn(
-            self.task, pre_engine, pre_opt, self.mesh, pa.local_iterations
+            self.task, pre_engine, pre_opt, self.mesh, pa.local_iterations,
+            rounds_scan_xs=self.cfg.rounds_scan_xs,
         )
         pre_state = TrainState(
             params=state.params,

@@ -30,7 +30,7 @@ import flax.struct
 import jax
 import jax.numpy as jnp
 import optax
-from jax import shard_map
+from dinunet_implementations_tpu.core.jaxcompat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..engines.base import Engine
@@ -62,9 +62,10 @@ class TrainState:
 
 def _state_specs(state: TrainState):
     """shard_map partition specs: everything replicated except the per-site
-    engine state (e.g. powerSGD's error-feedback residual), which is sharded
-    over the site axis — collapsing it to one site's copy would silently break
-    error feedback across epoch boundaries."""
+    engine state — powerSGD's error-feedback residual/Q and rankDAD's
+    warm-start subspace Ω (engines/rankdad.py) — which is sharded over the
+    site axis; collapsing it to one site's copy would silently break error
+    feedback (and subspace warm starts) across epoch boundaries."""
     return TrainState(
         params=jax.tree.map(lambda _: P(), state.params),
         batch_stats=jax.tree.map(lambda _: P(), state.batch_stats),
@@ -392,11 +393,11 @@ def compile_epoch_aot(epoch_fn, state: TrainState, x, y, w):
     ``epoch_fn``. Single-device path (``mesh=None``) — the shard_map path
     distributes inputs instead of keeping them resident.
     """
-    from jax.experimental.layout import Format, Layout
+    from ..core.jaxcompat import auto_input_format, input_formats_of
 
-    in_sh = (jax.tree.map(lambda _: None, state), Format(Layout.AUTO), None, None)
+    in_sh = (jax.tree.map(lambda _: None, state), auto_input_format(), None, None)
     comp = jax.jit(epoch_fn, in_shardings=in_sh).lower(state, x, y, w).compile()
-    x_fmt = comp.input_formats[0][1]
+    x_fmt = input_formats_of(comp)[0][1]
     return comp, lambda xs: jax.device_put(xs, x_fmt)
 
 

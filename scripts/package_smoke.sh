@@ -32,7 +32,10 @@ fi
 PYTHONPATH="$WORK/site" JAX_PLATFORMS=cpu python - <<EOF
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # older jax: the quickstart below is vmap-folded, 1 CPU device is fine
 import dinunet_implementations_tpu as dt
 assert dt.__file__.startswith("$WORK/site"), (
     f"imported from {dt.__file__}, not the installed wheel"

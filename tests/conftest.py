@@ -18,7 +18,12 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 # sitecustomize may have imported jax already (axon PJRT registration), so the
-# env var alone is too late — set the config knob directly.
+# env var alone is too late — set the config knob directly. The device-count
+# knob only exists on newer jax (older versions honor the XLA_FLAGS env var
+# set above instead), so tolerate its absence.
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass
 jax.config.update("jax_enable_x64", False)

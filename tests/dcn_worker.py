@@ -26,7 +26,10 @@ import jax
 
 # config knobs, not env vars: sitecustomize imports jax at interpreter start
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+try:
+    jax.config.update("jax_num_cpu_devices", 4)
+except AttributeError:
+    pass  # older jax honors the XLA_FLAGS device-count flag instead
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -50,6 +50,104 @@ def test_least_contended_marginal_floor_guards_nonpositive():
     assert dt == 1e-9
 
 
+def test_marginal_distribution_headline_and_spread():
+    """The headline must be the least-contended (endpoint-minimum) estimator;
+    min/median/spread summarize the per-observation paired marginals."""
+    bench = _bench()
+    n, c, fetch = 100, 0.010, 4.5
+    half, denom = n // 2, n - n // 2
+    # observation 2 is contended on the full chain only
+    pairs = [
+        (half * c + fetch, n * c + fetch),
+        (half * c + fetch + 0.5, n * c + fetch + 3.0),
+        (half * c + fetch, n * c + fetch + 1.0),
+    ]
+    d = bench.marginal_distribution(pairs, n)
+    assert abs(d["marginal_seconds_per_epoch"] - c) < 1e-12
+    assert d["observations"] == 3
+    assert abs(d["min"] - c) < 1e-12
+    per = [(f - h) / denom for h, f in pairs]
+    assert abs(d["median"] - sorted(per)[1]) < 1e-12
+    assert abs(d["spread"] - (max(per) - min(per))) < 1e-12
+
+
+def test_throughput_stats_converts_distribution():
+    bench = _bench()
+    d = {"marginal_seconds_per_epoch": 0.01, "observations": 2,
+         "per_observation": [0.01, 0.02], "min": 0.01, "median": 0.015,
+         "spread": 0.01}
+    s = bench.throughput_stats(d, samples_per_epoch=100.0)
+    assert s["value"] == 10000.0
+    assert s["min"] == 5000.0  # slowest observation
+    assert s["median"] == 7500.0
+    assert s["spread"] == 5000.0
+
+
+def test_marginal_distribution_contended_excluded_and_unreliable_gated():
+    """A contended observation (full <= half) is recorded verbatim, counted,
+    and EXCLUDED from min/median/spread; when even the endpoint-min estimate
+    is non-positive the record is flagged unreliable and throughput_stats
+    reports value None instead of the 1e-9 clamp's absurd throughput."""
+    bench = _bench()
+    n, c, fetch = 100, 0.010, 4.5
+    half = n // 2
+    # observation 1's half chain ate a 4 s contention hit → negative marginal
+    pairs = [
+        (half * c + fetch + 4.0, n * c + fetch),
+        (half * c + fetch, n * c + fetch + 0.5),
+    ]
+    d = bench.marginal_distribution(pairs, n)
+    assert d["contended"] == 1
+    assert d["per_observation"][0] < 0  # recorded verbatim
+    assert "unreliable" not in d  # endpoint-min still positive (obs 2's half)
+    assert abs(d["min"] - (c + 0.5 / (n - half))) < 1e-12
+    # every half chain contended → endpoint-min non-positive → unreliable
+    bad = [(n * c + fetch + 9.0, n * c + fetch), (n * c + fetch + 9.0, n * c + fetch)]
+    db = bench.marginal_distribution(bad, n)
+    assert db.get("unreliable") is True
+    s = bench.throughput_stats(db, samples_per_epoch=100.0)
+    assert s["value"] is None and s["unreliable"] is True
+
+
+def test_marginal_distribution_pre_full_headline_only():
+    """The calibration full chain feeds the HEADLINE endpoint minimum but is
+    not paired into the distribution (cross-window pairing)."""
+    bench = _bench()
+    n, c, fetch = 100, 0.010, 4.5
+    half = n // 2
+    pairs = [(half * c + fetch, n * c + fetch + 2.0)] * 2  # both fulls contended
+    clean_full = n * c + fetch
+    d = bench.marginal_distribution(pairs, n, pre_full=clean_full)
+    assert abs(d["marginal_seconds_per_epoch"] - c) < 1e-12  # pre_full won
+    assert d["observations"] == 2  # pre_full did NOT become an observation
+    assert all(v > c for v in d["per_observation"])
+
+
+def test_interleaved_ab_pairs_and_alternates_arm_order():
+    """Every arm gets N (half, full) pairs, and within each observation round
+    the arms are timed adjacently with the order alternating between rounds
+    (the contention-fairness property the A/B recipe depends on)."""
+    bench = _bench()
+    calls = []
+
+    def mk(name, c):
+        def run(k):
+            calls.append((name, k))
+            return k * c + 1.0
+        return run
+
+    out = bench.interleaved_ab({"a": mk("a", 0.01), "b": mk("b", 0.03)},
+                               n=10, obs=3)
+    assert abs(out["a"]["marginal_seconds_per_epoch"] - 0.01) < 1e-12
+    assert abs(out["b"]["marginal_seconds_per_epoch"] - 0.03) < 1e-12
+    assert out["a"]["observations"] == out["b"]["observations"] == 3
+    # 2 arms × 3 rounds × (half + full) = 12 calls; round order alternates
+    assert len(calls) == 12
+    first_round = [c[0] for c in calls[:2]]
+    second_round = [c[0] for c in calls[4:6]]
+    assert first_round == ["a", "b"] and second_round == ["b", "a"]
+
+
 def test_flops_per_sample_matches_hand_count():
     """The MFU denominator, pinned against an INDEPENDENT hand count (not
     the module's own formula) for the flagship dims: 98 windows, encoder

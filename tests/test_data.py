@@ -1,5 +1,7 @@
 """Data layer tests against the reference's real fixture tree."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -21,6 +23,10 @@ from dinunet_implementations_tpu.data import (
 from dinunet_implementations_tpu.data.api import SiteArrays
 
 FSL = "/root/reference/datasets/test_fsl/input"
+
+needs_reference = pytest.mark.skipif(
+    not os.path.isdir(FSL), reason="reference fixture not mounted"
+)
 SITE_SIZES = {0: 73, 1: 50, 2: 100, 3: 80, 4: 120}
 
 
@@ -36,6 +42,7 @@ def _fs_state(site):
     return {"baseDirectory": f"{FSL}/local{site}/simulatorRun"}
 
 
+@needs_reference
 def test_fs_handle_lists_covariate_index():
     h = FSVDataHandle(cache=_fs_cache(0), state=_fs_state(0))
     files = h.list_files()
@@ -44,6 +51,7 @@ def test_fs_handle_lists_covariate_index():
 
 
 @pytest.mark.parametrize("site", [0, 1])
+@needs_reference
 def test_fs_dataset_materializes(site):
     ds = build_site_dataset(FreeSurferDataset, FSVDataHandle, _fs_cache(site), _fs_state(site))
     assert len(ds) == SITE_SIZES[site]

@@ -5,7 +5,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from dinunet_implementations_tpu.core.jaxcompat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from dinunet_implementations_tpu.engines import (
@@ -275,6 +275,172 @@ def test_subspace_iteration_multi_matches_solo():
         # orthonormality of the lockstep result
         np.testing.assert_allclose(np.asarray(Pm.T @ Pm), np.eye(6),
                                    atol=1e-4)
+
+
+def test_rankdad_warm_start_round1_identical_to_cold():
+    """At init the warm-start state holds the cold-start default Ω draw
+    (lowrank.default_omega), so the FIRST aggregate round is identical with
+    warm starts on or off."""
+    tree, w = _tree(8), _weights()
+    kw = dict(dad_reduction_rank=3, dad_num_pow_iters=3, dad_tol=1e-3)
+    warm = _run_engine("rankDAD", tree, w, dad_warm_start=True, **kw)
+    cold = _run_engine("rankDAD", tree, w, dad_warm_start=False, **kw)
+    jax.tree.map(
+        lambda a, e: np.testing.assert_allclose(a, e, atol=1e-6), warm, cold
+    )
+
+
+def _run_engine_rounds(name, trees, w, **cfg):
+    """Run several aggregate rounds threading the engine state; returns the
+    per-round aggregates (list of trees)."""
+    mesh = host_mesh(S)
+    eng = make_engine(name, **cfg)
+    state0 = eng.init(jax.tree.map(lambda g: g[0], trees[0]))
+
+    def fn(w_all, *gs):
+        st = state0
+        outs = []
+        for g in gs:
+            g = jax.tree.map(lambda x: x[0], g)
+            agg, st = eng.aggregate(g, st, w_all[0], SITE_AXIS)
+            outs.append(jax.tree.map(lambda x: x[None], agg))
+        return tuple(outs)
+
+    spec = jax.tree.map(lambda _: P(SITE_AXIS), trees[0])
+    outs = shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(SITE_AXIS),) + (spec,) * len(trees),
+        out_specs=(spec,) * len(trees),
+    )(w, *trees)
+    return [jax.tree.map(lambda x: np.asarray(x[0]), o) for o in outs]
+
+
+def _gapped_tree(seed, m=12, n=8, r=4, gap=1e-3):
+    """Per-site matrices with a CLEAN spectral gap after σ_r, so the rank-r
+    subspace is well-conditioned and the power iteration actually converges
+    within the iteration budget (a random Gaussian's σ_r ≈ σ_{r+1} makes the
+    truncated subspace ill-conditioned — convergence rate (σ_{r+1}/σ_r)^k)."""
+    rng = np.random.default_rng(seed)
+    spec = np.array([1.0, 0.7, 0.5, 0.3] + [gap] * (min(m, n) - r), np.float32)
+    mats = []
+    for _ in range(S):
+        U, _ = np.linalg.qr(rng.normal(size=(m, len(spec))))
+        V, _ = np.linalg.qr(rng.normal(size=(n, len(spec))))
+        mats.append((U * spec) @ V.T)
+    return {"k": jnp.asarray(np.stack(mats).astype(np.float32))}
+
+
+@pytest.mark.slow
+def test_rankdad_warm_start_converged_parity_with_cold():
+    """Acceptance (r6): at dad_num_pow_iters high enough to converge, a
+    warm-started round-2 aggregate equals the cold-start round-2 aggregate —
+    the warm Ω changes the ITERATE, not the converged subspace."""
+    trees = [_gapped_tree(9), _gapped_tree(10)]
+    w = _weights()
+    kw = dict(dad_reduction_rank=4, dad_num_pow_iters=25, dad_tol=1e-9)
+    warm = _run_engine_rounds("rankDAD", trees, w, dad_warm_start=True, **kw)
+    cold = _run_engine_rounds("rankDAD", trees, w, dad_warm_start=False, **kw)
+    for a, e in zip(warm, cold):
+        jax.tree.map(
+            lambda x, y: np.testing.assert_allclose(x, y, atol=1e-4), a, e
+        )
+
+
+def test_rankdad_warm_state_roundtrips_epoch_scan():
+    """Acceptance (r6): the warm-start Ω must round-trip through the jitted
+    epoch scan exactly like powerSGD's Q/error-feedback — per-site leaves,
+    updated every round, finite — and a second epoch must consume the state
+    the first one produced."""
+    import jax.numpy as jnp
+
+    from dinunet_implementations_tpu.models import MSANNet
+    from dinunet_implementations_tpu.trainer import (
+        FederatedTask,
+        init_train_state,
+        make_optimizer,
+        make_train_epoch_fn,
+    )
+
+    model = MSANNet(in_size=6, hidden_sizes=(8,), out_size=2)
+    task = FederatedTask(model)
+    eng = make_engine("rankDAD", dad_reduction_rank=3, dad_num_pow_iters=3,
+                      dad_tol=1e-3)
+    opt = make_optimizer("adam", 1e-2)
+    rng = np.random.default_rng(0)
+    Ssites = 3
+    x = jnp.asarray(rng.normal(size=(Ssites, 4, 4, 6)).astype(np.float32))
+    y = jnp.asarray((rng.random((Ssites, 4, 4)) > 0.5).astype(np.int32))
+    w = jnp.ones((Ssites, 4, 4), jnp.float32)
+    state = init_train_state(task, eng, opt, jax.random.PRNGKey(0), x[0, 0],
+                             num_sites=Ssites)
+    om0 = [np.asarray(o) for o in jax.tree.leaves(state.engine_state["omega"])]
+    # per-site leading axis, like powerSGD's q/e
+    assert all(o.shape[0] == Ssites for o in om0)
+    epoch_fn = make_train_epoch_fn(task, eng, opt, mesh=None, local_iterations=2)
+    state1, losses1 = epoch_fn(state, x, y, w)
+    om1 = [np.asarray(o) for o in jax.tree.leaves(state1.engine_state["omega"])]
+    assert all(np.isfinite(o).all() for o in om1)
+    # the scan must actually UPDATE the warm state (Ω ← Q ≠ the random init)
+    assert any(not np.allclose(a, b) for a, b in zip(om0, om1))
+    state2, losses2 = epoch_fn(state1, x, y, w)
+    assert np.isfinite(np.asarray(losses2)).all()
+
+
+def test_rankdad_mixed_precision_iteration_close_to_f32():
+    """precision_bits="16" runs the big power-iteration matmuls in bf16 with
+    f32 accumulation — the aggregate must track the f32 engine within bf16
+    noise (relative Frobenius error, not bitwise)."""
+    tree, w = _tree(12), _weights()
+    kw = dict(dad_reduction_rank=8, dad_num_pow_iters=20, dad_tol=1e-9)
+    f32 = _run_engine("rankDAD", tree, w, precision_bits="32", **kw)
+    b16 = _run_engine("rankDAD", tree, w, precision_bits="16", **kw)
+
+    def rel(a, b):
+        return np.linalg.norm(a - b) / max(np.linalg.norm(b), 1e-12)
+
+    errs = jax.tree.leaves(jax.tree.map(rel, b16, f32))
+    assert max(errs) < 0.05, f"bf16 iteration drifted: {errs}"
+
+
+def test_subspace_iteration_grouped_mixed_ranks_matches_per_group():
+    """One shared while_loop over several rank classes must reproduce the
+    per-group results (the rank classes were previously separate while_loops,
+    which XLA serializes — audit r6)."""
+    from dinunet_implementations_tpu.engines.lowrank import (
+        subspace_iteration_grouped,
+        subspace_iteration_multi,
+    )
+
+    rng = np.random.default_rng(21)
+    g1 = [jnp.asarray(rng.normal(size=(24, 16)).astype(np.float32)),
+          jnp.asarray(rng.normal(size=(40, 12)).astype(np.float32))]
+    g2 = [jnp.asarray(rng.normal(size=(30, 3)).astype(np.float32))]
+    grouped = subspace_iteration_grouped(
+        [(g1, 6, None), (g2, 6, None)], 8, 1e-4
+    )
+    solo1 = subspace_iteration_multi(g1, 6, 8, 1e-4)
+    solo2 = subspace_iteration_multi(g2, 6, 8, 1e-4)
+    for (Pg, Qg), (Ps, Qs_) in zip(grouped[0] + grouped[1], solo1 + solo2):
+        np.testing.assert_allclose(
+            np.asarray(Pg @ Qg.T), np.asarray(Ps @ Qs_.T), atol=1e-4
+        )
+
+
+def test_rankdad_zero_gradient_round_recovers():
+    """A zero gradient zeroes the stored Ω; the next round's CholeskyQR
+    fallback re-seeds from canonical basis vectors, so the subspace must
+    recover as soon as the gradient returns."""
+    rng = np.random.default_rng(22)
+    zero = {"k": jnp.zeros((S, 12, 8), jnp.float32)}
+    live = {"k": jnp.asarray(rng.normal(size=(S, 12, 8)).astype(np.float32))}
+    w = _weights()
+    kw = dict(dad_reduction_rank=8, dad_num_pow_iters=25, dad_tol=1e-9)
+    out_zero, out_live = _run_engine_rounds(
+        "rankDAD", [zero, live], w, dad_warm_start=True, **kw
+    )
+    np.testing.assert_allclose(out_zero["k"], np.zeros((12, 8)), atol=1e-7)
+    expect = _pooled(live, w)
+    np.testing.assert_allclose(out_live["k"], expect["k"], atol=1e-4)
 
 
 @pytest.mark.slow

@@ -7,6 +7,8 @@ run against the one-site-per-device run and the all-on-one-device vmap run —
 all three must produce identical training (SGD, so the assert is tight).
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -22,6 +24,11 @@ from dinunet_implementations_tpu.trainer import (
     make_train_epoch_fn,
 )
 from dinunet_implementations_tpu.trainer.steps import make_eval_fn
+
+needs_reference = pytest.mark.skipif(
+    not os.path.isdir("/root/reference/datasets/test_fsl"),
+    reason="reference fixture not mounted",
+)
 
 
 def _data(S=4, steps=3, B=6, F=10, seed=0):
@@ -117,6 +124,7 @@ def test_folded_eval_matches_per_device():
 
 
 @pytest.mark.slow
+@needs_reference
 def test_fed_runner_sites_per_device(tmp_path):
     """cfg.sites_per_device=5 folds the 5-site FS fixture onto a 1-device
     site mesh; results still come out per site."""
@@ -137,6 +145,7 @@ def test_fed_runner_sites_per_device(tmp_path):
     assert np.isfinite(results[0]["test_metrics"][0][0])
 
 
+@needs_reference
 def test_fed_runner_rejects_nondivisible_fold(tmp_path):
     from dinunet_implementations_tpu.core.config import TrainConfig
     from dinunet_implementations_tpu.runner.fed_runner import FedRunner

@@ -8,6 +8,7 @@ re-design preserves reference semantics.
 """
 
 import importlib.util
+import os
 
 import jax
 import jax.numpy as jnp
@@ -18,7 +19,14 @@ import torch
 from dinunet_implementations_tpu.models import ICALstm, LSTMCell, MSANNet
 
 
+needs_reference = pytest.mark.skipif(
+    not os.path.isdir("/root/reference"), reason="reference tree not mounted"
+)
+
+
 def _load_ref(name, path):
+    if not os.path.exists(path):
+        return None  # guarded: every user is @needs_reference-marked
     spec = importlib.util.spec_from_file_location(name, path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
@@ -48,6 +56,7 @@ def _msannet_params_from_torch(tm):
     return {"params": params}
 
 
+@needs_reference
 def test_msannet_matches_torch():
     torch.manual_seed(0)
     tm = ref_fs.MSANNet(in_size=66, hidden_sizes=[256, 128, 64, 32], out_size=2)
@@ -102,6 +111,7 @@ def _lstm_cell_params_from_torch(tc):
 
 
 @pytest.mark.parametrize("T,H,D", [(7, 12, 9)])
+@needs_reference
 def test_lstm_cell_matches_reference_double_sigmoid(T, H, D):
     """Our double_sigmoid_gates=True reproduces the reference cell bit-for-bit
     (incl. the i/f/o double-sigmoid quirk, comps/icalstm/models.py:31-38)."""
@@ -154,6 +164,7 @@ def _icalstm_params_from_torch(tm):
     return {"params": p, "batch_stats": stats}
 
 
+@needs_reference
 def test_icalstm_matches_torch_eval():
     """Full-model eval parity (dropout off, BN running stats) with the
     double-sigmoid quirk enabled."""

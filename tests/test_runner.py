@@ -19,7 +19,12 @@ from dinunet_implementations_tpu.runner import (
 
 FSL = "/root/reference/datasets/test_fsl"
 
+needs_reference = pytest.mark.skipif(
+    not os.path.isdir(FSL), reason="reference fixture not mounted"
+)
 
+
+@needs_reference
 def test_discover_site_dirs_ordering():
     dirs = discover_site_dirs(FSL)
     assert len(dirs) == 5
@@ -34,6 +39,7 @@ def test_get_task_dispatch_parity():
 
 
 @pytest.mark.slow
+@needs_reference
 def test_fed_runner_fixture_end_to_end(tmp_path):
     cfg = TrainConfig(epochs=4, patience=10, split_ratio=(0.7, 0.15, 0.15))
     r = FedRunner(cfg, data_path=FSL, out_dir=str(tmp_path))
@@ -71,6 +77,7 @@ def test_fed_runner_fixture_end_to_end(tmp_path):
 
 
 @pytest.mark.slow
+@needs_reference
 def test_fed_runner_vmap_fold_mode(tmp_path):
     cfg = TrainConfig(epochs=2, split_ratio=(0.7, 0.15, 0.15))
     r = FedRunner(cfg, data_path=FSL, out_dir=str(tmp_path), mesh=None)
@@ -78,6 +85,7 @@ def test_fed_runner_vmap_fold_mode(tmp_path):
     assert 0 <= res["test_metrics"][0][1] <= 1
 
 
+@needs_reference
 def test_site_runner_parity_signature(tmp_path):
     """Reference call shape: SiteRunner(taks_id='FSL', data_path=..., mode='Train',
     split_ratio=[...]).run(Trainer, Dataset, Handle) — comps/fs/site_run.py:5-6."""
@@ -171,6 +179,7 @@ def test_ica_site_runner_reference_signature(tmp_path):
 
 
 @pytest.mark.slow
+@needs_reference
 def test_fed_runner_kfold(tmp_path):
     cfg = TrainConfig(epochs=2, num_folds=3)
     r = FedRunner(cfg, data_path=FSL, out_dir=str(tmp_path))
@@ -180,6 +189,7 @@ def test_fed_runner_kfold(tmp_path):
 
 
 @pytest.mark.slow
+@needs_reference
 def test_fed_runner_mode_test_roundtrip(tmp_path):
     """Train once, then a mode='test' run on the same output tree reproduces
     the stored test metrics without training (compspec mode field)."""
@@ -192,6 +202,7 @@ def test_fed_runner_mode_test_roundtrip(tmp_path):
     assert res_test["test_metrics"] == res_train["test_metrics"]
 
 
+@needs_reference
 def test_fed_runner_explicit_fold_ids_write_correct_dirs(tmp_path):
     """run(folds=[1]) must write fold_1 (not remap to fold_0)."""
     cfg = TrainConfig(epochs=1, num_folds=3)
@@ -202,6 +213,7 @@ def test_fed_runner_explicit_fold_ids_write_correct_dirs(tmp_path):
 
 
 @pytest.mark.slow
+@needs_reference
 def test_fed_runner_kfold_k2_empty_validation(tmp_path):
     """kfold k==2 has no validation fold by design (splits.py:41-45): fit
     must skip validation-based selection (final state selected, no early

@@ -266,6 +266,71 @@ def test_trainer_fit_learns_and_stops():
     assert res["stopped_epoch"] <= 40
 
 
+def test_checkpoint_engine_state_structure_change_resumes():
+    """r6 regression (review finding): a checkpoint saved under a different
+    engine-state structure (e.g. rankDAD before warm starts existed, or
+    dad_warm_start flipped between save and resume) must still resume —
+    params/optimizer exactly, engine state falling back to fresh init."""
+    import os
+
+    from dinunet_implementations_tpu.trainer import make_train_epoch_fn
+
+    model = MSANNet(in_size=6, hidden_sizes=(8,), out_size=2)
+    task = FederatedTask(model)
+    opt = make_optimizer("adam", 1e-2)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 2, 4, 6)).astype(np.float32))
+    cold = make_engine("rankDAD", dad_warm_start=False)
+    st_cold = init_train_state(task, cold, opt, jax.random.PRNGKey(0), x[0, 0],
+                               num_sites=2)
+    path = "/tmp/_ckpt_structchange.msgpack"
+    save_checkpoint(path, st_cold, meta={"epoch": 3})
+    warm = make_engine("rankDAD", dad_warm_start=True)
+    st_warm = init_train_state(task, warm, opt, jax.random.PRNGKey(1), x[0, 0],
+                               num_sites=2)
+    restored, meta = load_checkpoint(path, st_warm, with_meta=True)
+    assert meta["epoch"] == 3
+    # params resumed from the checkpoint, engine state fell back to fresh warm
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        restored.params, st_cold.params,
+    )
+    assert "omega" in restored.engine_state
+    os.remove(path)
+
+
+def test_batch_size_clamp_stays_local_to_the_fold():
+    """ADVICE regression (r5): a fold whose smallest site forces the
+    batch-size clamp must NOT mutate the trainer's shared config — the next
+    fold (or any cfg reuse) gets the original batch size back."""
+    cfg = TrainConfig(epochs=1, batch_size=16, validation_epochs=1)
+    model = MSANNet(in_size=6, hidden_sizes=(8,), out_size=2)
+    tr = FederatedTrainer(cfg, model, host_mesh(2))
+    # smallest train split (6) < batch_size (16) → the clamp fires
+    res = tr.fit(_toy_sites(2, n=6), _toy_sites(2, n=4), _toy_sites(2, n=4),
+                 verbose=False)
+    assert np.isfinite(res["epoch_losses"]).all()
+    assert tr.cfg.batch_size == 16, "clamp leaked into the shared config"
+    assert cfg.batch_size == 16
+
+
+def test_rounds_scan_xs_reachable_from_config():
+    """ADVICE regression (r5): TrainConfig.rounds_scan_xs must reach the
+    compiled epoch (the peak-HBM escape hatch documented in
+    trainer/steps.py) — both arms train and agree through the Trainer."""
+    outs = {}
+    for flag in (True, False):
+        cfg = TrainConfig(epochs=2, batch_size=8, rounds_scan_xs=flag)
+        model = MSANNet(in_size=6, hidden_sizes=(8,), out_size=2)
+        tr = FederatedTrainer(cfg, model, host_mesh(2))
+        res = tr.fit(_toy_sites(2), _toy_sites(2, n=16), _toy_sites(2, n=16),
+                     verbose=False)
+        outs[flag] = res
+    np.testing.assert_allclose(
+        outs[True]["epoch_losses"], outs[False]["epoch_losses"], rtol=1e-6
+    )
+
+
 def test_trainer_early_stop_on_patience():
     # lr=0 → metric never improves after first validation → stops at patience
     cfg = TrainConfig(epochs=50, patience=3, batch_size=8, learning_rate=0.0)
