@@ -60,19 +60,21 @@ ENC_IN, ENC_OUT, HIDDEN = COMPS * WLEN, 256, 348
 V5E_BF16_PEAK_FLOPS = 197e12
 
 
-def chain_epochs(epoch_fn, state0, x, y, w, n: int) -> float:
+def chain_epochs(epoch_fn, state0, x, y, w, n: int, live=None) -> float:
     """Run ``n`` chained epochs from ``state0`` and FULLY materialize the
     final state (np.asarray over every leaf) — the only synchronization the
     lazy tunneled backend honors. Returns wall-clock seconds. This is the
     shared measurement primitive for bench.py and bench_matrix.py; any
-    methodology fix belongs here, once."""
+    methodology fix belongs here, once. ``live`` is the optional ``[S,
+    rounds]`` liveness mask (``--faults``): the same device array feeds every
+    epoch (throughput of the masked program, not of a changing schedule)."""
     import jax
     import numpy as np
 
     s = state0
     t0 = time.time()
     for _ in range(n):
-        s, _ = epoch_fn(s, x, y, w)
+        s, _ = epoch_fn(s, x, y, w) if live is None else epoch_fn(s, x, y, w, live)
     jax.tree.map(np.asarray, s)
     return time.time() - t0
 
@@ -210,13 +212,16 @@ def flops_per_sample() -> float:
 
 
 def _setup_epoch(engine_name: str = "dSGD", engine_kw: dict | None = None,
-                 fused_bidir: bool | None = None, dims: dict | None = None):
+                 fused_bidir: bool | None = None, dims: dict | None = None,
+                 fault_plan=None):
     """Build the compiled flagship epoch for one bench arm.
 
     Returns ``(run_chain, samples_per_epoch)``: ``run_chain(k)`` times a
     k-epoch fully-materialized chain (compile happens on the first call —
     call ``run_chain(1)`` once to warm up before timing). ``dims`` overrides
-    the flagship model/data dims (``--small`` harness-validation mode)."""
+    the flagship model/data dims (``--small`` harness-validation mode).
+    ``fault_plan`` (a robustness.FaultPlan) measures the fault-masked round:
+    its epoch-0 liveness mask feeds every chained epoch."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -266,20 +271,26 @@ def _setup_epoch(engine_name: str = "dSGD", engine_kw: dict | None = None,
         task, engine, opt, jax.random.PRNGKey(0), x[0, 0], num_sites=S
     )
     epoch_fn = make_train_epoch_fn(task, engine, opt, mesh=None, local_iterations=1)
+    live = None
+    if fault_plan is not None and fault_plan.injects_faults():
+        # rounds == steps at local_iterations=1; the first epoch's window
+        live = jnp.asarray(fault_plan.liveness(S, 0, steps))
     # resident epoch inputs live in the layout the executable wants (the
     # per-epoch on-device relayout copy moves into this one-time device_put)
-    epoch_fn, put_x = compile_epoch_aot(epoch_fn, state0, x, y, w)
+    epoch_fn, put_x = compile_epoch_aot(epoch_fn, state0, x, y, w, live=live)
     x = put_x(x)
 
     def run_chain(k: int) -> float:
-        return chain_epochs(epoch_fn, state0, x, y, w, k)
+        return chain_epochs(epoch_fn, state0, x, y, w, k, live=live)
 
     return run_chain, S * steps * B
 
 
 def measure_tpu(fused_bidir: bool | None = None, repeats: int = 5,
-                with_distribution: bool = False):
-    run_chain, samples = _setup_epoch(fused_bidir=fused_bidir)
+                with_distribution: bool = False, fault_plan=None,
+                dims: dict | None = None):
+    run_chain, samples = _setup_epoch(fused_bidir=fused_bidir,
+                                      fault_plan=fault_plan, dims=dims)
     run_chain(1)  # compile + lazy-runtime warmup
     # N paired observations per endpoint: contended windows last minutes, so
     # more samples raise the odds of catching an uncontended one; the pairs
@@ -405,6 +416,36 @@ def main():
         dims = SMALL_DIMS if "--small" in sys.argv else None
         for rec in measure_rankdad_ab(obs=obs, n=n, dims=dims):
             print(json.dumps(rec), flush=True)
+        return
+    if "--faults" in sys.argv:
+        # fault-masked federated round throughput: same flagship epoch with a
+        # FaultPlan's liveness mask threaded through the engines (the masking
+        # overhead is the claim under test — the program is identical for any
+        # mask, so one measurement covers every fault pattern of this shape)
+        from dinunet_implementations_tpu.robustness import parse_fault_plan
+
+        plan = parse_fault_plan(sys.argv[sys.argv.index("--faults") + 1])
+        dims = SMALL_DIMS if "--small" in sys.argv else None
+        value, stats = measure_tpu(with_distribution=True, fault_plan=plan,
+                                   dims=dims)
+        sites = (dims or {}).get("sites", NUM_SITES)
+        live = plan.liveness(sites, 0, (dims or {}).get("steps", STEPS_PER_EPOCH))
+        rec = {
+            "metric": "samples/sec/chip (ICA-LSTM federated round, fault-masked)",
+            "value": value,
+            "unit": "samples/sec/chip",
+            "samples_per_sec": stats,
+            "faults": plan.to_json(),
+            "dead_site_rounds": int((live == 0).sum()),
+        }
+        if dims:
+            # --small: record the dims, omit vs_baseline — the CPU baseline
+            # is the FLAGSHIP config's, and a toy-dims ratio would masquerade
+            # as a real number (same policy as --ab-rankdad)
+            rec["dims"] = dims
+        elif value is not None:
+            rec["vs_baseline"] = round(value / baseline, 2)
+        print(json.dumps(rec))
         return
     if "--ab-bidir" in sys.argv:
         # A/B the fused bidirectional pooled kernel against two

@@ -40,4 +40,8 @@ def __getattr__(name):
         from .trainer.loop import FederatedTrainer
 
         return FederatedTrainer
+    if name in ("FaultPlan", "Preempted", "PreemptionGuard", "with_retry"):
+        from . import robustness
+
+        return getattr(robustness, name)
     raise AttributeError(name)

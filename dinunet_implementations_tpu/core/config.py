@@ -264,6 +264,14 @@ class TrainConfig:
     # write a TensorBoard-compatible device trace there (SURVEY.md §5: the
     # reference only has wall-clock duration lists; this is the TPU upgrade)
     profile_dir: str = ""
+    # fault tolerance (robustness/): a site whose round gradient is
+    # non-finite for this many CONSECUTIVE rounds is quarantined — zero
+    # weight for the rest of the fit, params advance on the live sites'
+    # aggregate. 0 keeps the per-round non-finite skip but never quarantines;
+    # -1 statically compiles the whole fault machinery out of the epoch
+    # program (exact pre-robustness program; liveness masks still work when a
+    # FaultPlan is given).
+    quarantine_rounds: int = 3
 
     # -- helpers ---------------------------------------------------------
 

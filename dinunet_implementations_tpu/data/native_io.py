@@ -15,8 +15,14 @@ import ctypes
 
 import numpy as np
 
+from ..robustness.retry import with_retry
+
 _lib = None
 _tried = False
+
+
+class NativeReadError(OSError):
+    """The native batch reader reported a failure (rc != 0)."""
 
 
 def _load():
@@ -36,12 +42,14 @@ def _load():
     return _lib
 
 
-def read_aseg_batch(paths: list[str], n_feats: int) -> np.ndarray | None:
-    """Parse ``paths`` into a ``[len(paths), n_feats]`` float32 matrix, or
-    ``None`` when the native path is unavailable or any file fails."""
-    lib = _load()
-    if lib is None or not paths or n_feats <= 0:
-        return None
+# Shared-filesystem reads (the deployment target: site data on NFS/GCS-fuse)
+# fail transiently under load; retry the whole batch read briefly before
+# falling back to the Python reader. Malformed-file failures are deterministic
+# and burn two short sleeps — an accepted cost for not classifying the native
+# error string.
+@with_retry(attempts=3, base_delay=0.05, max_delay=0.5,
+            retry_on=(NativeReadError,), describe="native aseg batch read")
+def _read_batch_native(lib, paths: list[str], n_feats: int) -> np.ndarray:
     enc = [p.encode() for p in paths]
     arr = (ctypes.c_char_p * len(enc))(*enc)
     out = np.empty((len(paths), n_feats), np.float32)
@@ -52,11 +60,23 @@ def read_aseg_batch(paths: list[str], n_feats: int) -> np.ndarray | None:
         errbuf, len(errbuf),
     )
     if rc != 0:
+        raise NativeReadError(errbuf.value.decode(errors="replace"))
+    return out
+
+
+def read_aseg_batch(paths: list[str], n_feats: int) -> np.ndarray | None:
+    """Parse ``paths`` into a ``[len(paths), n_feats]`` float32 matrix, or
+    ``None`` when the native path is unavailable or any file fails (after
+    the transient-failure retries)."""
+    lib = _load()
+    if lib is None or not paths or n_feats <= 0:
+        return None
+    try:
+        return _read_batch_native(lib, paths, n_feats)
+    except NativeReadError as e:
         import logging
 
         logging.getLogger(__name__).warning(
-            "native aseg parse failed (%s); falling back to the Python reader",
-            errbuf.value.decode(errors="replace"),
+            "native aseg parse failed (%s); falling back to the Python reader", e
         )
         return None
-    return out

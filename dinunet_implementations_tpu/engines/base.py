@@ -7,13 +7,19 @@ it inside the remote aggregator across ``num_reducers`` worker processes
 
 - ``init(grads) -> state`` — per-site engine state pytree (zeros; lives in
   the training state alongside optimizer state);
-- ``aggregate(grads, state, weight, axis_name) -> (agg_grads, new_state)`` —
-  maps per-site gradients to the globally-aggregated gradient via collectives
-  over the ``site`` mesh axis. ``weight`` is the site's example count for this
-  round (heterogeneous sites), so dSGD == pooled SGD.
+- ``aggregate(grads, state, weight, axis_name, live=None) -> (agg_grads,
+  new_state)`` — maps per-site gradients to the globally-aggregated gradient
+  via collectives over the ``site`` mesh axis. ``weight`` is the site's
+  example count for this round (heterogeneous sites), so dSGD == pooled SGD.
+  ``live`` is the per-round liveness mask scalar (robustness/): 0 for a site
+  that is dropped, non-finite, or quarantined this round — the engine zeroes
+  that site's payload (``jnp.where``, NOT multiplication: the gradient may be
+  NaN) and its weight, and the weighted mean renormalizes over live weight
+  only (``site_weight_scale``). ``live=None`` keeps legacy all-live behavior.
 
 Engines must be shape/dtype-preserving on the gradient pytree and jit-safe
-(static control flow only).
+(static control flow only; the liveness mask is a traced value, so a changing
+fault pattern never recompiles).
 """
 
 from __future__ import annotations
@@ -21,7 +27,25 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Callable
 
+import jax
+import jax.numpy as jnp
+
 from ..core.config import AggEngine
+
+
+def mask_dead_site(grads, weight, live):
+    """Zero a dead site's contribution before any collective.
+
+    ``jnp.where`` (not ``g * live``) because a quarantined site's gradient is
+    typically non-finite and ``NaN * 0 == NaN`` would poison the psum — the
+    exact failure this mask exists to stop. Returns ``(grads, weight)``
+    unchanged when ``live is None``.
+    """
+    if live is None:
+        return grads, weight
+    alive = jnp.asarray(live, jnp.float32) > 0
+    grads = jax.tree.map(lambda g: jnp.where(alive, g, jnp.zeros_like(g)), grads)
+    return grads, weight * alive.astype(jnp.float32)
 
 
 @dataclass(frozen=True)

@@ -11,7 +11,7 @@ accumulating in fp32.
 from __future__ import annotations
 
 from ..parallel.collectives import payload_cast, payload_uncast, site_weighted_mean
-from .base import Engine, register_engine
+from .base import Engine, mask_dead_site, register_engine
 
 
 @register_engine("dSGD")
@@ -19,7 +19,10 @@ def make_dsgd(precision_bits="32", **_unused) -> Engine:
     def init(grads):
         return {}
 
-    def aggregate(grads, state, weight, axis_name):
+    def aggregate(grads, state, weight, axis_name, live=None):
+        # dead/quarantined sites: payload zeroed, weight zeroed — the
+        # weighted mean renormalizes over live weight only (robustness/)
+        grads, weight = mask_dead_site(grads, weight, live)
         payload = payload_cast(grads, precision_bits)
         agg = site_weighted_mean(payload, weight, axis_name)
         return payload_uncast(agg, grads), state

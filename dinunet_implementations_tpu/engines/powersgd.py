@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from ..parallel.collectives import payload_dtype, site_weight_scale, wire_compress
-from .base import Engine, register_engine
+from .base import Engine, mask_dead_site, register_engine
 from .lowrank import (
     from_matrix,
     is_compressible,
@@ -64,7 +64,13 @@ def make_powersgd(
             "e": jax.tree.unflatten(treedef, es),
         }
 
-    def aggregate(grads, state, weight, axis_name):
+    def aggregate(grads, state, weight, axis_name, live=None):
+        # Dead-site round: G zeroed (NaN-safe where) and weight zeroed, so
+        # this site's M = e contributes nothing to the psum'd P/Q' (scale 0)
+        # and the global Ĝ is the live sites' weighted mean. The trainer
+        # freezes a dead site's q/e across the round (trainer/steps.py), so
+        # error feedback resumes where it left off when the site returns.
+        grads, weight = mask_dead_site(grads, weight, live)
         scale = site_weight_scale(weight, axis_name)
 
         # Per leaf, NOT lockstep (unlike rankDAD): powerSGD's error-feedback

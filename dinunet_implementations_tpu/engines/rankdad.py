@@ -45,7 +45,7 @@ from ..parallel.collectives import (
     site_all_gather_packed,
     site_weight_scale,
 )
-from .base import Engine, register_engine
+from .base import Engine, mask_dead_site, register_engine
 from .lowrank import (
     default_omega,
     from_matrix,
@@ -87,7 +87,13 @@ def make_rankdad(
         ]
         return {"omega": jax.tree.unflatten(treedef, oms)}
 
-    def aggregate(grads, state, weight, axis_name):
+    def aggregate(grads, state, weight, axis_name, live=None):
+        # Dead-site round: G zeroed (NaN-safe where) + weight zeroed — the
+        # site still factorizes (same program, no recompile) but its Q·scale
+        # payload is 0, so the gathered reconstruction is the live sites'
+        # weighted mean. Its warm-start Ω is frozen by the trainer for the
+        # round (trainer/steps.py), keeping the subspace for its return.
+        grads, weight = mask_dead_site(grads, weight, live)
         scale = site_weight_scale(weight, axis_name)
         leaves, treedef = jax.tree.flatten(grads)
         omegas = (

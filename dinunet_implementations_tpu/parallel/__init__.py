@@ -6,7 +6,7 @@ from .mesh import (
     replicated,
     site_sharding,
 )
-from .distributed import distributed_init, multihost_site_mesh
+from .distributed import distributed_init, distributed_shutdown, multihost_site_mesh
 from .collectives import (
     payload_cast,
     payload_dtype,

@@ -27,6 +27,7 @@ from __future__ import annotations
 import jax
 import numpy as np
 
+from ..robustness.retry import with_retry
 from .mesh import MODEL_AXIS, SITE_AXIS
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -50,6 +51,10 @@ def distributed_init(
     (TPU pod metadata, SLURM, etc.), so on a real pod this is simply
     ``distributed_init(coordinator_address="host0:1234", num_processes=N,
     process_id=rank)`` or no args at all.
+
+    A worker that comes up before its coordinator (pod rollout races, spot
+    restarts) retries the join under jittered exponential backoff
+    (robustness/retry.py) instead of dying on the first refused connection.
     """
     global _initialized
     if coordinator_address is None and num_processes in (None, 1):
@@ -58,14 +63,89 @@ def distributed_init(
         return True   # here would initialize the backend and make
     # jax.distributed.initialize() below raise ("must be called before any
     # JAX calls"), so idempotency is tracked by module flag only
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        **kwargs,
-    )
+
+    if _jax_distributed_client() is not None:
+        # the runtime was initialized by code OUTSIDE this module (our flag is
+        # False but jax's global client exists): we don't own it, so no retry
+        # and ABSOLUTELY no reset — let jax raise its own clear
+        # "should only be called once" error, exactly as before this wrapper
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kwargs,
+        )
+
+    def _attempt_initialize():
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                **kwargs,
+            )
+        except Exception:
+            # a failed connect leaves jax's module-global client/service SET
+            # (State.initialize assigns self.client before connect() and has
+            # no failure cleanup), so a bare retry would die on "initialize
+            # should only be called once" instead of retrying the join —
+            # clear the partial state first
+            _reset_partial_distributed_state()
+            raise
+
+    with_retry(
+        _attempt_initialize,
+        attempts=3,
+        base_delay=0.5,
+        retry_on=(RuntimeError, OSError, ConnectionError),
+        describe="jax.distributed.initialize",
+    )()
     _initialized = True
     return True
+
+
+def _jax_distributed_client():
+    """jax's module-global distributed client, or None (guarded private-API
+    probe — used only to detect a runtime initialized outside this module)."""
+    state = getattr(getattr(jax, "_src", None), "distributed", None)
+    state = getattr(state, "global_state", None)
+    return getattr(state, "client", None)
+
+
+def _reset_partial_distributed_state() -> None:
+    """Best-effort teardown of a PARTIALLY-initialized jax.distributed state
+    (client constructed, connect failed), so the next initialize attempt
+    starts clean. ``shutdown()`` is the public reset, but it can itself raise
+    on a never-connected client (``client.shutdown()`` precedes ``client =
+    None``); fall back to nulling the global state's handles directly."""
+    try:
+        jax.distributed.shutdown()
+        return
+    except Exception:
+        pass
+    state = getattr(getattr(jax, "_src", None), "distributed", None)
+    state = getattr(state, "global_state", None)
+    if state is not None:
+        for attr in ("client", "service", "preemption_sync_manager"):
+            try:
+                setattr(state, attr, None)
+            except Exception:
+                pass
+
+
+def distributed_shutdown() -> None:
+    """Tear down the multi-host runtime and clear the idempotency flag, so
+    ``distributed_init`` is re-entrant (worker restarts within one process,
+    coordinated test harnesses). A no-op when nothing was initialized."""
+    global _initialized
+    try:
+        if _initialized:
+            jax.distributed.shutdown()
+    finally:
+        # clear the flag even when shutdown() raises (wedged peer, never-
+        # connected client): the runtime is gone either way, and a stale True
+        # would make every later distributed_init a silent no-op
+        _initialized = False
 
 
 def multihost_site_mesh(

@@ -1,0 +1,40 @@
+"""Fault tolerance for federated rounds.
+
+The reference runs one container per hospital site under a coordinator that
+must survive flaky sites and restarts (SURVEY §0 trust topology); DrJAX and
+the Podracer architectures (PAPERS.md) both treat partial participation and
+worker loss as the normal case. This package makes the SPMD round loop match
+that contract:
+
+- :mod:`faults` — :class:`FaultPlan`, a deterministic fault-injection config
+  (site-drop schedule, seeded flaky-site drops, NaN poisoning, kill-at-round)
+  threaded through the trainer loop and data layer so every failure mode has
+  a reproducible chaos test;
+- :mod:`health` — the per-site health state (non-finite streak / skip /
+  quarantine counters) carried through the jitted epoch scan and surfaced in
+  ``logs.json``;
+- :mod:`preemption` — SIGTERM/SIGINT save-and-exit for preemptible workers
+  (:class:`PreemptionGuard`, :class:`Preempted`);
+- :mod:`retry` — jittered exponential backoff for transient failures
+  (``distributed_init``, native IO reads).
+
+The liveness-mask/quarantine math itself lives *inside* the compiled epoch
+(trainer/steps.py + the engines' ``live`` argument): masks are traced array
+inputs, so a different fault pattern never recompiles the program.
+"""
+
+from .faults import FaultPlan, parse_fault_plan, poison_inputs
+from .health import default_health, health_summary
+from .preemption import Preempted, PreemptionGuard
+from .retry import with_retry
+
+__all__ = [
+    "FaultPlan",
+    "Preempted",
+    "PreemptionGuard",
+    "default_health",
+    "health_summary",
+    "parse_fault_plan",
+    "poison_inputs",
+    "with_retry",
+]

@@ -1,0 +1,185 @@
+"""Deterministic fault injection — the chaos harness behind every robustness
+claim in this package.
+
+A :class:`FaultPlan` describes, in *global round* coordinates, which faults a
+run should experience:
+
+- ``drop``: scheduled site outages — ``(site, first_round, last_round)``
+  triples (inclusive; ``last_round = -1`` means "until the end of training").
+  A dropped site is zero-weighted in the round's aggregate (the weighted mean
+  renormalizes over live weight only — trainer/steps.py);
+- ``flaky_prob``/``flaky_seed``: per-(site, round) random drops under a
+  seeded counter-based RNG, so the same plan replays the same outage pattern
+  regardless of epoch chunking or resume point;
+- ``nan_at``: ``(round, site)`` pairs whose *inputs* are poisoned with NaN in
+  the data layer — the gradient then goes non-finite for real and must be
+  caught by the in-jit finiteness check + quarantine counters, not by a
+  shortcut in the test;
+- ``kill_at_round``: simulated preemption — the trainer saves a checkpoint
+  and raises :class:`~.preemption.Preempted` once the global round counter
+  passes this value (the deterministic arm of the SIGTERM handler).
+
+Masks are plain numpy arrays fed to the compiled epoch as traced inputs:
+changing the plan never recompiles the program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+
+def _tuplize(rows, width: int, name: str) -> tuple:
+    out = []
+    for row in rows:
+        row = tuple(int(v) for v in row)
+        if len(row) != width:
+            raise ValueError(
+                f"FaultPlan.{name} entries need {width} integers, got {row!r}"
+            )
+        out.append(row)
+    return tuple(out)
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """Deterministic fault schedule in global-round coordinates."""
+
+    drop: tuple = ()  # (site, first_round, last_round) triples; -1 = forever
+    flaky_prob: float = 0.0
+    flaky_seed: int = 0
+    nan_at: tuple = ()  # (round, site) pairs
+    kill_at_round: int | None = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "drop", _tuplize(self.drop, 3, "drop"))
+        object.__setattr__(self, "nan_at", _tuplize(self.nan_at, 2, "nan_at"))
+        if not 0.0 <= float(self.flaky_prob) <= 1.0:
+            raise ValueError(
+                f"FaultPlan.flaky_prob must be in [0, 1], got {self.flaky_prob}"
+            )
+        for site, first, last in self.drop:
+            if site < 0 or first < 0 or (last != -1 and last < first):
+                raise ValueError(f"bad FaultPlan.drop entry {(site, first, last)}")
+        for rnd, site in self.nan_at:
+            if rnd < 0 or site < 0:
+                raise ValueError(f"bad FaultPlan.nan_at entry {(rnd, site)}")
+
+    # -- round-window mask generation ------------------------------------
+
+    def _flaky_uniform(self, num_sites: int, round_start: int,
+                       num_rounds: int) -> np.ndarray:
+        """Counter-based uniform ``[num_sites, num_rounds]`` draw keyed by
+        (seed, site, GLOBAL round) — a pure vectorized function of the plan
+        (splitmix64 finalizer over per-cell counters), so the outage pattern
+        is independent of epoch chunking / resume point and costs one numpy
+        pass instead of one Generator construction per cell."""
+        seed_term = (int(self.flaky_seed) * 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+        site = np.arange(num_sites, dtype=np.uint64)[:, None]
+        rnd = (np.uint64(round_start) + np.arange(num_rounds, dtype=np.uint64))[None, :]
+        with np.errstate(over="ignore"):  # uint64 wraparound is the point
+            x = (
+                np.uint64(seed_term)
+                + site * np.uint64(0xD1B54A32D192ED03)
+                + rnd * np.uint64(0x8CB92BA72F3D8DD7)
+            )
+            x ^= x >> np.uint64(30)
+            x *= np.uint64(0xBF58476D1CE4E5B9)
+            x ^= x >> np.uint64(27)
+            x *= np.uint64(0x94D049BB133111EB)
+            x ^= x >> np.uint64(31)
+        return (x >> np.uint64(11)).astype(np.float64) * 2.0 ** -53
+
+    def liveness(self, num_sites: int, round_start: int, num_rounds: int) -> np.ndarray:
+        """``[num_sites, num_rounds]`` float32 mask for the round window
+        ``[round_start, round_start + num_rounds)``: 1 = live, 0 = dropped."""
+        live = np.ones((num_sites, num_rounds), np.float32)
+        for site, first, last in self.drop:
+            if site >= num_sites:
+                continue
+            lo = max(first - round_start, 0)
+            hi = num_rounds if last == -1 else min(last + 1 - round_start, num_rounds)
+            if lo < hi:
+                live[site, lo:hi] = 0.0
+        if self.flaky_prob > 0.0:
+            draws = self._flaky_uniform(num_sites, round_start, num_rounds)
+            live[draws < self.flaky_prob] = 0.0
+        return live
+
+    def nan_mask(self, num_sites: int, round_start: int, num_rounds: int) -> np.ndarray:
+        """``[num_sites, num_rounds]`` bool mask of (site, round) cells whose
+        inputs get poisoned with NaN."""
+        mask = np.zeros((num_sites, num_rounds), bool)
+        for rnd, site in self.nan_at:
+            r = rnd - round_start
+            if 0 <= r < num_rounds and site < num_sites:
+                mask[site, r] = True
+        return mask
+
+    def injects_faults(self) -> bool:
+        """True when the plan perturbs training rounds (drops / flaky / NaN) —
+        a kill-only plan needs no per-round masks."""
+        return bool(self.drop) or self.flaky_prob > 0.0 or bool(self.nan_at)
+
+    # -- JSON round-trip (CLI / bench surface) ---------------------------
+
+    def to_json(self) -> dict:
+        return {
+            "drop": [list(t) for t in self.drop],
+            "flaky_prob": self.flaky_prob,
+            "flaky_seed": self.flaky_seed,
+            "nan_at": [list(t) for t in self.nan_at],
+            "kill_at_round": self.kill_at_round,
+        }
+
+    @classmethod
+    def from_json(cls, spec) -> "FaultPlan":
+        """Build from a dict or a JSON string (the CLI/bench flag payload)."""
+        if isinstance(spec, (str, bytes)):
+            spec = json.loads(spec)
+        if not isinstance(spec, dict):
+            raise ValueError(f"FaultPlan spec must be a JSON object, got {type(spec)}")
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(spec) - known
+        if unknown:
+            raise ValueError(
+                f"unknown FaultPlan keys {sorted(unknown)} (have {sorted(known)})"
+            )
+        return cls(**spec)
+
+
+def parse_fault_plan(arg: str | None) -> FaultPlan | None:
+    """Parse the ``--faults`` flag: inline JSON, or ``@path`` to a JSON file."""
+    if not arg:
+        return None
+    if arg.startswith("@"):
+        with open(arg[1:]) as fh:
+            return FaultPlan.from_json(fh.read())
+    if os.path.exists(arg):  # a bare path also works
+        with open(arg) as fh:
+            return FaultPlan.from_json(fh.read())
+    return FaultPlan.from_json(arg)
+
+
+def poison_inputs(inputs: np.ndarray, nan_mask: np.ndarray,
+                  local_iterations: int) -> np.ndarray:
+    """Data-layer NaN injection: overwrite the poisoned (site, round) cells'
+    step blocks with NaN in a copy of the epoch inputs ``[S, steps, B, ...]``.
+
+    Each round spans ``local_iterations`` consecutive steps (the gradient-
+    accumulation block — trainer/steps.py), so the poisoned site's gradient
+    for that round goes non-finite end to end, exercising the real in-jit
+    finiteness check rather than a synthetic gradient override.
+    """
+    if not nan_mask.any():
+        return inputs
+    out = np.array(inputs, copy=True)
+    L = max(int(local_iterations), 1)
+    for site, rnd in zip(*np.nonzero(nan_mask)):
+        lo = rnd * L
+        out[site, lo:lo + L] = np.nan
+    return out

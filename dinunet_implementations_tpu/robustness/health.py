@@ -1,0 +1,47 @@
+"""Per-site health state: the quarantine bookkeeping carried through the
+jitted epoch scan.
+
+Three int32 counters per site, stored in ``TrainState.health`` with a leading
+``[num_sites]`` axis and sharded over the site mesh axis exactly like engine
+state (trainer/steps.py ``_state_specs``):
+
+- ``streak`` — consecutive rounds with a non-finite site gradient; resets to
+  0 the round the gradient comes back finite;
+- ``skips`` — total rounds this site contributed nothing (scheduled drop,
+  non-finite gradient, or quarantine);
+- ``quarantined`` — sticky 0/1 flag, set once ``streak`` reaches the
+  configured threshold (``TrainConfig.quarantine_rounds``). A quarantined
+  site is zero-weighted for the rest of the fit; params keep advancing on the
+  live sites' aggregate.
+
+The counters ride the checkpoint payload, so a resumed run keeps its
+quarantine decisions.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def default_health(num_sites: int) -> dict:
+    """Fresh all-healthy counters with the per-site leading axis."""
+    # jax deferred to the call (trainer paths): robustness/__init__ is
+    # imported by the otherwise jax-free data layer (native_io's retry), and
+    # an eager jax import here would lock in backend config before scripts
+    # like tests/dcn_worker.py get to set platform/device-count knobs
+    import jax.numpy as jnp
+
+    z = jnp.zeros((num_sites,), jnp.int32)
+    return {"streak": z, "skips": z, "quarantined": z}
+
+
+def health_summary(health) -> dict | None:
+    """Host-side summary for results dicts / ``logs.json``: plain int lists,
+    with the log-facing key names."""
+    if health is None:
+        return None
+    return {
+        "site_skipped_rounds": [int(v) for v in np.asarray(health["skips"])],
+        "site_quarantined": [int(v) for v in np.asarray(health["quarantined"])],
+        "site_nonfinite_streak": [int(v) for v in np.asarray(health["streak"])],
+    }

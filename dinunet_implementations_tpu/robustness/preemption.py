@@ -1,0 +1,80 @@
+"""Preemption safety: save-and-exit on SIGTERM/SIGINT.
+
+Preemptible workers (spot TPU VMs, k8s evictions) get a termination signal
+and a grace window. :class:`PreemptionGuard` converts that signal into a flag
+the trainer polls at epoch boundaries — the checkpoint granularity — so the
+in-flight fused epoch dispatch finishes, the rotating checkpoint lands, and
+the process exits cleanly instead of dying mid-write. ``FedRunner.run(
+resume=True)`` then continues bit-exact from the saved boundary.
+
+:class:`Preempted` derives from ``BaseException`` (like ``KeyboardInterrupt``)
+so blanket ``except Exception`` recovery code cannot swallow a shutdown
+request; the CLI catches it explicitly and exits ``128 + signum``.
+"""
+
+from __future__ import annotations
+
+import signal
+
+
+class Preempted(BaseException):
+    """Training was interrupted cooperatively (signal or FaultPlan kill) —
+    state was checkpointed first; resume continues bit-exact."""
+
+    def __init__(self, reason: str, signum: int | None = None,
+                 epoch: int | None = None):
+        super().__init__(reason)
+        self.reason = reason
+        self.signum = signum
+        self.epoch = epoch
+
+    @property
+    def exit_code(self) -> int:
+        # 128+signum is the shell convention for signal deaths; 75 (EX_TEMPFAIL)
+        # for the deterministic FaultPlan kill arm.
+        return 128 + self.signum if self.signum else 75
+
+
+class PreemptionGuard:
+    """Context manager that latches SIGTERM/SIGINT into :attr:`requested`.
+
+    The first signal only sets the flag (the trainer saves and raises
+    :class:`Preempted` at the next epoch boundary). A second SIGINT raises
+    ``KeyboardInterrupt`` immediately so a user hammering ctrl-C is never
+    trapped behind a slow epoch. Outside the main thread (where
+    ``signal.signal`` raises), the guard degrades to an inert no-op.
+    Guards nest: handlers are restored on exit.
+    """
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self.signals = tuple(signals)
+        self._old: dict = {}
+        self._requested: int | None = None
+
+    @property
+    def requested(self) -> int | None:
+        """The latched signal number, or ``None``."""
+        return self._requested
+
+    def _handler(self, signum, frame):
+        if self._requested is not None and signum == signal.SIGINT:
+            raise KeyboardInterrupt
+        self._requested = signum
+
+    def __enter__(self) -> "PreemptionGuard":
+        self._requested = None
+        self._old = {}
+        try:
+            for s in self.signals:
+                self._old[s] = signal.signal(s, self._handler)
+        except ValueError:  # not the main thread — run unguarded
+            for s, h in self._old.items():
+                signal.signal(s, h)
+            self._old = {}
+        return self
+
+    def __exit__(self, *exc):
+        for s, h in self._old.items():
+            signal.signal(s, h)
+        self._old = {}
+        return False

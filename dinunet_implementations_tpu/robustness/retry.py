@@ -1,0 +1,71 @@
+"""Transient-failure retry: jittered exponential backoff.
+
+The reference's coordinator/worker topology tolerates a worker that comes up
+before the coordinator, or an NFS read that fails once under load, by virtue
+of its message-bus retries. Here the equivalents — ``jax.distributed``
+initialization racing the coordinator, native batch-IO reads on shared
+filesystems — get an explicit wrapper:
+
+    @with_retry(attempts=3, base_delay=0.5, retry_on=(RuntimeError, OSError))
+    def connect(): ...
+
+    init = with_retry(jax.distributed.initialize, attempts=3)
+
+Backoff for attempt ``i`` is ``min(base_delay * 2**i, max_delay)`` scaled by
+a jitter factor in ``[0.5, 1.5)`` — jittered so a fleet of workers retrying
+the same dead coordinator doesn't thundering-herd it. Pass ``seed`` for a
+deterministic jitter sequence (tests), and ``sleep`` to observe/skip the
+waits.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import random
+import time
+
+_log = logging.getLogger("dinunet_implementations_tpu.robustness.retry")
+
+
+def with_retry(
+    fn=None,
+    *,
+    attempts: int = 3,
+    base_delay: float = 0.1,
+    max_delay: float = 5.0,
+    retry_on: tuple = (OSError,),
+    seed: int | None = None,
+    sleep=time.sleep,
+    describe: str | None = None,
+):
+    """Wrap ``fn`` (decorator or call form) with jittered exponential backoff.
+
+    Retries only exceptions matching ``retry_on``; anything else propagates
+    immediately. After ``attempts`` failures the last exception propagates.
+    """
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+
+    def deco(f):
+        @functools.wraps(f)
+        def wrapped(*args, **kwargs):
+            rng = random.Random(seed)
+            name = describe or getattr(f, "__name__", repr(f))
+            for attempt in range(attempts):
+                try:
+                    return f(*args, **kwargs)
+                except retry_on as e:
+                    if attempt == attempts - 1:
+                        raise
+                    delay = min(base_delay * (2 ** attempt), max_delay)
+                    delay *= 0.5 + rng.random()  # jitter in [0.5, 1.5)
+                    _log.warning(
+                        "%s failed (attempt %d/%d): %s — retrying in %.2fs",
+                        name, attempt + 1, attempts, e, delay,
+                    )
+                    sleep(delay)
+
+        return wrapped
+
+    return deco if fn is None else deco(fn)

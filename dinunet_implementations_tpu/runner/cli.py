@@ -72,6 +72,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run only these fold indices")
     p.add_argument("--resume", action="store_true",
                    help="resume each fold from its latest checkpoint")
+    p.add_argument("--faults", default=None, metavar="JSON|@FILE",
+                   help="deterministic fault injection (robustness/faults.py "
+                        "FaultPlan): inline JSON or @path — e.g. "
+                        '\'{"drop": [[3, 10, -1]], "nan_at": [[5, 1]], '
+                        '"kill_at_round": 20}\'. Site drops / NaN poisoning / '
+                        "simulated preemption replay identically run to run")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace per fold here")
     p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
@@ -129,11 +135,24 @@ def main(argv: list[str] | None = None) -> int:
             process_id=args.process_id,
         )
 
+    fault_plan = None
+    if args.faults:
+        from ..robustness.faults import parse_fault_plan
+
+        try:
+            fault_plan = parse_fault_plan(args.faults)
+        except (ValueError, OSError, TypeError) as e:
+            raise SystemExit(f"--faults: {e}")
+
     if args.site is not None:
         if args.folds is not None or args.resume:
             raise SystemExit(
                 "--folds/--resume are federated-mode options; "
                 "not supported together with --site"
+            )
+        if fault_plan is not None:
+            raise SystemExit(
+                "--faults targets federated rounds; not supported with --site"
             )
         from .fed_runner import SiteRunner
 
@@ -147,10 +166,24 @@ def main(argv: list[str] | None = None) -> int:
         )
         results = runner.run(verbose=verbose)
     else:
+        from ..robustness.preemption import Preempted
         from .fed_runner import FedRunner
 
-        runner = FedRunner(cfg, data_path=args.data_path, out_dir=args.out_dir)
-        results = runner.run(folds=args.folds, verbose=verbose, resume=args.resume)
+        runner = FedRunner(cfg, data_path=args.data_path, out_dir=args.out_dir,
+                           fault_plan=fault_plan)
+        try:
+            results = runner.run(
+                folds=args.folds, verbose=verbose, resume=args.resume
+            )
+        except Preempted as p:
+            # cooperative shutdown (SIGTERM/SIGINT or FaultPlan kill): state
+            # was checkpointed before the raise — rerun with --resume to
+            # continue bit-exact from the saved epoch boundary
+            print(json.dumps({
+                "preempted": True, "reason": p.reason, "epoch": p.epoch,
+                "resume_with": "--resume",
+            }), file=sys.stderr)
+            return p.exit_code
 
     for k, res in enumerate(results):
         loss, metric = res["test_metrics"][0]

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import glob
 import os
+import re
 
 from ..core.config import TrainConfig, resolve_site_configs
 from ..data.api import build_site_dataset
@@ -24,15 +25,28 @@ from ..trainer.loop import FederatedTrainer
 from .registry import get_task, task_cache
 
 
+def _site_dir_key(path: str):
+    """Numeric-then-lexicographic sort key for a ``local*`` site dir.
+
+    The site number is taken from the ``local*`` path segment ONLY (not the
+    whole path — a digit elsewhere in the tree must not reorder sites), via
+    ``re.search``: mixed trees with a bare ``local`` dir (no digits) or
+    decorated names (``local_backup``, unicode digit lookalikes that
+    ``str.isdigit`` accepts but ``int()`` rejects) sort first instead of
+    crashing the runner. The full path tie-breaks duplicates
+    deterministically.
+    """
+    segment = os.path.basename(os.path.dirname(path))
+    m = re.search(r"([0-9]+)", segment)
+    return (int(m.group(1)) if m else -1, path)
+
+
 def discover_site_dirs(dataset_dir: str) -> list[str]:
     """Reference fixture layout: ``<dataset_dir>/input/local{i}/simulatorRun``
     (``datasets/test_fsl``); falls back to ``dataset_dir`` itself as a single
     site when no local* dirs exist."""
     pattern = os.path.join(dataset_dir, "input", "local*", "simulatorRun")
-    dirs = sorted(
-        glob.glob(pattern),
-        key=lambda p: int("".join(c for c in p.split("local")[-1].split(os.sep)[0] if c.isdigit()) or 0),
-    )
+    dirs = sorted(glob.glob(pattern), key=_site_dir_key)
     return dirs or [dataset_dir]
 
 
@@ -86,10 +100,14 @@ class FedRunner:
         data_path: str = ".",
         out_dir: str | None = None,
         mesh="auto",
+        fault_plan=None,
         **overrides,
     ):
         cfg = (cfg or TrainConfig()).with_overrides(overrides)
         self.data_path = data_path
+        # deterministic chaos injection (robustness/faults.py), threaded into
+        # every fold's trainer; None = no faults
+        self.fault_plan = fault_plan
         self.site_dirs = discover_site_dirs(data_path)
         self.site_cfgs = resolve_site_configs(cfg, data_path, num_sites=len(self.site_dirs))
         # owner-scoped fields come from site 0 (the reference GUI sends one
@@ -150,7 +168,7 @@ class FedRunner:
         for k, fold in zip(fold_ids, all_folds):
             trainer = FederatedTrainer(
                 self.cfg, get_task(self.cfg.task_id).build_model(self.cfg),
-                self.mesh, out_dir=self.out_dir,
+                self.mesh, out_dir=self.out_dir, fault_plan=self.fault_plan,
             )
             res = trainer.fit(
                 fold["train"], fold["validation"], fold["test"], fold=k,

@@ -1,4 +1,9 @@
-from .checkpoint import load_checkpoint, load_params, save_checkpoint
+from .checkpoint import (
+    CorruptCheckpointError,
+    load_checkpoint,
+    load_params,
+    save_checkpoint,
+)
 from .loop import FederatedTrainer
 from .metrics import Averages, ClassificationMetrics, is_improvement
 from .steps import (

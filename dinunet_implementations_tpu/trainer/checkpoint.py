@@ -3,15 +3,27 @@
 The reference's persistence is implicit: cross-round module-level ``CACHE``
 dicts plus library-side best-model files implied by ``best_val_epoch``
 (SURVEY.md §5 checkpoint/resume). Here it is explicit and complete: params +
-batch_stats + optimizer state + engine state + RNG + round counter, serialized
-with flax msgpack. ``save_best``/warm-start covers the reference's
-``pretrain`` largest-site warm start (``compspec.json:120-127``).
+batch_stats + optimizer state + engine state + per-site health counters + RNG
++ round counter, serialized with flax msgpack. ``save_best``/warm-start
+covers the reference's ``pretrain`` largest-site warm start
+(``compspec.json:120-127``).
+
+Durability (robustness, PR 2): every file is framed with a CRC32 payload
+checksum (magic ``DNTCK1``), written via temp-file + ``os.replace``, and —
+with ``rotate=True`` — the previous generation survives as ``<path>.prev``.
+A load that hits a torn/corrupt/missing file (checksum mismatch, short read,
+bad msgpack) falls back to ``.prev`` automatically, so a worker killed at
+ANY instant leaves a loadable resume point. Unframed (pre-0.3) checkpoints
+still load: the magic cannot collide with a msgpack map header.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import struct
+import warnings
+import zlib
 from typing import Any
 
 import flax.serialization
@@ -19,6 +31,13 @@ import jax
 import jax.numpy as jnp
 
 from .steps import TrainState
+
+#: frame = magic + little-endian CRC32 of the msgpack blob + the blob.
+_MAGIC = b"DNTCK1\n"
+
+
+class CorruptCheckpointError(RuntimeError):
+    """The checkpoint file exists but fails its checksum / deserialization."""
 
 
 def _atomic_write(path: str, data):
@@ -33,7 +52,59 @@ def _atomic_write(path: str, data):
     os.replace(tmp, path)
 
 
-def save_checkpoint(path: str, state: TrainState, meta: dict | None = None) -> str:
+def _frame(blob: bytes) -> bytes:
+    return _MAGIC + struct.pack("<I", zlib.crc32(blob)) + blob
+
+
+def _read_raw(path: str) -> dict:
+    """Read one checkpoint file → restored msgpack dict; raises
+    :class:`CorruptCheckpointError` on checksum/deserialization failure."""
+    with open(path, "rb") as fh:
+        data = fh.read()
+    if data.startswith(_MAGIC):
+        head = len(_MAGIC) + 4
+        if len(data) < head:
+            raise CorruptCheckpointError(f"{path}: truncated checkpoint frame")
+        (crc,) = struct.unpack("<I", data[len(_MAGIC):head])
+        blob = data[head:]
+        if zlib.crc32(blob) != crc:
+            raise CorruptCheckpointError(
+                f"{path}: payload checksum mismatch (torn or corrupt file)"
+            )
+    else:
+        blob = data  # pre-0.3 unframed checkpoint
+    try:
+        return flax.serialization.msgpack_restore(blob)
+    except Exception as e:  # msgpack raises a zoo of types
+        raise CorruptCheckpointError(f"{path}: undecodable checkpoint: {e}") from e
+
+
+def _load_raw(path: str, fallback: bool = True) -> dict:
+    """Read ``path``, falling back to ``path + '.prev'`` (the rotated previous
+    generation) when the primary is missing or corrupt."""
+    try:
+        return _read_raw(path)
+    except (OSError, CorruptCheckpointError) as e:
+        prev = path + ".prev"
+        if fallback and os.path.exists(prev):
+            warnings.warn(
+                f"checkpoint {path} unreadable ({e}); falling back to the "
+                f"previous generation {prev}"
+            )
+            return _read_raw(prev)
+        raise
+
+
+def save_checkpoint(
+    path: str, state: TrainState, meta: dict | None = None, rotate: bool = False
+) -> str:
+    """Serialize ``state`` (+ atomically-paired ``meta``) to ``path``.
+
+    ``rotate=True`` keeps the previous generation as ``path + '.prev'``
+    before replacing ``path`` — the load side falls back to it when the
+    primary is torn or corrupt (storage faults; the atomic write already
+    rules out torn *writes*).
+    """
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     payload = {
         "params": state.params,
@@ -42,20 +113,29 @@ def save_checkpoint(path: str, state: TrainState, meta: dict | None = None) -> s
         "engine_state": state.engine_state,
         "rng": state.rng,
         "round": state.round,
+        "health": state.health if state.health is not None else {},
         # meta rides INSIDE the msgpack so state+meta are one atomic unit (a
         # kill between two separate files would pair epoch-N state with
         # epoch-(N-1) bookkeeping and resume from the wrong epoch)
         "meta_json": json.dumps(meta or {}),
     }
-    _atomic_write(path, flax.serialization.to_bytes(payload))
+    # serialize BEFORE rotating: a to_bytes failure (non-addressable shards,
+    # OOM) must not have already burned the old .prev and vacated the primary
+    framed = _frame(flax.serialization.to_bytes(payload))
+    if rotate and os.path.exists(path):
+        os.replace(path, path + ".prev")
+    _atomic_write(path, framed)
     if meta is not None:  # human-readable sidecar (non-authoritative)
         _atomic_write(path + ".meta.json", json.dumps(meta, indent=2, default=float))
     return path
 
 
-def load_checkpoint(path: str, like: TrainState, with_meta: bool = False):
+def load_checkpoint(path: str, like: TrainState, with_meta: bool = False,
+                    fallback: bool = True):
     """Restore into the structure of ``like`` (shapes/treedef must match).
     ``with_meta=True`` also returns the embedded (atomically-paired) meta.
+    ``fallback`` (default on) retries ``path + '.prev'`` when ``path`` is
+    missing/torn/corrupt — the rotating-checkpoint recovery path.
 
     The ENGINE state restores tolerantly: its structure is an engine
     implementation detail (powerSGD's q/e, rankDAD's warm-start Ω — absent
@@ -63,7 +143,9 @@ def load_checkpoint(path: str, like: TrainState, with_meta: bool = False):
     differs between save and resume), and a mismatch falls back to ``like``'s
     freshly-initialized engine state with a warning instead of failing the
     whole resume. That cold-restarts the warm-start/error-feedback carry —
-    mathematically safe — while params/optimizer/rng resume exactly."""
+    mathematically safe — while params/optimizer/rng resume exactly. The
+    per-site HEALTH counters restore the same tolerant way (absent in
+    pre-0.3 checkpoints → fresh all-healthy counters)."""
     template = {
         "params": like.params,
         "batch_stats": like.batch_stats,
@@ -71,12 +153,12 @@ def load_checkpoint(path: str, like: TrainState, with_meta: bool = False):
         "rng": like.rng,
         "round": like.round,
     }
-    with open(path, "rb") as fh:
-        raw = flax.serialization.msgpack_restore(fh.read())
+    raw = _load_raw(path, fallback=fallback)
     # meta_json restored tolerantly: checkpoints written before it existed
     # (pre-0.2.0) must still resume rather than fail the template match
     meta_json = raw.pop("meta_json", None)
     eng_raw = raw.pop("engine_state", None)
+    health_raw = raw.pop("health", None)
     restored = flax.serialization.from_state_dict(template, raw)
     restored["meta_json"] = meta_json
     try:
@@ -84,13 +166,23 @@ def load_checkpoint(path: str, like: TrainState, with_meta: bool = False):
             like.engine_state, eng_raw
         )
     except (KeyError, TypeError, ValueError):
-        print(
+        warnings.warn(
             f"[warn] checkpoint {path}: stored engine state does not match "
             "the current engine's structure (engine or its knobs — e.g. "
             "dad_warm_start — changed since the save); resuming with fresh "
             "engine state."
         )
         engine_state = like.engine_state
+    health = like.health
+    if health_raw and like.health is not None:
+        try:
+            health = flax.serialization.from_state_dict(like.health, health_raw)
+        except (KeyError, TypeError, ValueError):
+            warnings.warn(
+                f"[warn] checkpoint {path}: stored site-health counters do "
+                "not match the current run (site count changed?); resuming "
+                "with fresh health counters."
+            )
     state = TrainState(
         params=restored["params"],
         batch_stats=restored["batch_stats"],
@@ -98,6 +190,7 @@ def load_checkpoint(path: str, like: TrainState, with_meta: bool = False):
         engine_state=engine_state,
         rng=jnp.asarray(restored["rng"]),
         round=jnp.asarray(restored["round"]),
+        health=health,
     )
     if with_meta:
         meta = restored.get("meta_json")
@@ -109,8 +202,7 @@ def load_checkpoint(path: str, like: TrainState, with_meta: bool = False):
 
 def load_params(path: str, like_params: Any):
     """Warm-start: load only params from a checkpoint (pretrain semantics)."""
-    with open(path, "rb") as fh:
-        raw = flax.serialization.msgpack_restore(fh.read())
+    raw = _load_raw(path)
     return flax.serialization.from_state_dict(like_params, raw["params"])
 
 
@@ -118,8 +210,7 @@ def load_eval_state(path: str, like_params: Any, like_stats: Any):
     """Inference-only restore: (params, batch_stats, meta) — no dependency on
     optimizer/engine-state shapes, so a ``mode="test"`` run works even when
     its site count differs from the training run's."""
-    with open(path, "rb") as fh:
-        raw = flax.serialization.msgpack_restore(fh.read())
+    raw = _load_raw(path)
     params = flax.serialization.from_state_dict(like_params, raw["params"])
     stats = flax.serialization.from_state_dict(like_stats, raw.get("batch_stats", {}))
     meta = raw.get("meta_json") or "{}"

@@ -66,6 +66,26 @@ def write_logs_json(
     return path
 
 
+def health_log_fields(site_health: dict | None, site_index: int | None = None) -> dict:
+    """``logs.json`` fields for the per-site fault-tolerance counters
+    (robustness/health.py): rounds each site skipped (scheduled drop,
+    non-finite gradient, or quarantine) and whether it ended the fit
+    quarantined. ``site_index=None`` returns the remote-side full lists;
+    an index returns that one site's scalars (for ``local{i}/logs.json``).
+    Returns ``{}`` when no health state was tracked (e.g. ``mode="test"``)."""
+    if not site_health:
+        return {}
+    if site_index is None:
+        return {
+            "site_skipped_rounds": list(site_health["site_skipped_rounds"]),
+            "site_quarantined": list(site_health["site_quarantined"]),
+        }
+    return {
+        "skipped_rounds": site_health["site_skipped_rounds"][site_index],
+        "quarantined": site_health["site_quarantined"][site_index],
+    }
+
+
 def write_test_metrics_csv(dirpath: str, fold: int, metrics: dict) -> str:
     """``metrics``: mapping name → value; accuracy and f1 must be present (the
     notebook indexes columns 1 and 2)."""

@@ -36,9 +36,13 @@ from .checkpoint import (
     load_params,
     save_checkpoint,
 )
+from ..robustness.faults import poison_inputs
+from ..robustness.health import health_summary
+from ..robustness.preemption import Preempted, PreemptionGuard
 from .logs import (
     duration,
     fold_dir,
+    health_log_fields,
     write_logs_json,
     write_test_metrics_csv,
     zip_global_results,
@@ -55,13 +59,19 @@ from .steps import (
 
 
 class FederatedTrainer:
-    def __init__(self, cfg: TrainConfig, model, mesh=None, out_dir: str | None = None):
+    def __init__(self, cfg: TrainConfig, model, mesh=None, out_dir: str | None = None,
+                 fault_plan=None):
         """``mesh=None`` folds all sites onto the local device via vmap (one
         chip simulating N sites); a mesh with a ``site`` axis runs one site
-        per device slice (see trainer/steps.py)."""
+        per device slice (see trainer/steps.py). ``fault_plan`` is an
+        optional :class:`~..robustness.faults.FaultPlan` — deterministic
+        chaos injection (site drops / NaN poisoning / kill-at-round) threaded
+        through the data layer and epoch inputs; masks are traced arrays, so
+        injecting faults never changes the compiled program."""
         self.cfg = cfg
         self.mesh = mesh
         self.out_dir = out_dir
+        self.fault_plan = fault_plan
         self.task = FederatedTask(model)
         task_args = dataclasses.asdict(cfg.task_args())
         self.engine = make_engine(
@@ -71,6 +81,7 @@ class FederatedTrainer:
         self.epoch_fn = make_train_epoch_fn(
             self.task, self.engine, self.optimizer, mesh, cfg.local_iterations,
             rounds_scan_xs=cfg.rounds_scan_xs,
+            quarantine_rounds=cfg.quarantine_rounds,
         )
         self.eval_fn = make_eval_fn(self.task, mesh)
         # ship inputs to the device pre-cast to the model's compute dtype
@@ -108,10 +119,41 @@ class FederatedTrainer:
 
     def init_state(self, sample_x, num_sites: int | None = None) -> TrainState:
         rng = jax.random.PRNGKey(self.cfg.seed)
-        return init_train_state(
+        state = init_train_state(
             self.task, self.engine, self.optimizer, rng, sample_x,
             num_sites=num_sites or getattr(self, "_num_sites", 1),
         )
+        return self._place_state(state)
+
+    def _place_state(self, state: TrainState) -> TrainState:
+        """Commit a host-built state to the mesh's steady-state sharding (the
+        one the compiled epoch emits). Freshly-initialized / checkpoint-
+        restored states are otherwise uncommitted, and the first epoch_fn
+        call after init or resume would compile a SECOND program for the
+        uncommitted layout — one silent warmup recompile per fit. Single-
+        process meshes only: multi-host arrays are fed per-process
+        (put_site_batch) and keep the legacy behavior."""
+        from ..parallel.distributed import spans_processes
+        from .steps import _state_specs
+
+        if self.mesh is None or spans_processes(self.mesh):
+            return state
+        from jax.sharding import NamedSharding
+
+        return jax.tree.map(
+            lambda a, spec: jax.device_put(a, NamedSharding(self.mesh, spec)),
+            state, _state_specs(state),
+        )
+
+    def _put_live(self, live):
+        """Ship a ``[S, rounds]`` liveness mask like the epoch batches."""
+        if live is None:
+            return None
+        if self.mesh is not None:
+            from ..parallel.distributed import put_site_batch
+
+            return put_site_batch(self.mesh, live)
+        return jnp.asarray(live)
 
     def run_epoch(self, state, train_sites, epoch: int, batch_size=None):
         fb = plan_epoch(
@@ -120,7 +162,25 @@ class FederatedTrainer:
             seed=self.cfg.seed * 100003 + epoch,
             pad_mode="wrap",
         )
-        state, losses = self.epoch_fn(state, *self._put_batch(fb))
+        live = None
+        if self.fault_plan is not None and self.fault_plan.injects_faults():
+            # deterministic chaos: masks/poison are pure functions of the
+            # plan and the GLOBAL round window, so resume replays the same
+            # fault pattern the uninterrupted run saw
+            rounds = fb.steps // max(self.cfg.local_iterations, 1)
+            round0 = int(state.round)
+            live = self.fault_plan.liveness(fb.num_sites, round0, rounds)
+            nan_mask = self.fault_plan.nan_mask(fb.num_sites, round0, rounds)
+            if nan_mask.any():  # data-layer injection: real NaN inputs
+                fb = dataclasses.replace(
+                    fb,
+                    inputs=poison_inputs(
+                        fb.inputs, nan_mask, self.cfg.local_iterations
+                    ),
+                )
+        state, losses = self.epoch_fn(
+            state, *self._put_batch(fb), self._put_live(live)
+        )
         return state, np.asarray(losses)
 
     @staticmethod
@@ -262,7 +322,14 @@ class FederatedTrainer:
             d = fold_dir(self.out_dir, "remote", cfg.task_id, fold)
             latest_path = os.path.join(d, "checkpoint_latest.msgpack")
             best_path = os.path.join(d, "checkpoint_best.msgpack")
-        resuming = bool(resume and latest_path and os.path.exists(latest_path))
+        # a kill inside the rotate window (primary moved to .prev, new primary
+        # not yet written) leaves only the .prev generation — still a valid
+        # resume point (load_checkpoint falls back to it), so gate on either
+        resuming = bool(
+            resume and latest_path
+            and (os.path.exists(latest_path)
+                 or os.path.exists(latest_path + ".prev"))
+        )
 
         # --- warm starts — skipped when resuming: load_checkpoint below
         # replaces the state wholesale, so pretraining first would be pure
@@ -291,6 +358,7 @@ class FederatedTrainer:
         # embedded in the msgpack, atomically paired with the state)
         if resuming:
             state, meta = load_checkpoint(latest_path, state, with_meta=True)
+            state = self._place_state(state)  # avoid a resume-layout recompile
             start_epoch = int(meta.get("epoch", 0)) + 1
             best_metric = meta.get("best_val_metric")
             best_epoch = int(meta.get("best_val_epoch", 0))
@@ -307,7 +375,8 @@ class FederatedTrainer:
                 t_start = time.time() - cum[-1]
             best_state = (
                 load_checkpoint(best_path, state)
-                if os.path.exists(best_path)
+                if (os.path.exists(best_path)
+                    or os.path.exists(best_path + ".prev"))
                 else state
             )
 
@@ -321,57 +390,81 @@ class FederatedTrainer:
                 os.path.join(cfg.profile_dir, f"fold_{fold}")
             )
         stop_epoch = cfg.epochs
+        # kill-at-round chaos arm: track the global round window per epoch so
+        # the kill fires exactly once, when training CROSSES the round (a
+        # resumed run starts past it and sails through)
+        kill_round = (
+            self.fault_plan.kill_at_round if self.fault_plan is not None else None
+        )
+        round_before = int(state.round) if kill_round is not None else 0
+        guard = PreemptionGuard()
         try:
-            for epoch in range(start_epoch, cfg.epochs + 1):
-                e_start = time.time()
-                state, losses = self.run_epoch(
-                    state, train_sites, epoch, batch_size=cfg.batch_size
-                )
-                epoch_losses.append(float(losses.mean()))
-                # per-iteration durations (reference local_iter_duration is
-                # per-round, NB.ipynb cells 34-36). All rounds of an epoch run in
-                # ONE fused XLA dispatch here, so per-round host timing does not
-                # exist; the truthful equivalent is the epoch time amortized over
-                # its rounds.
-                rounds = max(len(losses), 1)
-                iter_durations.extend([(time.time() - e_start) / rounds] * rounds)
+            with guard:
+                for epoch in range(start_epoch, cfg.epochs + 1):
+                    e_start = time.time()
+                    state, losses = self.run_epoch(
+                        state, train_sites, epoch, batch_size=cfg.batch_size
+                    )
+                    # all-dead rounds report NaN loss (trainer/steps.py) —
+                    # average over the rounds that actually trained
+                    lived = losses[np.isfinite(losses)]
+                    epoch_loss = float(lived.mean()) if lived.size else float("nan")
+                    epoch_losses.append(epoch_loss)
+                    # per-iteration durations (reference local_iter_duration is
+                    # per-round, NB.ipynb cells 34-36). All rounds of an epoch run in
+                    # ONE fused XLA dispatch here, so per-round host timing does not
+                    # exist; the truthful equivalent is the epoch time amortized over
+                    # its rounds.
+                    rounds = max(len(losses), 1)
+                    iter_durations.extend([(time.time() - e_start) / rounds] * rounds)
 
-                if epoch % cfg.validation_epochs == 0:
-                    if has_val:
-                        val_avg, val_metrics = self.evaluate(
-                            state, val_sites, batch_size=cfg.batch_size
-                        )
-                        score = val_metrics.value(monitor) if monitor != "loss" else val_avg.avg
-                        if is_improvement(
-                            score, best_metric, direction if monitor != "loss" else "minimize"
-                        ):
-                            best_metric, best_epoch, best_state = score, epoch, state
-                            since_best = 0
-                            if best_path and self._coordinator():  # save-on-best
-                                save_checkpoint(
-                                    best_path, best_state,
-                                    meta={"best_val_epoch": best_epoch,
-                                          "best_val_metric": best_metric, "fold": fold},
+                    if epoch % cfg.validation_epochs == 0:
+                        if has_val:
+                            val_avg, val_metrics = self.evaluate(
+                                state, val_sites, batch_size=cfg.batch_size
+                            )
+                            score = val_metrics.value(monitor) if monitor != "loss" else val_avg.avg
+                            if is_improvement(
+                                score, best_metric, direction if monitor != "loss" else "minimize"
+                            ):
+                                best_metric, best_epoch, best_state = score, epoch, state
+                                since_best = 0
+                                if best_path and self._coordinator():  # save-on-best
+                                    save_checkpoint(
+                                        best_path, best_state,
+                                        meta={"best_val_epoch": best_epoch,
+                                              "best_val_metric": best_metric, "fold": fold},
+                                        rotate=True,
+                                    )
+                            else:
+                                since_best += cfg.validation_epochs
+                            if verbose:
+                                print(
+                                    f"[fold {fold}] epoch {epoch}: train_loss={epoch_loss:.4f} "
+                                    + self._format_val_line(val_avg, val_metrics, monitor)
+                                    + (" *" if best_epoch == epoch else "")
                                 )
                         else:
-                            since_best += cfg.validation_epochs
-                        if verbose:
-                            print(
-                                f"[fold {fold}] epoch {epoch}: train_loss={losses.mean():.4f} "
-                                + self._format_val_line(val_avg, val_metrics, monitor)
-                                + (" *" if best_epoch == epoch else "")
-                            )
+                            # no validation anywhere (kfold k==2): the latest
+                            # state is the selected state; no early stopping
+                            best_epoch, best_state = epoch, state
+                            if verbose:
+                                print(
+                                    f"[fold {fold}] epoch {epoch}: "
+                                    f"train_loss={epoch_loss:.4f} (no validation split)"
+                                )
+                        stop = since_best >= cfg.patience
                     else:
-                        # no validation anywhere (kfold k==2): the latest
-                        # state is the selected state; no early stopping
-                        best_epoch, best_state = epoch, state
-                        if verbose:
-                            print(
-                                f"[fold {fold}] epoch {epoch}: "
-                                f"train_loss={losses.mean():.4f} (no validation split)"
-                            )
-                    stop = since_best >= cfg.patience
-                    if latest_path and self._coordinator():  # resume point
+                        stop = False
+                    # durations BEFORE the save so the checkpointed meta's
+                    # bookkeeping covers the same epochs as its epoch_losses
+                    # (and the save's own IO time stays out of compute time)
+                    duration(self._cache, e_start, "time_spent_on_computation")
+                    duration(self._cache, t_start, "cumulative_total_duration")
+                    # rotating resume point EVERY epoch (ckpt + ckpt.prev,
+                    # checksummed): preemption granularity is one epoch, and a
+                    # torn/corrupt latest falls back to the previous generation
+                    if latest_path and self._coordinator():
                         save_checkpoint(
                             latest_path, state,
                             meta={"epoch": epoch, "best_val_epoch": best_epoch,
@@ -383,14 +476,30 @@ class FederatedTrainer:
                                       "time_spent_on_computation", []),
                                   "cumulative_total_duration": self._cache.get(
                                       "cumulative_total_duration", [])},
+                            rotate=True,
                         )
-                else:
-                    stop = False
-                duration(self._cache, e_start, "time_spent_on_computation")
-                duration(self._cache, t_start, "cumulative_total_duration")
-                if stop:
-                    stop_epoch = epoch
-                    break
+                    # -- preemption: a SIGTERM/SIGINT that landed during the
+                    # epoch exits here, AFTER the rotating checkpoint, so
+                    # resume=True continues bit-exact from this boundary
+                    if guard.requested is not None:
+                        raise Preempted(
+                            f"signal {guard.requested} during epoch {epoch}; "
+                            f"state saved to {latest_path or '(no out_dir)'}",
+                            signum=guard.requested, epoch=epoch,
+                        )
+                    if kill_round is not None:
+                        round_after = int(state.round)
+                        if round_before <= kill_round < round_after:
+                            raise Preempted(
+                                f"FaultPlan kill_at_round={kill_round} crossed "
+                                f"during epoch {epoch}; state saved to "
+                                f"{latest_path or '(no out_dir)'}",
+                                epoch=epoch,
+                            )
+                        round_before = round_after
+                    if stop:
+                        stop_epoch = epoch
+                        break
         finally:
             if cfg.profile_dir:
                 jax.profiler.stop_trace()
@@ -412,6 +521,14 @@ class FederatedTrainer:
         results = self._test_results(best_state, test_sites, best_epoch,
                                      best_metric, stop_epoch, epoch_losses,
                                      batch_size=cfg.batch_size)
+        # per-site fault-tolerance counters from the FINAL state (best_state
+        # may predate a quarantine event): rounds skipped, quarantine flags
+        if state.health is not None:
+            from ..parallel.distributed import fetch_site_outputs
+
+            results["site_health"] = health_summary(
+                fetch_site_outputs(state.health, self.mesh)
+            )
         if self.out_dir:
             self._write_outputs(results, iter_durations, best_state, fold)
         results["state"] = best_state
@@ -502,6 +619,7 @@ class FederatedTrainer:
             ),
             rng=state.rng,
             round=state.round,
+            health=state.health,
         )
         for epoch in range(1, pa.epochs + 1):
             fb = plan_epoch(
@@ -511,7 +629,8 @@ class FederatedTrainer:
             if verbose:
                 print(f"[pretrain site {largest}] epoch {epoch}: "
                       f"loss={np.asarray(losses).mean():.4f}")
-        # warm-started params; fresh optimizer for the federated phase
+        # warm-started params; fresh optimizer (and health) for the federated
+        # phase — pretrain skips/quarantines must not leak into the real run
         return TrainState(
             params=pre_state.params,
             batch_stats=pre_state.batch_stats,
@@ -519,6 +638,7 @@ class FederatedTrainer:
             engine_state=state.engine_state,
             rng=state.rng,
             round=pre_state.round,
+            health=state.health,
         )
 
     def _write_outputs(self, results, iter_durations, best_state, fold):
@@ -541,12 +661,14 @@ class FederatedTrainer:
                 results["best_val_epoch"],
                 cum, comp, iter_durations, side="local",
                 extra={"site_index": i, "pooled_test_metrics": results["test_metrics"],
-                       "durations_shared_across_sites": True},
+                       "durations_shared_across_sites": True,
+                       **health_log_fields(results.get("site_health"), i)},
             )
         d = fold_dir(self.out_dir, "remote", cfg.task_id, fold)
         write_logs_json(
             d, cfg.agg_engine, results["test_metrics"], results["best_val_epoch"],
             cum, comp, iter_durations, side="remote",
+            extra=health_log_fields(results.get("site_health")),
         )
         write_test_metrics_csv(d, fold, results["test_scores"])
         save_checkpoint(

@@ -36,6 +36,7 @@ from jax.sharding import PartitionSpec as P
 from ..engines.base import Engine
 from ..parallel.collectives import site_weight_scale
 from ..parallel.mesh import FOLD_AXIS, MODEL_AXIS, SITE_AXIS
+from ..robustness.health import default_health
 
 
 def _model_axis_of(mesh) -> str | None:
@@ -58,6 +59,10 @@ class TrainState:
     engine_state: Any  # PER-SITE: leaves carry a leading [num_sites] axis
     rng: jax.Array
     round: jax.Array  # global round counter (int32)
+    # PER-SITE health counters (robustness/health.py): non-finite streak,
+    # skipped-round count, sticky quarantine flag. None only for states built
+    # by hand pre-0.3 code paths — the epoch fn fills in zeros then.
+    health: Any = None
 
 
 def _state_specs(state: TrainState):
@@ -65,7 +70,8 @@ def _state_specs(state: TrainState):
     engine state — powerSGD's error-feedback residual/Q and rankDAD's
     warm-start subspace Ω (engines/rankdad.py) — which is sharded over the
     site axis; collapsing it to one site's copy would silently break error
-    feedback (and subspace warm starts) across epoch boundaries."""
+    feedback (and subspace warm starts) across epoch boundaries. The health
+    counters are per-site for the same reason."""
     return TrainState(
         params=jax.tree.map(lambda _: P(), state.params),
         batch_stats=jax.tree.map(lambda _: P(), state.batch_stats),
@@ -73,6 +79,7 @@ def _state_specs(state: TrainState):
         engine_state=jax.tree.map(lambda _: P(SITE_AXIS), state.engine_state),
         rng=P(),
         round=P(),
+        health=jax.tree.map(lambda _: P(SITE_AXIS), state.health),
     )
 
 
@@ -156,6 +163,7 @@ def init_train_state(
         ),
         rng=rng,
         round=jnp.zeros((), jnp.int32),
+        health=default_health(num_sites),
     )
 
 
@@ -166,14 +174,31 @@ def make_train_epoch_fn(
     mesh=None,
     local_iterations: int = 1,
     rounds_scan_xs: bool = True,
+    quarantine_rounds: int | None = 3,
 ):
     """Build the jitted epoch function.
 
     Takes ``(state, inputs [S,steps,B,...], labels [S,steps,B],
-    weights [S,steps,B])``; consumes ``steps`` in rounds of
+    weights [S,steps,B], live=None)``; consumes ``steps`` in rounds of
     ``local_iterations`` micro-batches (trailing remainder < local_iterations
     is dropped, mirroring drop_last at round granularity); returns
     ``(state, per-round weighted loss [rounds])``.
+
+    Fault tolerance (robustness/): ``live [S, rounds]`` is the optional
+    scheduled-liveness mask — a TRACED input, so a different fault pattern
+    never recompiles the epoch. Each round a site contributes iff it is
+    scheduled live AND its round gradient is finite AND it is not
+    quarantined; dead sites are zero-weighted inside every engine's
+    ``aggregate`` (``jnp.where``-masked payloads, weighted mean renormalized
+    over live weight only) and their engine state is frozen for the round. A
+    site whose gradient stays non-finite for ``quarantine_rounds``
+    consecutive rounds trips a sticky quarantine flag (``TrainState.health``;
+    ``quarantine_rounds == 0`` disables the sticky flag but keeps the
+    per-round skip). A round with NO live weight leaves
+    params/optimizer/batch-stats untouched. ``quarantine_rounds < 0`` with no
+    mask statically compiles the fault machinery OUT — the exact
+    pre-robustness program, for benchmarking the machinery's cost.
+    ``quarantine_rounds=None`` means the default (3).
 
     Site-axis realization (both run the *same* per-site program):
 
@@ -186,6 +211,8 @@ def make_train_epoch_fn(
     """
 
     model_axis = _model_axis_of(mesh)
+    if quarantine_rounds is None:
+        quarantine_rounds = 3  # the default threshold
 
     def loss_fn(params, batch_stats, rng, x, y, w):
         logits, new_stats = task.apply(
@@ -206,7 +233,7 @@ def make_train_epoch_fn(
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
-    def epoch_over_sites(state: TrainState, x, y, w, site_axes, inner_axis):
+    def epoch_over_sites(state: TrainState, x, y, w, live, site_axes, inner_axis):
         """Run one epoch for the k in-device sites in ``x [k, steps, B, ...]``.
 
         Only the per-site work (grads, engine aggregation, stat sync) runs
@@ -236,19 +263,41 @@ def make_train_epoch_fn(
         x_rounds, y_rounds, w_rounds = (
             split_rounds(x), split_rounds(y), split_rounds(w)
         )
+        # scheduled liveness, [k, rounds] f32 (None → all live; the branch is
+        # trace-time static, so both forms compile once each, never per mask)
+        live_rounds = (
+            None if live is None else live[:, :rounds].astype(jnp.float32)
+        )
+        # trace-time static gate: the fault machinery (isfinite reduction over
+        # the gradient tree, where-freezes/selects on engine state, params,
+        # opt state, BN stats) compiles in only when quarantine is enabled OR
+        # a liveness mask is fed; quarantine_rounds=-1 with no mask restores
+        # the exact pre-robustness program (the bench escape hatch)
+        guard = quarantine_rounds >= 0 or live is not None
+        health = state.health  # filled by epoch_fn before any shard_map
 
         def one_round(carry, xs):
-            params, batch_stats, opt_state, engine_state, rng, rnd = carry
+            params, batch_stats, opt_state, engine_state, health, rng, rnd = carry
             if rounds_scan_xs:
-                xb, yb, wb = xs  # [k, L, B, ...] — this round's block
+                if live_rounds is None:
+                    xb, yb, wb = xs
+                    lb = jnp.ones((k,), jnp.float32)
+                else:
+                    xb, yb, wb, lb = xs  # [k, L, B, ...] — this round's block
             else:
                 xb, yb, wb = (
                     jax.lax.dynamic_index_in_dim(a, xs, axis=1, keepdims=False)
                     for a in (x_rounds, y_rounds, w_rounds)
                 )
+                lb = (
+                    jnp.ones((k,), jnp.float32) if live_rounds is None
+                    else jax.lax.dynamic_index_in_dim(
+                        live_rounds, xs, axis=1, keepdims=False
+                    )
+                )
             rng, sub = jax.random.split(rng)
 
-            def site_part(es, xs, ys, ws):
+            def site_part(es, hs, ls, xs, ys, ws):
                 site_ix = jax.lax.axis_index(site_axes)
 
                 def micro(acc, mb):
@@ -274,31 +323,120 @@ def make_train_epoch_fn(
                 site_grad = jax.tree.map(
                     lambda g: g / jnp.maximum(n_sum, 1.0), g_sum
                 )
-                agg, es = engine.aggregate(site_grad, es, n_sum, site_axes)
-                # sync-BN: example-weighted average of per-site running stats
+                if not guard:
+                    # fault machinery statically compiled out: the exact
+                    # legacy round (no finite check, no selects, no counters)
+                    agg, es_new = engine.aggregate(
+                        site_grad, es, n_sum, site_axes, live=None
+                    )
+                    if task.has_batch_stats:
+                        scale = site_weight_scale(n_sum, site_axes)
+                        new_stats = jax.tree.map(
+                            lambda s: jax.lax.psum(s * scale, site_axes),
+                            new_stats,
+                        )
+                    loss_round = jax.lax.psum(
+                        loss_sums.sum(), site_axes
+                    ) / jnp.maximum(jax.lax.psum(n_sum, site_axes), 1.0)
+                    return agg, es_new, hs, new_stats, loss_round, None
+                # -- liveness: scheduled-live AND finite AND not quarantined.
+                # A poisoned batch (data corruption, overflow, fault
+                # injection) yields a non-finite site gradient; that site is
+                # skipped this round and its streak counter advances toward
+                # quarantine. All jnp.where / traced — no recompilation.
+                finite = jnp.array(True)
+                for leaf in jax.tree.leaves(site_grad):
+                    finite &= jnp.isfinite(leaf).all()
+                contribute = (
+                    ls * finite.astype(jnp.float32)
+                    * (1.0 - (hs["quarantined"] > 0).astype(jnp.float32))
+                )
+                n_eff = n_sum * contribute
+                agg, es_new = engine.aggregate(
+                    site_grad, es, n_sum, site_axes, live=contribute
+                )
+                # freeze a dead site's engine state for the round: its
+                # error-feedback residual / warm-start subspace must resume
+                # where it left off when the site returns, not absorb a
+                # round it never participated in
+                es_new = jax.tree.map(
+                    lambda new, old: jnp.where(contribute > 0, new, old),
+                    es_new, es,
+                )
+                total_live = jax.lax.psum(n_eff, site_axes)
+                # sync-BN: example-weighted average of LIVE sites' running
+                # stats (dead sites' stats may be NaN → where-zeroed, and
+                # their weight is already 0); an all-dead round keeps the
+                # previous stats
                 if task.has_batch_stats:
-                    scale = site_weight_scale(n_sum, site_axes)
+                    scale = site_weight_scale(n_eff, site_axes)
+                    new_stats = jax.tree.map(
+                        lambda s: jnp.where(contribute > 0, s, jnp.zeros_like(s)),
+                        new_stats,
+                    )
                     new_stats = jax.tree.map(
                         lambda s: jax.lax.psum(s * scale, site_axes), new_stats
                     )
-                # round-weighted global loss (for logs)
-                loss_round = jax.lax.psum(loss_sums.sum(), site_axes) / jnp.maximum(
-                    jax.lax.psum(n_sum, site_axes), 1.0
+                    new_stats = jax.tree.map(
+                        lambda syn, old: jnp.where(total_live > 0, syn, old),
+                        new_stats, batch_stats,
+                    )
+                # round-weighted global loss over LIVE sites (for logs);
+                # NaN-safe: a dead site's loss sum is excluded via where. An
+                # all-dead round has no training loss — report NaN, not a
+                # spurious 0.0 that would drag the epoch mean down (the
+                # trainer nan-means per-round losses into the epoch figure)
+                loss_round = jnp.where(
+                    total_live > 0,
+                    jax.lax.psum(
+                        jnp.where(contribute > 0, loss_sums.sum(), 0.0),
+                        site_axes,
+                    ) / jnp.maximum(total_live, 1.0),
+                    jnp.nan,
                 )
-                return agg, es, new_stats, loss_round
+                # -- health counters: streak of consecutive non-finite
+                # rounds; sticky quarantine once it reaches the threshold
+                streak = jnp.where(finite, 0, hs["streak"] + 1)
+                quarantined = hs["quarantined"]
+                if quarantine_rounds > 0:
+                    quarantined = jnp.maximum(
+                        quarantined, (streak >= quarantine_rounds).astype(jnp.int32)
+                    )
+                hs_new = {
+                    "streak": streak,
+                    "skips": hs["skips"] + (contribute <= 0).astype(jnp.int32),
+                    "quarantined": quarantined,
+                }
+                return agg, es_new, hs_new, new_stats, loss_round, total_live
 
-            agg, engine_state, stats_k, loss_k = jax.vmap(
-                site_part, in_axes=(0, 0, 0, 0), out_axes=(0, 0, 0, 0),
-                axis_name=inner_axis,
-            )(engine_state, xb, yb, wb)
+            agg, engine_state, health, stats_k, loss_k, tl_k = jax.vmap(
+                site_part, in_axes=(0, 0, 0, 0, 0, 0),
+                out_axes=(0, 0, 0, 0, 0, 0), axis_name=inner_axis,
+            )(engine_state, health, lb, xb, yb, wb)
             # agg/stats/loss are psum'd over site_axes → identical across the
             # k in-device rows; collapse to one copy and update once
             agg = jax.tree.map(lambda a: a[0], agg)
             batch_stats = jax.tree.map(lambda a: a[0], stats_k)
-            updates, opt_state = optimizer.update(agg, opt_state, params)
-            params = optax.apply_updates(params, updates)
+            updates, new_opt_state = optimizer.update(agg, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            if guard:
+                # a round with zero live weight advances nothing: params AND
+                # optimizer state hold (Adam's moment decay on a zero
+                # gradient would otherwise drift the update direction)
+                total_live = tl_k[0]
+                params = jax.tree.map(
+                    lambda new, old: jnp.where(total_live > 0, new, old),
+                    new_params, params,
+                )
+                opt_state = jax.tree.map(
+                    lambda new, old: jnp.where(total_live > 0, new, old),
+                    new_opt_state, opt_state,
+                )
+            else:
+                params, opt_state = new_params, new_opt_state
             return (
-                params, batch_stats, opt_state, engine_state, rng, rnd + 1,
+                params, batch_stats, opt_state, engine_state, health, rng,
+                rnd + 1,
             ), loss_k[0]
 
         carry0 = (
@@ -306,6 +444,7 @@ def make_train_epoch_fn(
             state.batch_stats,
             state.opt_state,
             state.engine_state,
+            health,
             jax.random.fold_in(state.rng, state.round),
             state.round,
         )
@@ -323,12 +462,16 @@ def make_train_epoch_fn(
         # so peak HBM residency grows by ~1x the epoch-input size. For
         # epoch inputs big enough for that to matter (multi-GB), pass
         # rounds_scan_xs=False.
-        xs = (
-            tuple(jnp.moveaxis(a, 1, 0) for a in (x_rounds, y_rounds, w_rounds))
-            if rounds_scan_xs else jnp.arange(rounds)
-        )
-        (params, stats, opt_state, engine_state, rng, rnd), losses = jax.lax.scan(
-            one_round, carry0, xs
+        if rounds_scan_xs:
+            xs = tuple(
+                jnp.moveaxis(a, 1, 0) for a in (x_rounds, y_rounds, w_rounds)
+            )
+            if live_rounds is not None:
+                xs = xs + (jnp.moveaxis(live_rounds, 1, 0),)
+        else:
+            xs = jnp.arange(rounds)
+        (params, stats, opt_state, engine_state, health, rng, rnd), losses = (
+            jax.lax.scan(one_round, carry0, xs)
         )
         new_state = TrainState(
             params=params,
@@ -337,47 +480,67 @@ def make_train_epoch_fn(
             engine_state=engine_state,
             rng=state.rng,
             round=rnd,
+            health=health,
         )
         return new_state, losses
 
+    def _ensure_health(state: TrainState, inputs) -> TrainState:
+        # states built by pre-0.3 code paths carry health=None (or, like
+        # dSGD's leafless engine state, a site count the data overrides);
+        # fill fresh counters at the jit boundary so specs/carry structures
+        # are uniform. Counters only survive when the site count matches —
+        # per-site bookkeeping is meaningless across a site-count change.
+        if (
+            state.health is None
+            or state.health["streak"].shape[0] != inputs.shape[0]
+        ):
+            state = state.replace(health=default_health(inputs.shape[0]))
+        return state
+
     if mesh is not None:
 
-        def shard_wrapped(st, x, y, w):
+        def shard_wrapped(st, x, y, w, lv=None):
             # x: [k, steps, B, ...] — this device's block of k sites. k > 1 is
             # the folded case (cfg.sites_per_device: more simulated sites than
             # devices); cross-site collectives span the (mesh site, fold)
             # axis pair. k == 1 is the one-site-per-device case, same program.
             return epoch_over_sites(
-                st, x, y, w, site_axes=(SITE_AXIS, FOLD_AXIS),
+                st, x, y, w, lv, site_axes=(SITE_AXIS, FOLD_AXIS),
                 inner_axis=FOLD_AXIS,
             )
 
         @jax.jit
-        def epoch_fn(state: TrainState, inputs, labels, weights):
+        def epoch_fn(state: TrainState, inputs, labels, weights, live=None):
+            state = _ensure_health(state, inputs)
             specs = _state_specs(state)
+            in_specs = (specs, P(SITE_AXIS), P(SITE_AXIS), P(SITE_AXIS))
+            args = (state, inputs, labels, weights)
+            if live is not None:  # trace-time branch: one program per form
+                in_specs += (P(SITE_AXIS),)
+                args += (live,)
             return shard_map(
                 shard_wrapped,
                 mesh=mesh,
-                in_specs=(specs, P(SITE_AXIS), P(SITE_AXIS), P(SITE_AXIS)),
+                in_specs=in_specs,
                 out_specs=(specs, P()),
                 check_vma=False,
-            )(state, inputs, labels, weights)
+            )(*args)
 
     else:
 
         @jax.jit
-        def epoch_fn(state: TrainState, inputs, labels, weights):
+        def epoch_fn(state: TrainState, inputs, labels, weights, live=None):
             # all S sites fold onto the local device: the inner vmap IS the
             # site axis
             return epoch_over_sites(
-                state, inputs, labels, weights,
+                _ensure_health(state, inputs), inputs, labels, weights, live,
                 site_axes=SITE_AXIS, inner_axis=SITE_AXIS,
             )
 
     return epoch_fn
 
 
-def compile_epoch_aot(epoch_fn, state: TrainState, x, y, w):
+def compile_epoch_aot(epoch_fn, state: TrainState, x, y, w, live=None):
     """AOT-compile an epoch function letting XLA choose the INPUT layout for
     the (large, resident) epoch inputs.
 
@@ -391,12 +554,18 @@ def compile_epoch_aot(epoch_fn, state: TrainState, x, y, w):
     Returns ``(compiled, put_x)``: call ``put_x(x)`` once on the resident
     inputs, then ``compiled(state, put_x(x), y, w)`` exactly like
     ``epoch_fn``. Single-device path (``mesh=None``) — the shard_map path
-    distributes inputs instead of keeping them resident.
+    distributes inputs instead of keeping them resident. Pass ``live``
+    (``[S, rounds]``) to compile the fault-injected program (bench
+    ``--faults``); the compiled callable then takes it as a fifth argument.
     """
     from ..core.jaxcompat import auto_input_format, input_formats_of
 
     in_sh = (jax.tree.map(lambda _: None, state), auto_input_format(), None, None)
-    comp = jax.jit(epoch_fn, in_shardings=in_sh).lower(state, x, y, w).compile()
+    args = (state, x, y, w)
+    if live is not None:
+        in_sh = in_sh + (None,)
+        args = args + (live,)
+    comp = jax.jit(epoch_fn, in_shardings=in_sh).lower(*args).compile()
     x_fmt = input_formats_of(comp)[0][1]
     return comp, lambda xs: jax.device_put(xs, x_fmt)
 

@@ -101,7 +101,12 @@ def make_rankdad_exchange_only(
     def init(grads):
         return {}
 
-    def aggregate(grads, state, weight, axis_name):
+    def aggregate(grads, state, weight, axis_name, live=None):
+        from dinunet_implementations_tpu.engines.base import mask_dead_site
+
+        # same liveness contract as the real engines (trainer/steps.py passes
+        # live= unconditionally)
+        grads, weight = mask_dead_site(grads, weight, live)
         scale = site_weight_scale(weight, axis_name)
         leaves, treedef = jax.tree.flatten(grads)
         out: list = [None] * len(leaves)

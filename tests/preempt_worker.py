@@ -1,0 +1,82 @@
+"""Worker process for the real-SIGTERM crash-resume test (test_robustness.py).
+
+    python preempt_worker.py <out_dir> <epochs> [--resume]
+
+Runs a deterministic toy federated fit (data generated from fixed seeds, so
+every invocation — full, killed, resumed — sees identical inputs). Prints one
+line per validation epoch (the parent uses those to time its SIGTERM). On
+:class:`Preempted` the trainer has already saved the rotating checkpoint; the
+worker exits with the signal convention code (143 for SIGTERM). On completion
+it writes ``<out_dir>/results.json``.
+"""
+
+import json
+import os
+import sys
+
+# env before the jax import (conftest.py does the same for the test process)
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from dinunet_implementations_tpu import TrainConfig  # noqa: E402
+from dinunet_implementations_tpu.data.api import SiteArrays  # noqa: E402
+from dinunet_implementations_tpu.models import MSANNet  # noqa: E402
+from dinunet_implementations_tpu.parallel import host_mesh  # noqa: E402
+from dinunet_implementations_tpu.robustness import Preempted  # noqa: E402
+from dinunet_implementations_tpu.trainer import FederatedTrainer  # noqa: E402
+
+
+def toy_sites(ns, n, seed):
+    out = []
+    rng = np.random.default_rng(seed)
+    for _ in range(ns):
+        X = rng.normal(size=(n, 6)).astype(np.float32)
+        y = (X.sum(-1) > 0).astype(np.int32)
+        out.append(SiteArrays(X, y, np.arange(n, dtype=np.int32)))
+    return out
+
+
+def main():
+    out_dir = sys.argv[1]
+    epochs = int(sys.argv[2])
+    resume = "--resume" in sys.argv
+
+    cfg = TrainConfig(epochs=epochs, patience=100, batch_size=8,
+                      validation_epochs=1)
+    model = MSANNet(in_size=6, hidden_sizes=(8,), out_size=2)
+    tr = FederatedTrainer(cfg, model, host_mesh(2), out_dir=out_dir)
+    train = toy_sites(2, 40, seed=4)
+    val = toy_sites(2, 16, seed=5)
+    test = toy_sites(2, 16, seed=6)
+    try:
+        res = tr.fit(train, val, test, fold=0, verbose=True, resume=resume)
+    except Preempted as p:
+        print(f"PREEMPTED epoch={p.epoch}", flush=True)
+        sys.exit(p.exit_code)
+    with open(os.path.join(out_dir, "results.json"), "w") as fh:
+        json.dump({
+            "test_metrics": res["test_metrics"],
+            "best_val_epoch": res["best_val_epoch"],
+            "epoch_losses": res["epoch_losses"],
+        }, fh)
+    print("DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

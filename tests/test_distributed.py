@@ -152,6 +152,10 @@ def _run_dcn_workers(data_path, out_dir, reports, nproc, timeout=420):
             if p.poll() is None:
                 p.kill()
                 p.wait()
+    if any(p.returncode == 66 for p in procs):
+        # worker-side capability probe (dcn_worker.py): this jaxlib's CPU
+        # backend cannot execute cross-process collectives at all
+        pytest.skip("multiprocess CPU collectives unsupported by this jaxlib")
     for r, p in enumerate(procs):
         out = open(log_paths[r]).read()
         assert p.returncode == 0, f"worker {r} rc={p.returncode}:\n{out[-4000:]}"

@@ -1,0 +1,640 @@
+"""Fault-tolerance tests: FaultPlan determinism, liveness masking through the
+engines, NaN quarantine, rotating/checksummed checkpoints, preemption
+save-and-exit, retry/backoff, and the chaos acceptance run.
+
+Fast tests stay in tier-1; the kill/chaos integration runs are ``slow``.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from dinunet_implementations_tpu import TrainConfig
+from dinunet_implementations_tpu.data.api import SiteArrays
+from dinunet_implementations_tpu.models import MSANNet
+from dinunet_implementations_tpu.parallel import host_mesh
+from dinunet_implementations_tpu.robustness import (
+    FaultPlan,
+    Preempted,
+    PreemptionGuard,
+    parse_fault_plan,
+    poison_inputs,
+    with_retry,
+)
+from dinunet_implementations_tpu.trainer import (
+    CorruptCheckpointError,
+    FederatedTrainer,
+    load_checkpoint,
+    save_checkpoint,
+)
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan: determinism, JSON/CLI round-trip, data-layer poisoning
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_json_roundtrip():
+    plan = FaultPlan(drop=((3, 10, -1), (5, 10, 20)), flaky_prob=0.25,
+                     flaky_seed=7, nan_at=((4, 2),), kill_at_round=12)
+    assert FaultPlan.from_json(plan.to_json()) == plan
+    assert FaultPlan.from_json(json.dumps(plan.to_json())) == plan
+
+
+def test_fault_plan_cli_flag_roundtrip(tmp_path):
+    """Tier-1 smoke: a FaultPlan survives the CLI flag surface — inline JSON
+    and @file — byte-identically."""
+    from dinunet_implementations_tpu.runner.cli import build_parser
+
+    plan = FaultPlan(drop=((1, 2, -1),), nan_at=((0, 1), (1, 1)),
+                     kill_at_round=9)
+    blob = json.dumps(plan.to_json())
+    args = build_parser().parse_args(["--data-path", ".", "--faults", blob])
+    assert parse_fault_plan(args.faults) == plan
+    f = tmp_path / "plan.json"
+    f.write_text(blob)
+    args = build_parser().parse_args(["--data-path", ".", "--faults", f"@{f}"])
+    assert parse_fault_plan(args.faults) == plan
+    assert parse_fault_plan(None) is None
+    assert parse_fault_plan("") is None
+
+
+def test_fault_plan_rejects_malformed():
+    with pytest.raises(ValueError, match="flaky_prob"):
+        FaultPlan(flaky_prob=1.5)
+    with pytest.raises(ValueError, match="drop"):
+        FaultPlan(drop=((0, 5),))  # wrong arity
+    with pytest.raises(ValueError, match="drop"):
+        FaultPlan(drop=((0, 9, 5),))  # last < first
+    with pytest.raises(ValueError, match="unknown FaultPlan keys"):
+        FaultPlan.from_json({"nope": 1})
+
+
+def test_fault_plan_liveness_deterministic_and_chunk_independent():
+    """The flaky draw is keyed by (seed, site, GLOBAL round): the mask for a
+    window never depends on how training chunks rounds into epochs — a
+    resumed run replays the exact outage pattern of the uninterrupted one."""
+    plan = FaultPlan(drop=((1, 3, 6),), flaky_prob=0.4, flaky_seed=11)
+    whole = plan.liveness(4, 0, 12)
+    np.testing.assert_array_equal(whole, plan.liveness(4, 0, 12))
+    chunked = np.concatenate(
+        [plan.liveness(4, 0, 5), plan.liveness(4, 5, 7)], axis=1
+    )
+    np.testing.assert_array_equal(whole, chunked)
+    # the scheduled drop window is exact and inclusive
+    clean = FaultPlan(drop=((1, 3, 6),))
+    live = clean.liveness(4, 0, 12)
+    assert live[1, 2] == 1.0 and live[1, 3] == 0.0
+    assert live[1, 6] == 0.0 and live[1, 7] == 1.0
+    assert live[0].all() and live[2].all()
+    # open-ended drop (-1) holds to the end of any window
+    forever = FaultPlan(drop=((0, 2, -1),)).liveness(2, 100, 5)
+    assert (forever[0] == 0.0).all() and (forever[1] == 1.0).all()
+
+
+def test_fault_plan_nan_mask_and_poisoning():
+    plan = FaultPlan(nan_at=((2, 1), (5, 0)))
+    mask = plan.nan_mask(2, 0, 4)  # window covers round 2 only
+    assert mask[1, 2] and mask.sum() == 1
+    x = np.zeros((2, 8, 3, 4), np.float32)  # [S, steps, B, F]
+    out = poison_inputs(x, mask, local_iterations=2)
+    assert np.isnan(out[1, 4:6]).all()  # round 2 → steps 4..5
+    assert np.isfinite(out[0]).all()
+    assert np.isfinite(out[1, :4]).all() and np.isfinite(out[1, 6:]).all()
+    assert np.isfinite(x).all()  # original untouched
+    assert poison_inputs(x, np.zeros((2, 4), bool), 2) is x  # no-copy fast path
+
+
+# ---------------------------------------------------------------------------
+# liveness masking + quarantine inside the compiled epoch
+# ---------------------------------------------------------------------------
+
+
+def _toy_sites(ns, n=24, d=6, seed=0):
+    out = []
+    rng = np.random.default_rng(seed)
+    for _ in range(ns):
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        y = (X.sum(-1) > 0).astype(np.int32)
+        out.append(SiteArrays(X, y, np.arange(n, dtype=np.int32)))
+    return out
+
+
+def _identical_sites(ns, n=24, d=6, seed=3):
+    """ns sites holding byte-identical data (so a masked-out site's run can
+    be compared against a run without it)."""
+    one = _toy_sites(1, n=n, d=d, seed=seed)[0]
+    return [SiteArrays(one.inputs.copy(), one.labels.copy(), one.indices.copy())
+            for _ in range(ns)]
+
+
+def _fit(cfg, sites_fn, mesh, fault_plan=None, out_dir=None, resume=False,
+         **fit_kw):
+    model = MSANNet(in_size=6, hidden_sizes=(8,), out_size=2)
+    tr = FederatedTrainer(cfg, model, mesh, out_dir=out_dir,
+                          fault_plan=fault_plan)
+    res = tr.fit(sites_fn("train"), sites_fn("val"), sites_fn("test"),
+                 verbose=False, resume=resume, **fit_kw)
+    return tr, res
+
+
+def test_nan_injection_quarantines_site():
+    """A site whose inputs go NaN for quarantine_rounds consecutive rounds is
+    auto-quarantined; training completes finite, and — because both sites
+    hold identical data — the final params equal a run without the poisoned
+    site entirely (the weighted mean renormalizes over live weight only)."""
+    # 24 samples / batch 8 → 3 rounds per epoch; poison site 1's rounds 0-2
+    plan = FaultPlan(nan_at=((0, 1), (1, 1), (2, 1)))
+    cfg = TrainConfig(epochs=3, batch_size=8, quarantine_rounds=3, patience=50)
+
+    def two(which):
+        return _identical_sites(2) if which == "train" else _identical_sites(2, n=16, seed=9)
+
+    def one(which):
+        return two(which)[:1]
+
+    _, res_faulted = _fit(cfg, two, host_mesh(2), fault_plan=plan)
+    _, res_solo = _fit(cfg, one, host_mesh(1))
+
+    health = res_faulted["site_health"]
+    assert health["site_quarantined"] == [0, 1]
+    assert health["site_skipped_rounds"][0] == 0
+    assert health["site_skipped_rounds"][1] == 9  # every round of 3 epochs
+    assert np.isfinite(res_faulted["epoch_losses"]).all()
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6),
+        res_faulted["state"].params, res_solo["state"].params,
+    )
+
+
+@pytest.mark.parametrize("engine", ["dSGD", "rankDAD", "powerSGD"])
+def test_scheduled_dropout_renormalizes_every_engine(engine):
+    """A scheduled site drop flows into every engine's aggregate: with two
+    identical sites and site 1 dropped from round 0, the aggregate equals the
+    single-site run for ALL engines (dead payloads are where-zeroed and the
+    weighted mean renormalizes over live weight)."""
+    plan = FaultPlan(drop=((1, 0, -1),))
+    cfg = TrainConfig(epochs=2, batch_size=8, agg_engine=engine, patience=50)
+
+    def two(which):
+        return _identical_sites(2) if which == "train" else _identical_sites(2, n=16, seed=9)
+
+    def one(which):
+        return two(which)[:1]
+
+    _, res_faulted = _fit(cfg, two, None, fault_plan=plan)
+    _, res_solo = _fit(cfg, one, None)
+
+    health = res_faulted["site_health"]
+    assert health["site_quarantined"] == [0, 0]  # dropped ≠ quarantined
+    assert health["site_skipped_rounds"] == [0, 6]
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5),
+        res_faulted["state"].params, res_solo["state"].params,
+    )
+
+
+def test_quarantine_minus_one_compiles_machinery_out():
+    """quarantine_rounds=-1 with no FaultPlan is the static escape hatch: the
+    epoch program carries no fault machinery and trains identically (values
+    match the default program bit-for-bit when every site is healthy)."""
+    import jax.numpy as jnp
+    from dinunet_implementations_tpu.engines import make_engine
+    from dinunet_implementations_tpu.trainer import (
+        FederatedTask, init_train_state, make_optimizer, make_train_epoch_fn,
+    )
+
+    task = FederatedTask(MSANNet(in_size=6, hidden_sizes=(8,), out_size=2))
+    engine = make_engine("dSGD")
+    opt = make_optimizer("adam", 1e-2)
+    state0 = init_train_state(task, engine, opt, jax.random.PRNGKey(0),
+                              jnp.ones((4, 6)), num_sites=2)
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(2, 3, 4, 6)).astype(np.float32))
+    y = jnp.asarray((rng.random((2, 3, 4)) > 0.5).astype(np.int32))
+    w = jnp.ones((2, 3, 4), jnp.float32)
+    outs = {}
+    for qr in (3, -1):
+        fn = make_train_epoch_fn(task, engine, opt, mesh=None,
+                                 quarantine_rounds=qr)
+        st, losses = fn(state0, x, y, w)
+        outs[qr] = (st, losses)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        outs[3][0].params, outs[-1][0].params,
+    )
+    np.testing.assert_array_equal(np.asarray(outs[3][1]), np.asarray(outs[-1][1]))
+    # the opted-out program leaves health untouched (no counters maintained)
+    np.testing.assert_array_equal(np.asarray(outs[-1][0].health["skips"]), [0, 0])
+    # but a liveness mask still masks even when opted out
+    live = jnp.asarray([[1.0, 1.0, 1.0], [0.0, 0.0, 0.0]])
+    fn = make_train_epoch_fn(task, engine, opt, mesh=None, quarantine_rounds=-1)
+    st_m, _ = fn(state0, x, y, w, live)
+    assert np.isfinite(np.asarray(jax.tree.leaves(st_m.params)[0])).all()
+
+
+def test_fault_masks_do_not_recompile():
+    """Masks are traced inputs: a run whose fault pattern CHANGES every epoch
+    (flaky drops) compiles the epoch exactly once."""
+    plan = FaultPlan(flaky_prob=0.3, flaky_seed=5)
+    cfg = TrainConfig(epochs=4, batch_size=8, patience=50)
+
+    def sites(which):
+        return _toy_sites(2) if which == "train" else _toy_sites(2, n=16, seed=9)
+
+    tr, res = _fit(cfg, sites, host_mesh(2), fault_plan=plan)
+    assert np.isfinite(res["epoch_losses"]).all()
+    cache_size = getattr(tr.epoch_fn, "_cache_size", None)
+    if cache_size is not None:
+        assert cache_size() == 1, "per-mask recompilation"
+
+
+def test_health_counters_reach_logs_json(tmp_path):
+    plan = FaultPlan(drop=((1, 0, -1),))
+    cfg = TrainConfig(epochs=2, batch_size=8, patience=50)
+
+    def sites(which):
+        return _toy_sites(2) if which == "train" else _toy_sites(2, n=16, seed=9)
+
+    _fit(cfg, sites, host_mesh(2), fault_plan=plan, out_dir=str(tmp_path))
+    remote = json.load(open(
+        tmp_path / "remote/simulatorRun/FS-Classification/fold_0/logs.json"))
+    assert remote["site_skipped_rounds"] == [0, 6]
+    assert remote["site_quarantined"] == [0, 0]
+    local1 = json.load(open(
+        tmp_path / "local1/simulatorRun/FS-Classification/fold_0/logs.json"))
+    assert local1["skipped_rounds"] == 6 and local1["quarantined"] == 0
+
+
+# ---------------------------------------------------------------------------
+# rotating / checksummed checkpoints
+# ---------------------------------------------------------------------------
+
+
+def _small_state(mesh_size=2):
+    from dinunet_implementations_tpu.engines import make_engine
+    from dinunet_implementations_tpu.trainer import (
+        FederatedTask, init_train_state, make_optimizer,
+    )
+    import jax.numpy as jnp
+
+    task = FederatedTask(MSANNet(in_size=6, hidden_sizes=(8,), out_size=2))
+    engine = make_engine("dSGD")
+    opt = make_optimizer("adam", 1e-3)
+    return init_train_state(task, engine, opt, jax.random.PRNGKey(0),
+                            jnp.ones((4, 6)), num_sites=mesh_size)
+
+
+def test_checkpoint_rotation_keeps_previous_generation(tmp_path):
+    state = _small_state()
+    p = str(tmp_path / "ck.msgpack")
+    save_checkpoint(p, state, meta={"epoch": 1}, rotate=True)
+    assert not os.path.exists(p + ".prev")  # nothing to rotate yet
+    save_checkpoint(p, state, meta={"epoch": 2}, rotate=True)
+    assert os.path.exists(p + ".prev")
+    _, meta = load_checkpoint(p, state, with_meta=True)
+    assert meta["epoch"] == 2
+    _, meta_prev = load_checkpoint(p + ".prev", state, with_meta=True)
+    assert meta_prev["epoch"] == 1
+
+
+def test_corrupt_checkpoint_falls_back_to_prev(tmp_path):
+    state = _small_state()
+    p = str(tmp_path / "ck.msgpack")
+    save_checkpoint(p, state, meta={"epoch": 1}, rotate=True)
+    save_checkpoint(p, state, meta={"epoch": 2}, rotate=True)
+    # bit-rot in the latest generation: checksum catches it, loader recovers
+    blob = bytearray(open(p, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(p, "wb").write(bytes(blob))
+    with pytest.warns(UserWarning, match="falling back"):
+        _, meta = load_checkpoint(p, state, with_meta=True)
+    assert meta["epoch"] == 1
+    # a truncated (torn) latest also falls back
+    open(p, "wb").write(bytes(blob[:10]))
+    with pytest.warns(UserWarning, match="falling back"):
+        _, meta = load_checkpoint(p, state, with_meta=True)
+    assert meta["epoch"] == 1
+    # a MISSING latest with a surviving .prev (kill between rotate and
+    # replace) also recovers
+    os.remove(p)
+    with pytest.warns(UserWarning, match="falling back"):
+        _, meta = load_checkpoint(p, state, with_meta=True)
+    assert meta["epoch"] == 1
+
+
+def test_corrupt_checkpoint_without_prev_raises(tmp_path):
+    state = _small_state()
+    p = str(tmp_path / "ck.msgpack")
+    save_checkpoint(p, state, meta={"epoch": 1})
+    blob = bytearray(open(p, "rb").read())
+    blob[-3] ^= 0xFF
+    open(p, "wb").write(bytes(blob))
+    with pytest.raises(CorruptCheckpointError, match="checksum"):
+        load_checkpoint(p, state)
+
+
+def test_checkpoint_health_counters_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    state = _small_state()
+    state = state.replace(health={
+        "streak": jnp.asarray([0, 2], jnp.int32),
+        "skips": jnp.asarray([1, 5], jnp.int32),
+        "quarantined": jnp.asarray([0, 1], jnp.int32),
+    })
+    p = save_checkpoint(str(tmp_path / "ck.msgpack"), state)
+    restored = load_checkpoint(p, _small_state())
+    np.testing.assert_array_equal(np.asarray(restored.health["skips"]), [1, 5])
+    np.testing.assert_array_equal(
+        np.asarray(restored.health["quarantined"]), [0, 1])
+
+
+# ---------------------------------------------------------------------------
+# preemption: guard semantics + deterministic kill-at-round resume
+# ---------------------------------------------------------------------------
+
+
+def test_preemption_guard_latches_signal_and_restores_handlers():
+    before = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard() as guard:
+        assert guard.requested is None
+        os.kill(os.getpid(), signal.SIGTERM)
+        # delivery is synchronous for self-signals on the main thread
+        assert guard.requested == signal.SIGTERM
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+def test_kill_at_round_saves_then_resume_matches_uninterrupted(tmp_path):
+    """The FaultPlan kill arm: training raises Preempted after crossing the
+    kill round (checkpoint already saved); resume=True with the SAME plan
+    sails past the kill (it only fires when the round is crossed) and lands
+    on the uninterrupted run's exact results."""
+    cfg = TrainConfig(epochs=6, batch_size=8, patience=50)
+
+    def sites(which):
+        return _toy_sites(2, n=40, seed=4) if which == "train" \
+            else _toy_sites(2, n=16, seed=5)
+
+    _, res_full = _fit(cfg, sites, host_mesh(2), out_dir=str(tmp_path / "full"))
+
+    # 40 samples / batch 8 → 5 rounds per epoch; kill crossing in epoch 3
+    plan = FaultPlan(kill_at_round=12)
+    with pytest.raises(Preempted) as exc:
+        _fit(cfg, sites, host_mesh(2), fault_plan=plan,
+             out_dir=str(tmp_path / "killed"))
+    assert exc.value.epoch == 3
+    ck = tmp_path / "killed/remote/simulatorRun/FS-Classification/fold_0/checkpoint_latest.msgpack"
+    assert ck.exists()
+
+    _, res_res = _fit(cfg, sites, host_mesh(2), fault_plan=plan,
+                      out_dir=str(tmp_path / "killed"), resume=True)
+    assert res_res["test_metrics"] == res_full["test_metrics"]
+    assert res_res["best_val_epoch"] == res_full["best_val_epoch"]
+    np.testing.assert_allclose(res_res["epoch_losses"],
+                               res_full["epoch_losses"], atol=1e-6)
+
+    # rotate-window crash: a kill between os.replace(ckpt → .prev) and the
+    # new primary's write leaves ONLY .prev — resume must fall back to it
+    # (one replayed epoch) instead of silently restarting from scratch
+    assert os.path.exists(str(ck) + ".prev")
+    os.remove(ck)
+    with pytest.warns(UserWarning, match="falling back"):
+        _, res_prev = _fit(cfg, sites, host_mesh(2), fault_plan=plan,
+                           out_dir=str(tmp_path / "killed"), resume=True)
+    assert res_prev["test_metrics"] == res_full["test_metrics"]
+    np.testing.assert_allclose(res_prev["epoch_losses"],
+                               res_full["epoch_losses"], atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# retry / backoff + distributed shutdown + runner discovery hardening
+# ---------------------------------------------------------------------------
+
+
+def test_with_retry_retries_then_succeeds():
+    calls, delays = [], []
+
+    @with_retry(attempts=3, base_delay=0.1, retry_on=(OSError,), seed=0,
+                sleep=delays.append)
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert flaky() == "ok"
+    assert len(calls) == 3 and len(delays) == 2
+    # exponential envelope with jitter in [0.5, 1.5)
+    assert 0.05 <= delays[0] < 0.15
+    assert 0.10 <= delays[1] < 0.30
+    # deterministic under a fixed seed
+    calls2, delays2 = [], []
+
+    @with_retry(attempts=3, base_delay=0.1, retry_on=(OSError,), seed=0,
+                sleep=delays2.append)
+    def flaky2():
+        calls2.append(1)
+        if len(calls2) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    flaky2()
+    assert delays2 == delays
+
+
+def test_with_retry_exhaustion_and_nonretryable():
+    attempts = []
+
+    @with_retry(attempts=2, base_delay=0.0, retry_on=(OSError,),
+                sleep=lambda _: None)
+    def always_fails():
+        attempts.append(1)
+        raise OSError("down")
+
+    with pytest.raises(OSError, match="down"):
+        always_fails()
+    assert len(attempts) == 2
+
+    @with_retry(attempts=3, retry_on=(OSError,), sleep=lambda _: None)
+    def wrong_kind():
+        attempts.append("v")
+        raise ValueError("logic bug")
+
+    with pytest.raises(ValueError):
+        wrong_kind()
+    assert attempts.count("v") == 1  # no retries for non-transient errors
+
+
+def test_distributed_shutdown_resets_init_flag(monkeypatch):
+    from dinunet_implementations_tpu.parallel import distributed as dist
+
+    called = []
+    monkeypatch.setattr(jax.distributed, "shutdown", lambda: called.append(1))
+    monkeypatch.setattr(dist, "_initialized", True)
+    dist.distributed_shutdown()
+    assert called == [1] and dist._initialized is False
+    # idempotent: a second call must not touch the (dead) runtime again
+    dist.distributed_shutdown()
+    assert called == [1]
+
+
+def test_discover_site_dirs_survives_mixed_local_trees(tmp_path):
+    """Regression: a ``local`` dir with no digits (e.g. input/local/
+    simulatorRun) or digits elsewhere in the path must neither crash the
+    numeric sort nor scramble site order."""
+    from dinunet_implementations_tpu.runner import discover_site_dirs
+
+    root = tmp_path / "data2"  # digit in the tree, outside the site segment
+    for name in ("local", "local10", "local2"):
+        (root / "input" / name / "simulatorRun").mkdir(parents=True)
+    dirs = discover_site_dirs(str(root))
+    names = [p.split(os.sep)[-2] for p in dirs]
+    assert names == ["local", "local2", "local10"]  # numeric, not lexicographic
+    # no local* dirs → the dataset dir itself is the single site
+    assert discover_site_dirs(str(tmp_path / "nope")) == [str(tmp_path / "nope")]
+
+
+# ---------------------------------------------------------------------------
+# chaos integration (slow): SIGTERM crash-resume, dropout convergence floor,
+# and the full acceptance scenario
+# ---------------------------------------------------------------------------
+
+
+def _run_worker(out_dir, epochs, resume=False, kill_after_epoch=None,
+                timeout=300):
+    worker = os.path.join(os.path.dirname(__file__), "preempt_worker.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    args = [sys.executable, "-u", worker, str(out_dir), str(epochs)]
+    if resume:
+        args.append("--resume")
+    proc = subprocess.Popen(args, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True, env=env)
+    lines, deadline = [], time.monotonic() + timeout
+    killed = False
+    while proc.poll() is None and time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            continue
+        lines.append(line)
+        if (kill_after_epoch is not None and not killed
+                and f"epoch {kill_after_epoch}:" in line):
+            proc.send_signal(signal.SIGTERM)
+            killed = True
+    try:
+        proc.wait(timeout=max(deadline - time.monotonic(), 1))
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    lines.extend(proc.stdout.readlines())
+    return proc.returncode, "".join(lines)
+
+
+@pytest.mark.slow
+def test_sigterm_crash_resume_equivalence(tmp_path):
+    """Kill a real training process with SIGTERM mid-fit: it must save and
+    exit 143; resuming must land on the uninterrupted run's exact metrics."""
+    rc_full, out_full = _run_worker(tmp_path / "full", epochs=12)
+    assert rc_full == 0, out_full
+    res_full = json.load(open(tmp_path / "full" / "results.json"))
+
+    kdir = tmp_path / "killed"
+    rc_kill, out_kill = _run_worker(kdir, epochs=12, kill_after_epoch=3)
+    assert rc_kill == 128 + signal.SIGTERM, out_kill
+    assert "PREEMPTED" in out_kill
+    assert not (kdir / "results.json").exists()
+    ck = kdir / "remote/simulatorRun/FS-Classification/fold_0/checkpoint_latest.msgpack"
+    assert ck.exists(), out_kill
+
+    rc_res, out_res = _run_worker(kdir, epochs=12, resume=True)
+    assert rc_res == 0, out_res
+    res_res = json.load(open(kdir / "results.json"))
+    assert res_res["test_metrics"] == res_full["test_metrics"]
+    assert res_res["best_val_epoch"] == res_full["best_val_epoch"]
+    np.testing.assert_allclose(res_res["epoch_losses"],
+                               res_full["epoch_losses"], atol=1e-6)
+
+
+@pytest.mark.slow
+def test_site_dropout_convergence_floor():
+    """Losing 2 of 4 sites mid-training must degrade gracefully: the
+    federation keeps training on the survivors and still clears a
+    reference-grade AUC floor on the separable toy task."""
+    cfg = TrainConfig(epochs=15, batch_size=8, patience=50, learning_rate=1e-2)
+    # 40 samples / batch 8 → 5 rounds/epoch; sites 2 & 3 die at epoch 6
+    plan = FaultPlan(drop=((2, 25, -1), (3, 25, -1)))
+
+    def sites(which):
+        n, seed = (40, 1) if which == "train" else (24, 2 if which == "val" else 3)
+        return _toy_sites(4, n=n, seed=seed)
+
+    _, res = _fit(cfg, sites, None, fault_plan=plan)
+    health = res["site_health"]
+    assert health["site_skipped_rounds"][2] == 50  # epochs 6-15 × 5 rounds
+    assert health["site_skipped_rounds"][3] == 50
+    assert health["site_quarantined"] == [0, 0, 0, 0]
+    assert res["test_scores"]["auc"] > 0.85, (
+        f"dropout broke convergence: {res['test_scores']}")
+
+
+@pytest.mark.slow
+def test_chaos_acceptance_8_sites(tmp_path):
+    """The ISSUE acceptance scenario: 8 sites, 2 dropping mid-training, one
+    site NaN-poisoned into quarantine, under a seeded FaultPlan — the run
+    completes, quarantines exactly the poisoned site, compiles exactly one
+    epoch program (no per-mask recompile), and the kill-at-round arm resumes
+    to the uninterrupted faulted baseline's exact metrics."""
+    # 24 samples / batch 8 → 3 rounds/epoch, 8 epochs = 24 rounds.
+    # Sites 5 & 6 drop from round 9 (epoch 4); site 2's inputs go NaN for
+    # rounds 4-6 → quarantined (quarantine_rounds=3) from round 7 on.
+    faults = dict(drop=((5, 9, -1), (6, 9, -1)),
+                  nan_at=((4, 2), (5, 2), (6, 2)))
+    cfg = TrainConfig(epochs=8, batch_size=8, patience=50, quarantine_rounds=3)
+
+    def sites(which):
+        n, seed = (24, 1) if which == "train" else (16, 2 if which == "val" else 3)
+        return _toy_sites(8, n=n, seed=seed)
+
+    # --- clean run: the compiled-program-count yardstick
+    tr_clean, res_clean = _fit(cfg, sites, None)
+
+    # --- faulted, uninterrupted: the kill arm's baseline
+    plan = FaultPlan(**faults)
+    tr_fault, res_fault = _fit(cfg, sites, None, fault_plan=plan)
+    health = res_fault["site_health"]
+    assert health["site_quarantined"] == [0, 0, 1, 0, 0, 0, 0, 0]
+    # site 2: rounds 4-6 non-finite + quarantined 7..23 → 20 skips
+    assert health["site_skipped_rounds"][2] == 20
+    # sites 5/6: rounds 9..23 dropped → 15 skips
+    assert health["site_skipped_rounds"][5] == 15
+    assert health["site_skipped_rounds"][6] == 15
+    assert np.isfinite(res_fault["epoch_losses"]).all()
+
+    # no per-mask recompile: same compiled-program count as the clean run
+    for tr in (tr_clean, tr_fault):
+        cache_size = getattr(tr.epoch_fn, "_cache_size", None)
+        if cache_size is not None:
+            assert cache_size() == 1
+
+    # --- kill arm: same faults + kill at round 14 (epoch 5), then resume
+    plan_kill = FaultPlan(kill_at_round=14, **faults)
+    with pytest.raises(Preempted):
+        _fit(cfg, sites, None, fault_plan=plan_kill,
+             out_dir=str(tmp_path / "killed"))
+    _, res_resumed = _fit(cfg, sites, None, fault_plan=plan_kill,
+                          out_dir=str(tmp_path / "killed"), resume=True)
+    assert res_resumed["test_metrics"] == res_fault["test_metrics"]
+    np.testing.assert_allclose(res_resumed["epoch_losses"],
+                               res_fault["epoch_losses"], atol=1e-6)
+    assert res_resumed["site_health"] == health

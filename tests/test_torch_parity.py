@@ -20,6 +20,7 @@ it (equal per-site batches here, so it equals the plain mean).
 """
 
 import importlib.util
+import os
 
 import jax
 import jax.numpy as jnp
@@ -39,6 +40,15 @@ from dinunet_implementations_tpu.trainer import (
 
 IN, HIDDEN, OUT = 12, (16, 8), 2
 SITES, B, LR = 2, 6, 1e-3
+
+_REF_MODELS = "/root/reference/comps/fs/models.py"
+
+#: the module-level tests below that load the reference's own torch MSANNet
+#: must skip (not error) on containers without the reference checkout — the
+#: same needs_reference contract as tests/test_runner.py
+needs_reference_models = pytest.mark.skipif(
+    not os.path.exists(_REF_MODELS), reason="reference checkout not mounted"
+)
 
 
 def _load_ref_msannet():
@@ -78,6 +88,7 @@ def _torch_params_as_tree(tm):
 
 
 @pytest.mark.slow
+@needs_reference_models
 def test_federated_dsgd_adam_round_matches_torch():
     rng = np.random.default_rng(0)
     x = rng.normal(size=(SITES, 1, B, IN)).astype(np.float32)
@@ -137,6 +148,7 @@ def test_federated_dsgd_adam_round_matches_torch():
 
 
 @pytest.mark.slow
+@needs_reference_models
 def test_unequal_site_batches_weighted_average_matches_torch():
     """Heterogeneous site sizes (the 73-120 subject spread, SURVEY §7): the
     jax engine weights by example count; torch mirror must too."""
