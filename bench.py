@@ -275,13 +275,32 @@ def _setup_epoch(engine_name: str = "dSGD", engine_kw: dict | None = None,
     if fault_plan is not None and fault_plan.injects_faults():
         # rounds == steps at local_iterations=1; the first epoch's window
         live = jnp.asarray(fault_plan.liveness(S, 0, steps))
-    # resident epoch inputs live in the layout the executable wants (the
-    # per-epoch on-device relayout copy moves into this one-time device_put)
-    epoch_fn, put_x = compile_epoch_aot(epoch_fn, state0, x, y, w, live=live)
-    x = put_x(x)
+
+    from dinunet_implementations_tpu.checks.sanitize import (
+        CompileGuard,
+        sanitize_enabled,
+    )
+
+    guard = None
+    if sanitize_enabled():
+        # --sanitize / DINUNET_SANITIZE=1: keep the PLAIN jitted epoch (its
+        # compile cache is introspectable; the AOT path compiles exactly once
+        # by construction, so there is nothing to guard there) and check the
+        # compile counter after every timed chain — a chain that recompiles
+        # is measuring compilation, not the federated round.
+        guard = CompileGuard({"epoch_fn": epoch_fn}, label=engine_name)
+    else:
+        # resident epoch inputs live in the layout the executable wants (the
+        # per-epoch on-device relayout copy moves into this one-time
+        # device_put)
+        epoch_fn, put_x = compile_epoch_aot(epoch_fn, state0, x, y, w, live=live)
+        x = put_x(x)
 
     def run_chain(k: int) -> float:
-        return chain_epochs(epoch_fn, state0, x, y, w, k, live=live)
+        t = chain_epochs(epoch_fn, state0, x, y, w, k, live=live)
+        if guard is not None:
+            guard.check(context=f"engine={engine_name}, chain={k} epochs")
+        return t
 
     return run_chain, S * steps * B
 
@@ -398,6 +417,14 @@ SMALL_DIMS = dict(sites=32, steps=2, batch=4, windows=6, comps=8, wlen=4,
 
 
 def main():
+    if "--sanitize" in sys.argv:
+        # runtime sanitizer (dinunet_implementations_tpu/checks/sanitize.py):
+        # compile-counter guard over the bench's epoch program — same env
+        # contract as the trainer CLI: the explicit flag WINS over any
+        # DINUNET_SANITIZE value left in the shell (incl. "0")
+        import os
+
+        os.environ["DINUNET_SANITIZE"] = "compile"
     baseline = CPU_BASELINE_SAMPLES_PER_SEC
     if "--live-baseline" in sys.argv:
         try:

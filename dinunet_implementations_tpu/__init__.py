@@ -26,12 +26,16 @@ from .core.config import (
 )
 from .parallel.mesh import MODEL_AXIS, SITE_AXIS, host_mesh, make_site_mesh
 
-__version__ = "0.2.0"
+__version__ = "0.4.0"
 
 
 def __getattr__(name):
     # Heavier subsystems are imported lazily so `import dinunet_implementations_tpu`
     # stays light for config-only uses.
+    if name in ("run_checks", "sanitized_fit", "SanitizerViolation", "CompileGuard"):
+        from . import checks
+
+        return getattr(checks, name)
     if name in ("FedRunner", "SiteRunner"):
         from .runner import fed_runner
 

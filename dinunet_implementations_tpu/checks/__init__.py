@@ -1,0 +1,65 @@
+"""jaxlint — codebase-specific SPMD-invariant analysis + runtime sanitizer.
+
+The last two PRs each shipped fixes for bug classes that are mechanically
+detectable (the fold-crossing ``self.cfg`` mutation; blanket handlers that
+would have swallowed ``Preempted``). This package checks those invariants
+up front instead of re-discovering them per PR:
+
+Static rules (``python -m dinunet_implementations_tpu.checks``):
+
+- **R001** no ``print()`` outside the CLI/demo/report allowlist — library
+  output goes through the level-gated logger in ``trainer/logs.py``;
+- **R002** no bare ``except:`` / ``except BaseException:`` anywhere (the
+  ``Preempted`` shutdown contract), and no silently-swallowing
+  ``except Exception`` inside ``robustness/``, ``trainer/``, ``runner/``;
+- **R003** collective axis names resolve to the ``parallel/mesh.py``
+  constants (``SITE_AXIS``/``MODEL_AXIS``/``FOLD_AXIS``), never ad-hoc
+  string literals;
+- **R004** no mutation of ``cfg``/``self.cfg`` fields outside
+  ``core/config.py`` — TrainConfig is shared across folds;
+- **R005** no tracer-escaping casts (``float``/``int``/``np.asarray``/
+  ``.item()``) inside jit-traced code (engines, models, ops, collectives,
+  the step builders, and any ``@jax.jit`` function);
+- **R006** ``TrainState`` fields round-trip through the checkpoint
+  serializer's key set (schema-drift guard).
+
+Findings support inline ``# jaxlint: disable=Rxxx`` suppression and a
+checked-in baseline (``checks/baseline.json``, shipped empty). The analyzer
+half is stdlib-only; the runtime sanitizer (``sanitize.py``,
+``DINUNET_SANITIZE=1``) adds a compile-counter guard, leak checking, and
+debug-NaN mode around real fits.
+"""
+
+from .core import (
+    DEFAULT_BASELINE,
+    PACKAGE_ROOT,
+    Finding,
+    apply_baseline,
+    load_baseline,
+    run_checks,
+    save_baseline,
+)
+from .sanitize import (
+    CompileGuard,
+    SanitizerViolation,
+    jit_cache_size,
+    sanitize_enabled,
+    sanitize_flags,
+    sanitized_fit,
+)
+
+__all__ = [
+    "CompileGuard",
+    "DEFAULT_BASELINE",
+    "Finding",
+    "PACKAGE_ROOT",
+    "SanitizerViolation",
+    "apply_baseline",
+    "jit_cache_size",
+    "load_baseline",
+    "run_checks",
+    "sanitize_enabled",
+    "sanitize_flags",
+    "sanitized_fit",
+    "save_baseline",
+]

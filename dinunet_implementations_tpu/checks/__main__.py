@@ -1,0 +1,70 @@
+"""CLI: ``python -m dinunet_implementations_tpu.checks [paths...]``.
+
+Exit code 0 when every finding is baselined (or there are none), 1 when new
+findings exist — the tier-1/CI lint gate. ``--baseline`` regenerates the
+checked-in baseline from the current findings (for grandfathering during a
+large refactor; the shipped baseline is empty and should stay that way).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .core import (
+    DEFAULT_BASELINE,
+    PACKAGE_ROOT,
+    apply_baseline,
+    load_baseline,
+    run_checks,
+    save_baseline,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m dinunet_implementations_tpu.checks",
+        description="jaxlint: codebase-specific SPMD-invariant analyzer "
+                    "(rules R001-R006; see the checks package docstring).",
+    )
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to scan (default: the installed "
+                        "dinunet_implementations_tpu package)")
+    p.add_argument("--baseline", action="store_true",
+                   help="regenerate the baseline file from the current "
+                        "findings and exit 0")
+    p.add_argument("--baseline-file", default=DEFAULT_BASELINE,
+                   help=f"baseline path (default: {DEFAULT_BASELINE})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: report every finding")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="one JSON object per finding on stdout")
+    args = p.parse_args(argv)
+
+    findings = []
+    for root in (args.paths or [PACKAGE_ROOT]):
+        findings.extend(run_checks(root))
+
+    if args.baseline:
+        path = save_baseline(findings, args.baseline_file)
+        print(f"jaxlint: wrote {len(findings)} baseline entries to {path}")
+        return 0
+
+    baseline = [] if args.no_baseline else load_baseline(args.baseline_file)
+    new, matched = apply_baseline(findings, baseline)
+    if args.as_json:
+        for f in new:
+            print(json.dumps(f.to_dict()))
+    else:
+        for f in new:
+            print(f.format())
+    tail = f"jaxlint: {len(new)} finding(s)"
+    if matched:
+        tail += f" ({matched} baselined)"
+    print(tail, file=sys.stderr)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,555 @@
+"""jaxlint rules R001-R006 — the codebase-specific SPMD invariants.
+
+Every rule carries the invariant it protects and the incident that motivated
+it (see docs/ARCHITECTURE.md "Static analysis & sanitizer" for the operator
+view). Rules are pure AST passes over :class:`~.core.SourceFile`; scoping is
+by path relative to the scan root, so the same rules run unchanged over the
+real package and over test fixture trees.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Callable, Iterable, Iterator
+
+from .core import Finding, SourceFile
+
+# -- scoping tables ---------------------------------------------------------
+
+#: R001 — modules whose print() IS the product (CLI/report/demo surfaces).
+PRINT_ALLOWED_FILES = {
+    "runner/cli.py",  # the operational CLI: JSON result lines on stdout
+    "data/demo.py",  # demo-tree generator CLI
+    "analysis.py",  # notebook-parity report CLI (prints summary_markdown)
+    "checks/__main__.py",  # this analyzer's own CLI
+}
+
+#: R002 — packages where a swallowed ``except Exception`` can eat the
+#: ``Preempted``/fault-tolerance contract's neighbors (broad handlers around
+#: round/checkpoint/runner code hid real faults twice before PR 2).
+#: parallel/ and native/ joined the scope when their grandfathered broad
+#: handlers were narrowed to concrete types (this PR).
+SWALLOW_SCOPED_DIRS = ("robustness/", "trainer/", "runner/", "parallel/", "native/")
+
+#: R003 — collective ops and the positional index of their axis-name operand.
+COLLECTIVE_AXIS_ARG = {
+    "psum": 1,
+    "pmean": 1,
+    "pmax": 1,
+    "pmin": 1,
+    "psum_scatter": 1,
+    "all_gather": 1,
+    "all_to_all": 1,
+    "ppermute": 1,
+    "axis_index": 0,
+    "axis_size": 0,
+}
+
+#: R005 — modules whose function bodies execute under jit tracing by design
+#: (reached from the compiled epoch/eval step): every engine/model/kernel,
+#: the collectives/sequence helpers, and the step builders themselves.
+TRACED_MODULE_DIRS = ("engines/", "models/", "ops/")
+TRACED_MODULE_FILES = {
+    "trainer/steps.py",
+    "parallel/collectives.py",
+    "parallel/sequence.py",
+}
+
+#: R005 — host-only escapes: these force a traced value concrete and either
+#: crash under jit or silently freeze a runtime value into the compiled
+#: program as a constant.
+ESCAPE_NAME_CALLS = {"float", "int", "bool"}
+ESCAPE_NP_ATTRS = {"asarray", "array"}
+ESCAPE_METHOD_CALLS = {"item", "tolist"}
+NUMPY_MODULE_NAMES = {"np", "numpy", "onp"}
+
+#: R004 — the one module allowed to construct/mutate TrainConfig state.
+CONFIG_MODULE = "core/config.py"
+
+#: R006 — the two files whose schemas must agree.
+TRAIN_STATE_FILE = "trainer/steps.py"
+CHECKPOINT_FILE = "trainer/checkpoint.py"
+#: payload keys that are serializer bookkeeping, not TrainState fields
+CHECKPOINT_EXTRA_KEYS = {"meta_json"}
+
+
+# -- registry ---------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Rule:
+    id: str
+    title: str
+    fixit: str
+    fn: Callable
+    project: bool = False
+
+    def _wrap(self, sf_or_path, hits: Iterable) -> Iterator[Finding]:
+        for hit in hits:
+            if isinstance(hit, Finding):
+                yield hit
+                continue
+            line, col, message = hit
+            sf = sf_or_path
+            yield Finding(
+                rule=self.id, path=sf.relpath, line=line, col=col,
+                message=message, snippet=sf.snippet(line), fixit=self.fixit,
+            )
+
+    def check(self, sf: SourceFile) -> Iterator[Finding]:
+        return self._wrap(sf, self.fn(sf))
+
+    def check_project(self, files: dict[str, SourceFile]) -> Iterator[Finding]:
+        return iter(self.fn(files))
+
+
+RULES: dict[str, Rule] = {}
+PROJECT_RULES: dict[str, Rule] = {}
+
+
+def rule(id: str, title: str, fixit: str, project: bool = False):
+    def deco(fn):
+        r = Rule(id=id, title=title, fixit=fixit, fn=fn, project=project)
+        (PROJECT_RULES if project else RULES)[id] = r
+        return fn
+
+    return deco
+
+
+# -- AST helpers ------------------------------------------------------------
+
+
+def _callee_name(node: ast.Call) -> str | None:
+    """Trailing name of the called thing: ``psum`` for ``jax.lax.psum``."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _is_numpy_attr(f: ast.expr) -> bool:
+    return (
+        isinstance(f, ast.Attribute)
+        and f.attr in ESCAPE_NP_ATTRS
+        and isinstance(f.value, ast.Name)
+        and f.value.id in NUMPY_MODULE_NAMES
+    )
+
+
+def _names_exception(node: ast.expr | None, name: str) -> bool:
+    """Does an ``except`` type expression mention ``name`` (directly or in a
+    tuple)?"""
+    if node is None:
+        return False
+    if isinstance(node, ast.Name):
+        return node.id == name
+    if isinstance(node, ast.Attribute):
+        return node.attr == name
+    if isinstance(node, ast.Tuple):
+        return any(_names_exception(e, name) for e in node.elts)
+    return False
+
+
+_LOGGING_ATTRS = {
+    "warn", "warning", "error", "exception", "critical", "info", "debug", "log",
+}
+
+
+def _handler_surfaces(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body re-raises or logs — i.e. the failure is
+    surfaced somewhere instead of silently swallowed."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _LOGGING_ATTRS:
+                return True
+            if isinstance(f, ast.Name) and f.id in ("print",) | _LOGGING_ATTRS:
+                return True
+    return False
+
+
+def _is_jit_decorator(dec: ast.expr) -> bool:
+    """``@jit`` / ``@jax.jit`` / ``@jax.jit(...)`` / ``@partial(jax.jit, ...)``."""
+    if isinstance(dec, ast.Call):
+        f = dec.func
+        if isinstance(f, (ast.Name, ast.Attribute)):
+            name = f.id if isinstance(f, ast.Name) else f.attr
+            if name == "jit":
+                return True
+            if name == "partial" and dec.args and _is_jit_decorator(dec.args[0]):
+                return True
+        return False
+    if isinstance(dec, ast.Name):
+        return dec.id == "jit"
+    if isinstance(dec, ast.Attribute):
+        return dec.attr == "jit"
+    return False
+
+
+def _in_traced_module(relpath: str) -> bool:
+    return relpath in TRACED_MODULE_FILES or any(
+        relpath.startswith(d) for d in TRACED_MODULE_DIRS
+    )
+
+
+def _is_cfg_expr(node: ast.expr) -> bool:
+    """``cfg`` / ``self.cfg`` / ``<anything>.cfg`` — the shared TrainConfig
+    object."""
+    if isinstance(node, ast.Name):
+        return node.id == "cfg"
+    if isinstance(node, ast.Attribute):
+        return node.attr == "cfg"
+    return False
+
+
+# -- R001 -------------------------------------------------------------------
+
+
+@rule(
+    "R001",
+    "no print() in library code",
+    "route output through trainer/logs.py (level-gated logger: log_info / "
+    "log_warning), or allowlist the module if its stdout IS the product",
+)
+def r001_no_print(sf: SourceFile):
+    """Hot-path ``print()`` bypasses log levels, multi-host coordinator
+    gating, and every downstream consumer of the structured logs — PR 2's
+    round loop printed per-epoch lines that could not be silenced or
+    captured. Only CLI/demo/report surfaces may print."""
+    if sf.relpath in PRINT_ALLOWED_FILES:
+        return
+    for node in ast.walk(sf.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "print"
+        ):
+            yield (
+                node.lineno, node.col_offset,
+                "print() outside the CLI/demo allowlist",
+            )
+
+
+# -- R002 -------------------------------------------------------------------
+
+
+@rule(
+    "R002",
+    "no bare/blanket exception handlers",
+    "name the concrete exception types the code can actually raise (with a "
+    "comment naming the failure mode); never catch BaseException — it "
+    "swallows Preempted/KeyboardInterrupt (the robustness/preemption.py "
+    "shutdown contract)",
+)
+def r002_exception_hygiene(sf: SourceFile):
+    """``Preempted(BaseException)`` exists precisely so recovery code cannot
+    eat a shutdown request; a bare ``except:`` or ``except BaseException``
+    re-opens that hole anywhere, and inside robustness/trainer/runner even an
+    ``except Exception`` that silently swallows hides real faults (the bug
+    class PR 2 was built to kill)."""
+    scoped = sf.relpath.startswith(SWALLOW_SCOPED_DIRS)
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            yield (
+                node.lineno, node.col_offset,
+                "bare 'except:' catches BaseException (incl. Preempted / "
+                "KeyboardInterrupt)",
+            )
+        elif _names_exception(node.type, "BaseException"):
+            yield (
+                node.lineno, node.col_offset,
+                "'except BaseException' swallows the Preempted shutdown "
+                "contract",
+            )
+        elif (
+            scoped
+            and _names_exception(node.type, "Exception")
+            and not _handler_surfaces(node)
+        ):
+            yield (
+                node.lineno, node.col_offset,
+                "'except Exception' here swallows failures without re-raise "
+                "or logging (fault-tolerance scope: robustness/, trainer/, "
+                "runner/)",
+            )
+
+
+# -- R003 -------------------------------------------------------------------
+
+
+@rule(
+    "R003",
+    "collective axis names come from parallel/mesh.py constants",
+    "use SITE_AXIS / MODEL_AXIS / FOLD_AXIS from parallel/mesh.py (or a "
+    "variable bound to them) instead of an ad-hoc string literal",
+)
+def r003_axis_constants(sf: SourceFile):
+    """Every collective across the ~10 modules using them must agree on the
+    mesh/fold axis names; a duplicated string literal compiles fine until one
+    call site drifts, and then the psum silently reduces over the wrong axis
+    (the DrJAX axis-name-consistency invariant, arXiv:2403.07128)."""
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _callee_name(node)
+        axis_args: list[ast.expr] = []
+        if name in COLLECTIVE_AXIS_ARG:
+            pos = COLLECTIVE_AXIS_ARG[name]
+            if len(node.args) > pos:
+                axis_args.append(node.args[pos])
+        for kw in node.keywords:
+            if kw.arg in ("axis_name", "axis_names"):
+                axis_args.append(kw.value)
+        for arg in axis_args:
+            consts: list[ast.Constant] = []
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                consts.append(arg)
+            elif isinstance(arg, ast.Tuple):
+                consts.extend(
+                    e for e in arg.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                )
+            for c in consts:
+                yield (
+                    c.lineno, c.col_offset,
+                    f"axis name string literal {c.value!r} in collective/"
+                    f"axis argument",
+                )
+
+
+# -- R004 -------------------------------------------------------------------
+
+
+@rule(
+    "R004",
+    "TrainConfig is immutable outside core/config.py",
+    "build a NEW config with cfg.replace(field=...) and thread it locally; "
+    "the config object is shared across folds and callers",
+)
+def r004_no_cfg_mutation(sf: SourceFile):
+    """PR 1's fold bug: the batch-size clamp wrote ``self.cfg.batch_size``,
+    and because FedRunner hands ONE config object to every fold's trainer, a
+    fold with small sites silently shrank the batch for all later folds.
+    Mutation of ``cfg``/``self.cfg`` fields anywhere outside construction is
+    that bug waiting to recur."""
+    if sf.relpath == CONFIG_MODULE:
+        return
+    for node in ast.walk(sf.tree):
+        targets: list[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        elif isinstance(node, ast.Call):
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id == "setattr"
+                and node.args
+                and _is_cfg_expr(node.args[0])
+            ):
+                yield (
+                    node.lineno, node.col_offset,
+                    "setattr on a shared TrainConfig object",
+                )
+            continue
+        for t in targets:
+            if isinstance(t, ast.Attribute) and _is_cfg_expr(t.value):
+                yield (
+                    t.lineno, t.col_offset,
+                    f"mutates shared TrainConfig field '.{t.attr}' outside "
+                    f"{CONFIG_MODULE}",
+                )
+
+
+# -- R005 -------------------------------------------------------------------
+
+
+@rule(
+    "R005",
+    "no tracer-escaping casts in jit-traced code",
+    "keep the value traced (jnp ops) or move the cast to the host side of "
+    "the jit boundary; a genuinely static shape/int needs an inline "
+    "'# jaxlint: disable=R005' with a comment saying why it is static",
+)
+def r005_no_tracer_escapes(sf: SourceFile):
+    """``float()``/``int()``/``np.asarray``/``.item()`` on a traced value
+    either raises ``ConcretizationTypeError`` mid-refactor or — worse —
+    silently bakes a runtime value into the compiled program as a constant,
+    which then recompiles per distinct value (the one-compilation-per-program
+    invariant the sanitizer's compile counter enforces at runtime)."""
+    traced_module = _in_traced_module(sf.relpath)
+
+    def scan(body: list[ast.stmt], traced: bool):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn_traced = traced or traced_module or any(
+                    _is_jit_decorator(d) for d in stmt.decorator_list
+                )
+                yield from scan(stmt.body, fn_traced)
+                continue
+            if isinstance(stmt, ast.ClassDef):
+                yield from scan(stmt.body, traced)
+                continue
+            if not traced:
+                # still need to find nested defs inside non-traced statements
+                for node in ast.walk(stmt):
+                    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        fn_traced = traced_module or any(
+                            _is_jit_decorator(d) for d in node.decorator_list
+                        )
+                        if fn_traced:
+                            yield from scan(node.body, True)
+                continue
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if isinstance(f, ast.Name) and f.id in ESCAPE_NAME_CALLS:
+                    yield (
+                        node.lineno, node.col_offset,
+                        f"'{f.id}()' concretizes a traced value",
+                    )
+                elif _is_numpy_attr(f):
+                    yield (
+                        node.lineno, node.col_offset,
+                        f"'np.{f.attr}' pulls a traced value to host numpy",
+                    )
+                elif (
+                    isinstance(f, ast.Attribute)
+                    and f.attr in ESCAPE_METHOD_CALLS
+                    and not node.args
+                ):
+                    yield (
+                        node.lineno, node.col_offset,
+                        f"'.{f.attr}()' forces a device transfer",
+                    )
+
+    yield from scan(sf.tree.body, False)
+
+
+# -- R006 -------------------------------------------------------------------
+
+
+def _train_state_fields(sf: SourceFile) -> list[str] | None:
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ClassDef) and node.name == "TrainState":
+            return [
+                s.target.id
+                for s in node.body
+                if isinstance(s, ast.AnnAssign) and isinstance(s.target, ast.Name)
+            ]
+    return None
+
+
+def _dict_str_keys(d: ast.Dict) -> list[str]:
+    return [
+        k.value for k in d.keys
+        if isinstance(k, ast.Constant) and isinstance(k.value, str)
+    ]
+
+
+def _assigned_dict_keys(fn: ast.FunctionDef, var: str) -> list[str] | None:
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == var for t in node.targets
+            )
+            and isinstance(node.value, ast.Dict)
+        ):
+            return _dict_str_keys(node.value)
+    return None
+
+
+def _popped_keys(fn: ast.FunctionDef) -> set[str]:
+    keys: set[str] = set()
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("pop", "get")
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            keys.add(node.args[0].value)
+    return keys
+
+
+@rule(
+    "R006",
+    "TrainState fields round-trip through the checkpoint serializer",
+    "add the field to save_checkpoint's payload dict AND to "
+    "load_checkpoint's template/pop set in trainer/checkpoint.py (or remove "
+    "the stale payload key)",
+    project=True,
+)
+def r006_checkpoint_schema(files: dict[str, SourceFile]):
+    """A ``TrainState`` field the serializer does not carry silently resets
+    on every resume (the ``health`` counters were one checkpoint-schema edit
+    away from exactly that in PR 2); a payload key with no backing field is a
+    stale schema that masks the next drift. Verified statically: field set ==
+    save-payload key set == load-side (template + tolerant-pop) key set."""
+    steps = files.get(TRAIN_STATE_FILE)
+    ckpt = files.get(CHECKPOINT_FILE)
+    if steps is None or ckpt is None:
+        return []  # fixture trees without the pair: nothing to verify
+    out: list[Finding] = []
+
+    def finding(sf: SourceFile, line: int, msg: str) -> Finding:
+        return Finding(
+            rule="R006", path=sf.relpath, line=line, col=0, message=msg,
+            snippet=sf.snippet(line), fixit=PROJECT_RULES["R006"].fixit,
+        )
+
+    fields = _train_state_fields(steps)
+    if fields is None:
+        return [finding(steps, 1, "TrainState class not found — cannot "
+                                  "verify checkpoint schema")]
+    save_fn = next(
+        (n for n in ast.walk(ckpt.tree)
+         if isinstance(n, ast.FunctionDef) and n.name == "save_checkpoint"),
+        None,
+    )
+    load_fn = next(
+        (n for n in ast.walk(ckpt.tree)
+         if isinstance(n, ast.FunctionDef) and n.name == "load_checkpoint"),
+        None,
+    )
+    if save_fn is None or load_fn is None:
+        return [finding(ckpt, 1, "save_checkpoint/load_checkpoint not found "
+                                 "— cannot verify checkpoint schema")]
+    payload = _assigned_dict_keys(save_fn, "payload")
+    if payload is None:
+        return [finding(ckpt, save_fn.lineno,
+                        "save_checkpoint has no literal 'payload' dict — "
+                        "cannot verify checkpoint schema")]
+    template = _assigned_dict_keys(load_fn, "template") or []
+    load_keys = set(template) | _popped_keys(load_fn)
+    for f in fields:
+        if f not in payload:
+            out.append(finding(
+                ckpt, save_fn.lineno,
+                f"TrainState field '{f}' is not serialized by "
+                f"save_checkpoint — it silently resets on resume",
+            ))
+        if f not in load_keys:
+            out.append(finding(
+                ckpt, load_fn.lineno,
+                f"TrainState field '{f}' is not restored by load_checkpoint",
+            ))
+    for k in payload:
+        if k not in fields and k not in CHECKPOINT_EXTRA_KEYS:
+            out.append(finding(
+                ckpt, save_fn.lineno,
+                f"checkpoint payload key '{k}' has no TrainState field "
+                f"(stale schema)",
+            ))
+    return out

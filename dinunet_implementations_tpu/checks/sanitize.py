@@ -1,0 +1,185 @@
+"""Runtime sanitizer: compile-counter guard + leak/NaN checking for a fit.
+
+The static rules (rules.py) catch what an AST can see; this module checks the
+dynamic halves of the same invariants while a real fit runs:
+
+- **compile counter** — the one-compilation-per-(engine, topology) property.
+  PR 2 asserted it in one test via the jitted function's private
+  ``_cache_size``; here that becomes a reusable guard: a fit whose
+  ``epoch_fn`` compiles more than once (shape drift, a traced value baked
+  static, a per-fault-pattern recompile) fails loudly with the round/site
+  context from ``TrainState.health``.
+- **leak checking** — ``jax.checking_leaks`` around the fit surfaces tracer
+  leaks out of the jitted epoch/eval closures.
+- **debug-NaN** — ``jax_debug_nans`` pinpoints the op that produced a
+  non-finite value (NOT for FaultPlan NaN-injection runs, where NaNs are the
+  test stimulus — use ``DINUNET_SANITIZE=compile,leaks`` there).
+
+Activation: ``DINUNET_SANITIZE=1`` (all checks) or a comma subset
+(``compile``, ``leaks``, ``nans``); the CLI and bench.py expose ``--sanitize``
+as sugar for the env var. Disabled (the default) every hook below is a no-op
+costing one dict lookup — the sanitizer is a debug mode, not a tax.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from contextlib import contextmanager
+
+ALL_FLAGS = ("compile", "leaks", "nans")
+ENV_VAR = "DINUNET_SANITIZE"
+
+
+class SanitizerViolation(RuntimeError):
+    """A runtime invariant the sanitizer guards was violated."""
+
+
+def sanitize_flags(value: str | None = None) -> frozenset[str]:
+    """Parse ``DINUNET_SANITIZE`` (or an explicit ``value``) into the active
+    check set. ``""``/``0``/``false`` → none; ``1``/``true``/``all`` → all;
+    otherwise a comma list of flag names."""
+    raw = os.environ.get(ENV_VAR, "") if value is None else value
+    raw = (raw or "").strip().lower()
+    if raw in ("", "0", "false", "off", "no"):
+        return frozenset()
+    if raw in ("1", "true", "on", "yes", "all"):
+        return frozenset(ALL_FLAGS)
+    flags = frozenset(t.strip() for t in raw.split(",") if t.strip())
+    unknown = flags - set(ALL_FLAGS)
+    if unknown:
+        raise ValueError(
+            f"{ENV_VAR}: unknown sanitizer flag(s) {sorted(unknown)}; "
+            f"valid: {ALL_FLAGS} (or 1/0)"
+        )
+    return flags
+
+
+def sanitize_enabled() -> bool:
+    return bool(sanitize_flags())
+
+
+def jit_cache_size(fn) -> int | None:
+    """Number of compiled programs cached on a jitted callable, or ``None``
+    when this jax build does not expose the counter (the guard then degrades
+    to a no-op rather than failing spuriously)."""
+    cs = getattr(fn, "_cache_size", None)
+    if callable(cs):
+        try:
+            return int(cs())
+        except (TypeError, ValueError):
+            return None
+    return None
+
+
+class CompileGuard:
+    """Reusable compile-counter guard over named jitted callables.
+
+    Snapshot the cache sizes at construction, run the workload, then
+    :meth:`check` — more than ``max_compiles`` NEW programs per callable
+    raises :class:`SanitizerViolation`. This is the no-recompile property as
+    a harness: one guard per (engine, topology) fit, or around a bench chain,
+    or in a test.
+    """
+
+    def __init__(self, fns: dict, max_compiles: int = 1, label: str = ""):
+        self.max_compiles = max_compiles
+        self.label = label
+        self._fns = {
+            name: f for name, f in fns.items()
+            if f is not None and jit_cache_size(f) is not None
+        }
+        self._start = {name: jit_cache_size(f) for name, f in self._fns.items()}
+
+    def counts(self) -> dict:
+        """New compilations per guarded callable since construction."""
+        return {
+            name: (jit_cache_size(f) or 0) - self._start[name]
+            for name, f in self._fns.items()
+        }
+
+    def check(self, context: str = "") -> dict:
+        counts = self.counts()
+        for name, delta in counts.items():
+            if delta > self.max_compiles:
+                where = f" [{self.label}]" if self.label else ""
+                ctx = f"\n  context: {context}" if context else ""
+                raise SanitizerViolation(
+                    f"compile-counter guard{where}: '{name}' compiled "
+                    f"{delta} programs (expected <= {self.max_compiles}). "
+                    f"The epoch program must compile once per (engine, "
+                    f"topology); extra compilations mean shape drift or a "
+                    f"traced value being treated as static.{ctx}"
+                )
+        return counts
+
+
+class SanitizeReport:
+    """Mutable holder the fit's caller feeds results into, so a violation
+    message can carry the round/site context from ``TrainState.health``."""
+
+    def __init__(self, label: str = "fit"):
+        self.label = label
+        self.result: dict | None = None
+
+    def note_result(self, result) -> None:
+        if isinstance(result, dict):
+            self.result = result
+
+    def context(self) -> str:
+        if not self.result:
+            return ""
+        parts = []
+        state = self.result.get("state")
+        rnd = getattr(state, "round", None)
+        if rnd is not None:
+            try:
+                parts.append(f"round={int(rnd)}")
+            except (TypeError, ValueError):
+                pass
+        health = self.result.get("site_health")
+        if health:
+            parts.append(f"site_health={health}")
+        if self.result.get("best_val_epoch") is not None:
+            parts.append(f"best_val_epoch={self.result['best_val_epoch']}")
+        return " ".join(parts)
+
+
+@contextmanager
+def sanitized_fit(trainer, label: str = "fit", max_epoch_compiles: int = 1,
+                  flags: frozenset[str] | None = None):
+    """Wrap one ``FederatedTrainer.fit`` in the active sanitizer checks.
+
+    Yields a :class:`SanitizeReport` (feed ``fit``'s result dict into
+    ``note_result`` for violation context), or ``None`` when the sanitizer is
+    disabled. The compile counter is checked AFTER the leak/NaN contexts
+    close, so all compilations — including any the debug modes themselves
+    force — happen under one consistent jax config.
+    """
+    flags = sanitize_flags() if flags is None else frozenset(flags)
+    if not flags:
+        yield None
+        return
+    import jax
+
+    report = SanitizeReport(label=label)
+    with contextlib.ExitStack() as stack:
+        if "nans" in flags:
+            prev = jax.config.jax_debug_nans
+            jax.config.update("jax_debug_nans", True)
+            stack.callback(jax.config.update, "jax_debug_nans", prev)
+        if "leaks" in flags:
+            stack.enter_context(jax.checking_leaks())
+        # epoch_fn only: eval_fn legitimately compiles once per split shape
+        # (validation vs test step counts differ), so its count is not an
+        # invariant — the epoch program's is.
+        guard = (
+            CompileGuard(
+                {"epoch_fn": getattr(trainer, "epoch_fn", None)},
+                max_compiles=max_epoch_compiles, label=label,
+            )
+            if "compile" in flags else None
+        )
+        yield report
+    if guard is not None:
+        guard.check(context=report.context())

@@ -94,5 +94,11 @@ def build_and_load(name: str) -> ctypes.CDLL | None:
         if lst.st_uid != os.getuid() or (lst.st_mode & 0o022):
             return None  # not ours / tamperable — refuse to load
         return ctypes.CDLL(lib_path)
-    except Exception:
+    except (OSError, subprocess.SubprocessError, RuntimeError):
+        # the optional-acceleration failure modes, each → Python fallback:
+        # OSError — g++ missing (FileNotFoundError), stat/chmod/replace on a
+        #   read-only cache, or CDLL failing to load the .so;
+        # SubprocessError — the compile itself failed (CalledProcessError)
+        #   or hit the 120 s timeout (TimeoutExpired);
+        # RuntimeError — _cache_dir() found no trustworthy cache directory.
         return None

@@ -84,12 +84,17 @@ def distributed_init(
                 process_id=process_id,
                 **kwargs,
             )
-        except Exception:
-            # a failed connect leaves jax's module-global client/service SET
+        except (RuntimeError, OSError):
+            # the retryable failure modes: coordinator not up yet (refused
+            # connect → ConnectionError ⊂ OSError), DNS/socket errors, and
+            # jaxlib surfacing a failed join as RuntimeError/XlaRuntimeError.
+            # A failed connect leaves jax's module-global client/service SET
             # (State.initialize assigns self.client before connect() and has
             # no failure cleanup), so a bare retry would die on "initialize
             # should only be called once" instead of retrying the join —
-            # clear the partial state first
+            # clear the partial state first. Non-retryable errors (bad
+            # arguments → ValueError/TypeError) propagate untouched: no
+            # retry will follow, so there is no partial state to clear for.
             _reset_partial_distributed_state()
             raise
 
@@ -121,7 +126,12 @@ def _reset_partial_distributed_state() -> None:
     try:
         jax.distributed.shutdown()
         return
-    except Exception:
+    except (RuntimeError, OSError, AttributeError):
+        # the shutdown-on-partial-state failure modes: RuntimeError (incl.
+        # XlaRuntimeError) from a never-connected client's shutdown(),
+        # OSError from the socket teardown, AttributeError when the state
+        # object predates/postdates the private-API shape we probe — in all
+        # of them we fall through to nulling the handles directly
         pass
     state = getattr(getattr(jax, "_src", None), "distributed", None)
     state = getattr(state, "global_state", None)
@@ -129,7 +139,9 @@ def _reset_partial_distributed_state() -> None:
         for attr in ("client", "service", "preemption_sync_manager"):
             try:
                 setattr(state, attr, None)
-            except Exception:
+            except AttributeError:
+                # a jax version exposing this as a read-only/absent slot:
+                # skip that handle, best-effort by design
                 pass
 
 

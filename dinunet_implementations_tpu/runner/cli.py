@@ -80,6 +80,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "simulated preemption replay identically run to run")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace per fold here")
+    p.add_argument("--sanitize", nargs="?", const="1", default=None,
+                   metavar="FLAGS",
+                   help="runtime sanitizer (checks/sanitize.py): compile-"
+                        "counter guard + jax leak checking + debug-NaN "
+                        "around every fit. Optional comma subset of "
+                        "compile,leaks,nans (default: all). Equivalent to "
+                        "DINUNET_SANITIZE=<FLAGS>")
     p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
                    help="multi-host runs: the jax.distributed coordinator "
                         "(the COINSTAC-pipeline-coordinator equivalent); "
@@ -111,6 +118,19 @@ def main(argv: list[str] | None = None) -> int:
             overrides[key] = val
     cfg = TrainConfig().with_overrides(overrides)
     verbose = not args.quiet
+
+    if args.sanitize is not None:
+        # the runner layer reads the env var, so the flag is just sugar —
+        # validate it here for an early, readable error
+        import os
+
+        from ..checks.sanitize import ENV_VAR, sanitize_flags
+
+        try:
+            sanitize_flags(args.sanitize)
+        except ValueError as e:
+            raise SystemExit(f"--sanitize: {e}")
+        os.environ[ENV_VAR] = args.sanitize
 
     mh_flags = (args.coordinator, args.num_processes, args.process_id)
     if any(f is not None for f in mh_flags):
@@ -156,6 +176,8 @@ def main(argv: list[str] | None = None) -> int:
             )
         from .fed_runner import SiteRunner
 
+        from ..checks.sanitize import SanitizerViolation
+
         runner = SiteRunner(
             task_id=cfg.task_id, data_path=args.data_path,
             mode=cfg.mode, site_index=args.site, out_dir=args.out_dir,
@@ -164,8 +186,13 @@ def main(argv: list[str] | None = None) -> int:
             **{k: v for k, v in overrides.items()
                if k not in ("task_id", "mode", "site_index", "out_dir")},
         )
-        results = runner.run(verbose=verbose)
+        try:
+            results = runner.run(verbose=verbose)
+        except SanitizerViolation as v:
+            print(json.dumps({"sanitizer_violation": str(v)}), file=sys.stderr)
+            return 70  # EX_SOFTWARE: an internal invariant broke
     else:
+        from ..checks.sanitize import SanitizerViolation
         from ..robustness.preemption import Preempted
         from .fed_runner import FedRunner
 
@@ -175,6 +202,9 @@ def main(argv: list[str] | None = None) -> int:
             results = runner.run(
                 folds=args.folds, verbose=verbose, resume=args.resume
             )
+        except SanitizerViolation as v:
+            print(json.dumps({"sanitizer_violation": str(v)}), file=sys.stderr)
+            return 70  # EX_SOFTWARE: an internal invariant broke
         except Preempted as p:
             # cooperative shutdown (SIGTERM/SIGINT or FaultPlan kill): state
             # was checkpointed before the raise — rerun with --resume to

@@ -164,16 +164,27 @@ class FedRunner:
         if folds is not None:
             all_folds = [all_folds[k] for k in folds]
             fold_ids = list(folds)
+        from ..checks.sanitize import sanitized_fit
+
         results = []
         for k, fold in zip(fold_ids, all_folds):
             trainer = FederatedTrainer(
                 self.cfg, get_task(self.cfg.task_id).build_model(self.cfg),
                 self.mesh, out_dir=self.out_dir, fault_plan=self.fault_plan,
             )
-            res = trainer.fit(
-                fold["train"], fold["validation"], fold["test"], fold=k,
-                verbose=verbose, resume=resume,
-            )
+            # DINUNET_SANITIZE=1 (or CLI --sanitize): compile-counter guard +
+            # leak/NaN checking around the fit — each fold's trainer is one
+            # (engine, topology) program, so the per-fit guard IS the
+            # one-compilation-per-program gate. No-op when disabled.
+            with sanitized_fit(
+                trainer, label=f"{self.cfg.agg_engine}/fold{k}"
+            ) as report:
+                res = trainer.fit(
+                    fold["train"], fold["validation"], fold["test"], fold=k,
+                    verbose=verbose, resume=resume,
+                )
+                if report is not None:
+                    report.note_result(res)
             results.append(res)
         return results
 
@@ -242,18 +253,24 @@ class SiteRunner:
             base_dir=site_dirs[ix],
             seed=cfg.seed,
         )
+        from ..checks.sanitize import sanitized_fit
+
         results = []
         for k, split in enumerate(splits):
             trainer = FederatedTrainer(
                 cfg, spec.build_model(cfg), mesh=None, out_dir=self.out_dir
             )
-            results.append(
-                trainer.fit(
+            with sanitized_fit(
+                trainer, label=f"{cfg.agg_engine}/site{ix}/fold{k}"
+            ) as report:
+                res = trainer.fit(
                     [arrs.take(split["train"])],
                     [arrs.take(split["validation"])],
                     [arrs.take(split["test"])],
                     fold=k,
                     verbose=verbose,
                 )
-            )
+                if report is not None:
+                    report.note_result(res)
+            results.append(res)
         return results

@@ -19,10 +19,53 @@ our outputs (SURVEY.md §7 'cheap, strong parity check').
 from __future__ import annotations
 
 import json
+import logging
 import os
 import shutil
+import sys
 import time
 import zipfile
+
+# ---------------------------------------------------------------------------
+# Level-gated logger — the ONE sanctioned output path for library code
+# (jaxlint R001: print() is reserved for CLI/demo/report surfaces). Messages
+# go to stdout in plain form, byte-compatible with the print() lines they
+# replaced, but gated by DINUNET_LOG_LEVEL (default INFO) so hot-path
+# progress lines can be silenced without touching verbose flags.
+# ---------------------------------------------------------------------------
+
+_LOGGER_NAME = "dinunet_implementations_tpu"
+
+
+class _StdoutHandler(logging.StreamHandler):
+    """StreamHandler that resolves sys.stdout at emit time (pytest capsys /
+    notebook redirections swap the stream object after import)."""
+
+    def emit(self, record):
+        self.stream = sys.stdout
+        super().emit(record)
+
+
+def get_logger() -> logging.Logger:
+    logger = logging.getLogger(_LOGGER_NAME)
+    if not logger.handlers:
+        handler = _StdoutHandler()
+        handler.setFormatter(logging.Formatter("%(message)s"))
+        logger.addHandler(handler)
+        logger.propagate = False
+        level = os.environ.get("DINUNET_LOG_LEVEL", "INFO").upper()
+        logger.setLevel(getattr(logging, level, logging.INFO))
+    return logger
+
+
+def log_info(msg: str) -> None:
+    """Progress lines (per-epoch readouts, pretrain status)."""
+    get_logger().info(msg)
+
+
+def log_warning(msg: str) -> None:
+    """Recoverable-but-noteworthy conditions (clamps, empty splits)."""
+    get_logger().warning(msg)
 
 
 def duration(cache: dict, start: float, key: str):

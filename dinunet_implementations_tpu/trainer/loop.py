@@ -43,6 +43,8 @@ from .logs import (
     duration,
     fold_dir,
     health_log_fields,
+    log_info,
+    log_warning,
     write_logs_json,
     write_test_metrics_csv,
     zip_global_results,
@@ -299,7 +301,7 @@ class FederatedTrainer:
             # for later folds (ADVICE r5). The clamped batch size is threaded
             # explicitly to run_epoch/evaluate below.
             if verbose:
-                print(
+                log_warning(
                     f"[warn] batch_size={cfg.batch_size} exceeds the smallest "
                     f"site's train split ({min_site} samples); clamping "
                     f"batch_size to {min_site} for this fold (drop_last "
@@ -310,7 +312,7 @@ class FederatedTrainer:
         if verbose:
             for i, s in enumerate(train_sites):
                 if not len(s):
-                    print(
+                    log_warning(
                         f"[warn] site {i} has an empty train split "
                         f"(train/val/test sizes: {sizes[i]}) — it will "
                         "contribute nothing to training this fold"
@@ -439,7 +441,7 @@ class FederatedTrainer:
                             else:
                                 since_best += cfg.validation_epochs
                             if verbose:
-                                print(
+                                log_info(
                                     f"[fold {fold}] epoch {epoch}: train_loss={epoch_loss:.4f} "
                                     + self._format_val_line(val_avg, val_metrics, monitor)
                                     + (" *" if best_epoch == epoch else "")
@@ -449,7 +451,7 @@ class FederatedTrainer:
                             # state is the selected state; no early stopping
                             best_epoch, best_state = epoch, state
                             if verbose:
-                                print(
+                                log_info(
                                     f"[fold {fold}] epoch {epoch}: "
                                     f"train_loss={epoch_loss:.4f} (no validation split)"
                                 )
@@ -627,8 +629,8 @@ class FederatedTrainer:
             )
             pre_state, losses = pre_epoch_fn(pre_state, *self._put_batch(fb))
             if verbose:
-                print(f"[pretrain site {largest}] epoch {epoch}: "
-                      f"loss={np.asarray(losses).mean():.4f}")
+                log_info(f"[pretrain site {largest}] epoch {epoch}: "
+                         f"loss={np.asarray(losses).mean():.4f}")
         # warm-started params; fresh optimizer (and health) for the federated
         # phase — pretrain skips/quarantines must not leak into the real run
         return TrainState(

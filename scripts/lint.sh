@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Lint gate: ruff (hard-error style/correctness families, [tool.ruff] in
+# pyproject.toml) + jaxlint (the codebase-specific SPMD-invariant analyzer,
+# dinunet_implementations_tpu/checks — rules R001-R006, empty baseline).
+# Run from anywhere; CI (.github/workflows/ci.yml) runs exactly this script.
+set -uo pipefail
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+rc=0
+
+if command -v ruff >/dev/null 2>&1; then
+  echo "== ruff =="
+  ruff check . || rc=1
+else
+  # the container image may not ship ruff; jaxlint below is stdlib-only and
+  # always runs, so the SPMD-invariant gate never silently disappears
+  echo "[lint] ruff not installed (pip install -e '.[dev]'); skipping style lint" >&2
+fi
+
+echo "== jaxlint =="
+JAX_PLATFORMS=cpu python -m dinunet_implementations_tpu.checks || rc=1
+
+exit $rc

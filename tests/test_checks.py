@@ -1,0 +1,450 @@
+"""jaxlint (dinunet_implementations_tpu/checks) — analyzer + sanitizer.
+
+Three layers:
+- fixture snippets that trigger and suppress every static rule (R001-R006),
+  scanned from a synthetic package tree so path-scoped rules behave exactly
+  as they do on the real package;
+- baseline round-trip (grandfather → rescan clean → new finding still gates);
+- the acceptance gate: the REAL package scans clean against the checked-in
+  (empty) baseline, and the runtime sanitizer's compile-counter guard passes
+  a healthy fit for two engines and trips on a shape-unstable one.
+"""
+
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dinunet_implementations_tpu.checks import (
+    CompileGuard,
+    PACKAGE_ROOT,
+    SanitizerViolation,
+    apply_baseline,
+    jit_cache_size,
+    load_baseline,
+    run_checks,
+    sanitize_flags,
+    sanitized_fit,
+    save_baseline,
+)
+from dinunet_implementations_tpu.core.config import TrainConfig
+from dinunet_implementations_tpu.data.api import SiteArrays
+from dinunet_implementations_tpu.models import MSANNet
+from dinunet_implementations_tpu.trainer.loop import FederatedTrainer
+
+
+# ---------------------------------------------------------------------------
+# fixture-tree scanning
+# ---------------------------------------------------------------------------
+
+
+def _scan(tmp_path, files: dict):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return run_checks(str(tmp_path))
+
+
+def _rules(findings):
+    return sorted(f.rule for f in findings)
+
+
+def test_r000_syntax_error_gates(tmp_path):
+    fs = _scan(tmp_path, {"trainer/broken.py": "def f(:\n"})
+    assert _rules(fs) == ["R000"]
+
+
+def test_r001_print_flagged_and_allowlisted(tmp_path):
+    fs = _scan(tmp_path, {
+        "trainer/hot.py": "def f():\n    print('round done')\n",
+        "runner/cli.py": "print('json line')\n",
+        "data/demo.py": "print('tree ready')\n",
+        "analysis.py": "print('report')\n",
+    })
+    assert _rules(fs) == ["R001"]
+    assert fs[0].path == "trainer/hot.py"
+    assert "logs.py" in fs[0].fixit
+
+
+def test_r002_bare_and_base_exception_anywhere(tmp_path):
+    fs = _scan(tmp_path, {
+        "data/anyfile.py": """
+            try:
+                work()
+            except:
+                pass
+            try:
+                work()
+            except BaseException:
+                cleanup()
+            try:
+                work()
+            except (ValueError, BaseException):
+                cleanup()
+        """,
+    })
+    assert _rules(fs) == ["R002", "R002", "R002"]
+
+
+def test_r002_swallowing_broad_handler_scoped(tmp_path):
+    swallow = """
+        try:
+            work()
+        except Exception:
+            pass
+    """
+    surfaced = """
+        import warnings
+        try:
+            work()
+        except Exception as e:
+            warnings.warn(f"failed: {e}")
+        try:
+            work()
+        except Exception:
+            raise RuntimeError("wrapped")
+    """
+    fs = _scan(tmp_path, {
+        "trainer/x.py": swallow,  # in scope → flagged
+        "robustness/y.py": swallow,  # in scope → flagged
+        "data/z.py": swallow,  # data/ is NOT in the swallow scope
+        "runner/ok.py": surfaced,  # logs or re-raises → fine
+    })
+    assert _rules(fs) == ["R002", "R002"]
+    assert {f.path for f in fs} == {"trainer/x.py", "robustness/y.py"}
+
+
+def test_r003_literal_axis_names(tmp_path):
+    fs = _scan(tmp_path, {
+        "engines/bad.py": """
+            import jax
+            def agg(g):
+                a = jax.lax.psum(g, "site")
+                b = jax.lax.all_gather(g, axis_name="model")
+                i = jax.lax.axis_index(("site", "site_fold"))
+                return a, b, i
+        """,
+        "engines/good.py": """
+            import jax
+            from parallel.mesh import SITE_AXIS
+            def agg(g, axis_name=SITE_AXIS):
+                a = jax.lax.psum(g, axis_name)
+                return jax.lax.all_gather(a, SITE_AXIS, axis=0, tiled=True)
+        """,
+    })
+    # psum literal + all_gather kw literal + two tuple members
+    assert _rules(fs) == ["R003"] * 4
+    assert all(f.path == "engines/bad.py" for f in fs)
+
+
+def test_r004_cfg_mutation(tmp_path):
+    fs = _scan(tmp_path, {
+        "trainer/bad.py": """
+            class T:
+                def fit(self, cfg):
+                    self.cfg.batch_size = 4
+                    cfg.epochs = 2
+                    setattr(self.cfg, "seed", 1)
+        """,
+        "trainer/good.py": """
+            class T:
+                def __init__(self, cfg):
+                    self.cfg = cfg          # binding the attr is fine
+                def fit(self):
+                    cfg = self.cfg.replace(batch_size=4)  # new object
+                    return cfg
+        """,
+        "core/config.py": """
+            def _init(cfg):
+                cfg.batch_size = 16  # construction site — allowed
+        """,
+    })
+    assert _rules(fs) == ["R004"] * 3
+    assert all(f.path == "trainer/bad.py" for f in fs)
+
+
+def test_r005_tracer_escapes_in_traced_scopes(tmp_path):
+    fs = _scan(tmp_path, {
+        "engines/bad.py": """
+            import numpy as np
+            def aggregate(g, w):
+                n = float(w.sum())
+                h = np.asarray(g)
+                return g.item(), n, h
+        """,
+        "models/ok.py": """
+            import jax.numpy as jnp
+            def forward(x):
+                return jnp.asarray(x, jnp.float32)  # traced cast — fine
+        """,
+        "data/host.py": """
+            import numpy as np
+            def load(rows):
+                return np.asarray([int(r) for r in rows])  # host side — fine
+        """,
+        "data/jitted.py": """
+            import jax
+            @jax.jit
+            def step(x):
+                return float(x)  # jitted even outside the traced modules
+        """,
+    })
+    assert _rules(fs) == ["R005"] * 4
+    assert {f.path for f in fs} == {"engines/bad.py", "data/jitted.py"}
+
+
+def test_r005_module_level_is_host_side(tmp_path):
+    fs = _scan(tmp_path, {
+        "engines/const.py": "RANK = int(1e3)  # import-time, not traced\n",
+    })
+    assert fs == []
+
+
+_STEPS_FIXTURE = """
+    class TrainState:
+        params: object
+        opt_state: object
+        rng: object
+"""
+
+
+def _ckpt_fixture(payload_keys, template_keys, pops=()):
+    payload = ", ".join(f'"{k}": state.{k}' for k in payload_keys)
+    template = ", ".join(f'"{k}": like.{k}' for k in template_keys)
+    pop_lines = "\n        ".join(f'raw.pop("{k}", None)' for k in pops) or "pass"
+    return f"""
+    def save_checkpoint(path, state, meta=None):
+        payload = {{{payload}, "meta_json": "{{}}"}}
+        return payload
+
+    def load_checkpoint(path, like, raw=None):
+        template = {{{template}}}
+        {pop_lines}
+        return template
+    """
+
+
+def test_r006_schema_consistent(tmp_path):
+    fs = _scan(tmp_path, {
+        "trainer/steps.py": _STEPS_FIXTURE,
+        "trainer/checkpoint.py": _ckpt_fixture(
+            ["params", "opt_state", "rng"], ["params", "opt_state", "rng"]
+        ),
+    })
+    assert fs == []
+
+
+def test_r006_schema_drift(tmp_path):
+    fs = _scan(tmp_path, {
+        "trainer/steps.py": _STEPS_FIXTURE,
+        # rng missing from the payload AND load side; stale 'legacy' key
+        "trainer/checkpoint.py": _ckpt_fixture(
+            ["params", "opt_state", "legacy"], ["params", "opt_state"]
+        ),
+    })
+    msgs = " | ".join(f.message for f in fs)
+    assert _rules(fs) == ["R006"] * 3
+    assert "'rng' is not serialized" in msgs
+    assert "'rng' is not restored" in msgs
+    assert "'legacy'" in msgs
+
+
+def test_r006_real_schema_matches():
+    """The real TrainState/checkpoint pair stays in sync (incl. health)."""
+    findings = [f for f in run_checks(PACKAGE_ROOT) if f.rule == "R006"]
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline
+# ---------------------------------------------------------------------------
+
+_TRIGGERS = {
+    "R001": ("trainer/a.py", "print('x')", "print('x')  # jaxlint: disable=R001"),
+    "R002": ("trainer/b.py",
+             "try:\n    f()\nexcept:\n    pass",
+             "try:\n    f()\nexcept:  # jaxlint: disable=R002\n    pass"),
+    "R003": ("engines/c.py",
+             "import jax\ndef f(g):\n    return jax.lax.psum(g, 'site')",
+             "import jax\ndef f(g):\n    return jax.lax.psum(g, 'site')"
+             "  # jaxlint: disable=R003"),
+    "R004": ("trainer/d.py",
+             "def f(cfg):\n    cfg.epochs = 1",
+             "def f(cfg):\n    # jaxlint: disable=R004\n    cfg.epochs = 1"),
+    "R005": ("engines/e.py",
+             "def f(x):\n    return int(x)",
+             "def f(x):\n    return int(x)  # jaxlint: disable=R005"),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(_TRIGGERS))
+def test_inline_suppression_per_rule(tmp_path, rule):
+    rel, trigger, suppressed = _TRIGGERS[rule]
+    assert _rules(_scan(tmp_path / "t", {rel: trigger})) == [rule]
+    assert _scan(tmp_path / "s", {rel: suppressed}) == []
+
+
+def test_inline_suppression_r006(tmp_path):
+    files = {
+        "trainer/steps.py": _STEPS_FIXTURE,
+        "trainer/checkpoint.py": _ckpt_fixture(
+            ["params", "opt_state"], ["params", "opt_state"]
+        ),
+    }
+    assert _rules(_scan(tmp_path / "t", files)) == ["R006"] * 2
+    files["trainer/checkpoint.py"] = files["trainer/checkpoint.py"].replace(
+        "def save_checkpoint", "# jaxlint: disable=R006\n    def save_checkpoint"
+    ).replace(
+        "def load_checkpoint", "# jaxlint: disable=R006\n    def load_checkpoint"
+    )
+    assert _scan(tmp_path / "s", files) == []
+
+
+def test_suppress_all_keyword(tmp_path):
+    fs = _scan(tmp_path, {
+        "trainer/a.py": "print('x')  # jaxlint: disable=all\n",
+    })
+    assert fs == []
+
+
+def test_baseline_roundtrip(tmp_path):
+    files = {"trainer/a.py": "print('one')\nprint('two')\n"}
+    findings = _scan(tmp_path / "pkg", files)
+    assert _rules(findings) == ["R001", "R001"]
+    bl_path = save_baseline(findings, str(tmp_path / "baseline.json"))
+    baseline = load_baseline(bl_path)
+    assert len(baseline) == 2
+    # grandfathered findings no longer gate...
+    new, matched = apply_baseline(findings, baseline)
+    assert new == [] and matched == 2
+    # ...and survive a line shift (keys are snippets, not line numbers)...
+    files2 = {"trainer/a.py": "# a new comment shifts lines\n"
+                              "print('one')\nprint('two')\n"}
+    shifted = _scan(tmp_path / "pkg2", files2)
+    new, matched = apply_baseline(shifted, baseline)
+    assert new == [] and matched == 2
+    # ...but a NEW finding still gates (multiset semantics)
+    files3 = {"trainer/a.py": "print('one')\nprint('two')\nprint('three')\n"}
+    grown = _scan(tmp_path / "pkg3", files3)
+    new, matched = apply_baseline(grown, baseline)
+    assert matched == 2 and [f.snippet for f in new] == ["print('three')"]
+
+
+def test_subpath_scan_keeps_package_relative_scoping():
+    """Scanning a file/subdir of the real package must anchor relpaths to the
+    package root — otherwise the R001 allowlist misses runner/cli.py (false
+    positives) and R002/R005 path scopes silently disarm (false negatives)."""
+    import os
+
+    cli = os.path.join(PACKAGE_ROOT, "runner", "cli.py")
+    assert [f for f in run_checks(cli) if f.rule == "R001"] == []
+    assert run_checks(os.path.join(PACKAGE_ROOT, "trainer")) == []
+
+
+def test_package_scans_clean_with_empty_baseline():
+    """The acceptance gate: the WHOLE package is clean and the checked-in
+    baseline is genuinely empty (findings were fixed, not grandfathered)."""
+    assert load_baseline() == []
+    findings = run_checks(PACKAGE_ROOT)
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer
+# ---------------------------------------------------------------------------
+
+
+def test_sanitize_flags_parsing():
+    assert sanitize_flags("") == frozenset()
+    assert sanitize_flags("0") == frozenset()
+    assert sanitize_flags("1") == {"compile", "leaks", "nans"}
+    assert sanitize_flags("compile,nans") == {"compile", "nans"}
+    with pytest.raises(ValueError):
+        sanitize_flags("compile,bogus")
+
+
+def _needs_cache_counter(fn):
+    if jit_cache_size(fn) is None:
+        pytest.skip("this jax build does not expose the jit cache counter")
+
+
+def test_compile_guard_trips_on_shape_instability():
+    f = jax.jit(lambda x: x * 2)
+    _needs_cache_counter(f)
+    guard = CompileGuard({"f": f}, max_compiles=1, label="toy")
+    f(jnp.ones((2,)))
+    guard.check()  # one program: fine
+    f(jnp.ones((3,)))  # second shape → second program
+    with pytest.raises(SanitizerViolation, match="compiled 2 programs"):
+        guard.check(context="round=3")
+
+
+def _toy_sites(ns, n=40, d=6, seed=0):
+    out = []
+    rng = np.random.default_rng(seed)
+    for i in range(ns):
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        y = (X.sum(-1) > 0).astype(np.int32)
+        out.append(SiteArrays(X, y, np.arange(n, dtype=np.int32)))
+    return out
+
+
+def _toy_trainer(engine):
+    cfg = TrainConfig(agg_engine=engine, epochs=3, batch_size=8,
+                      validation_epochs=1, monitor_metric="auc")
+    return FederatedTrainer(cfg, MSANNet(in_size=6, hidden_sizes=(16,), out_size=2))
+
+
+@pytest.mark.parametrize("engine", ["dSGD", "powerSGD"])
+def test_sanitized_fit_passes_healthy_fit(engine, monkeypatch):
+    """Acceptance: a DINUNET_SANITIZE=1 fit passes the compile-counter guard
+    (one epoch program per (engine, topology)) for at least two engines."""
+    monkeypatch.setenv("DINUNET_SANITIZE", "1")
+    tr = _toy_trainer(engine)
+    _needs_cache_counter(tr.epoch_fn)
+    with sanitized_fit(tr, label=f"{engine}/test") as report:
+        res = tr.fit(_toy_sites(2, seed=1), _toy_sites(2, n=24, seed=2),
+                     _toy_sites(2, n=24, seed=3), verbose=False)
+        report.note_result(res)
+    assert jit_cache_size(tr.epoch_fn) == 1
+    assert 0 <= res["test_metrics"][0][1] <= 1
+
+
+def test_sanitized_fit_trips_on_shape_unstable_fit(monkeypatch):
+    """A fit whose epoch batch shape drifts compiles a second epoch program
+    — the sanitizer must fail it, with the violation naming epoch_fn."""
+    monkeypatch.setenv("DINUNET_SANITIZE", "compile")
+    tr = _toy_trainer("dSGD")
+    _needs_cache_counter(tr.epoch_fn)
+    sites = _toy_sites(2, seed=1)
+    state = tr.init_state(jnp.ones((8, 6)), num_sites=2)
+    with pytest.raises(SanitizerViolation, match="epoch_fn"):
+        with sanitized_fit(tr, label="unstable"):
+            state, _ = tr.run_epoch(state, sites, epoch=1, batch_size=8)
+            state, _ = tr.run_epoch(state, sites, epoch=2, batch_size=4)
+
+
+def test_sanitized_fit_disabled_is_noop(monkeypatch):
+    monkeypatch.delenv("DINUNET_SANITIZE", raising=False)
+    tr = _toy_trainer("dSGD")
+    with sanitized_fit(tr) as report:
+        assert report is None
+
+
+def test_fed_runner_threads_sanitizer(tmp_path, monkeypatch):
+    """The runner surface honors DINUNET_SANITIZE end-to-end (the CLI
+    --sanitize flag just sets the same env var)."""
+    from dinunet_implementations_tpu.data.demo import make_demo_tree
+    from dinunet_implementations_tpu.runner.fed_runner import FedRunner
+
+    root = tmp_path / "demo"
+    make_demo_tree(str(root), n_sites=2, subjects=16, seed=0)
+    monkeypatch.setenv("DINUNET_SANITIZE", "compile")
+    cfg = TrainConfig(agg_engine="dSGD", epochs=2, batch_size=4,
+                      split_ratio=(0.7, 0.15, 0.15))
+    results = FedRunner(cfg, data_path=str(root),
+                        out_dir=str(tmp_path / "out")).run(verbose=False)
+    assert len(results) == 1 and "test_metrics" in results[0]
