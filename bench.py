@@ -385,6 +385,196 @@ def measure_rankdad_ab(obs: int = 5, n: int = TIMED_EPOCHS,
     return records
 
 
+def _setup_pipeline_arm(arm: str, dims: dict | None = None,
+                        donate: bool = True):
+    """One input-pipeline A/B arm (``--pipeline``): unlike the steady-state
+    bench arms above (which pre-place the epoch inputs once), these chains
+    model the TRAINER's per-epoch input path —
+
+    - ``host``: the dense ``[S, steps, B, ...]`` epoch tensor is re-shipped
+      to the device every epoch (cast to the compute dtype in flight), i.e.
+      what FederatedTrainer's host pipeline pays each epoch;
+    - ``device``: the inventory is uploaded once outside the timed region and
+      each epoch ships only the ``[S, steps, B]`` int32 index plan; batches
+      are gathered on-device inside the jitted epoch (trainer/steps.py
+      ``pipeline="device"``), with the carried state donated.
+
+    Returns ``(run_chain, samples_per_epoch, info)``; ``info`` carries
+    ``transfer_bytes_per_epoch`` and a mutable ``host_s``/``epochs``
+    accumulator for the measured per-epoch host-blocked time (plan build +
+    transfer dispatch — the work the device waits on between fused epoch
+    dispatches). Both arms run the plain jitted epoch (no AOT layouts) so the
+    comparison isolates the input path."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dinunet_implementations_tpu.engines import make_engine
+    from dinunet_implementations_tpu.models import ICALstm
+    from dinunet_implementations_tpu.trainer import (
+        FederatedTask,
+        init_train_state,
+        make_optimizer,
+        make_train_epoch_fn,
+    )
+
+    d = dict(sites=NUM_SITES, steps=STEPS_PER_EPOCH, batch=BATCH_PER_SITE,
+             windows=WINDOWS, comps=COMPS, wlen=WLEN, enc_out=ENC_OUT,
+             hidden=HIDDEN, compute_dtype="bfloat16")
+    d.update(dims or {})
+    model = ICALstm(input_size=d["enc_out"], hidden_size=d["hidden"],
+                    num_comps=d["comps"], window_size=d["wlen"], num_cls=2,
+                    compute_dtype=d["compute_dtype"])
+    task = FederatedTask(model)
+    engine = make_engine("dSGD")
+    opt = make_optimizer("adam", 1e-3)
+    S, steps, B = d["sites"], d["steps"], d["batch"]
+    rng = np.random.default_rng(0)
+    np_x = rng.normal(
+        size=(S, steps, B, d["windows"], d["comps"], d["wlen"])
+    ).astype(np.float32)
+    np_y = (rng.random((S, steps, B)) > 0.5).astype(np.int32)
+    np_w = np.ones((S, steps, B), np.float32)
+    dt = jnp.bfloat16 if d["compute_dtype"] == "bfloat16" else jnp.float32
+    state0 = init_train_state(
+        task, engine, opt, jax.random.PRNGKey(0), jnp.asarray(np_x[0, 0]),
+        num_sites=S,
+    )
+    info = {"host_s": 0.0, "epochs": 0}
+
+    if arm == "host":
+        epoch_fn = make_train_epoch_fn(
+            task, engine, opt, mesh=None, local_iterations=1,
+            pipeline="host", donate_state=donate,
+        )
+
+        def feed():
+            t0 = time.perf_counter()
+            args = (jnp.asarray(np_x, dtype=dt), jnp.asarray(np_y),
+                    jnp.asarray(np_w))
+            info["host_s"] += time.perf_counter() - t0
+            info["epochs"] += 1
+            return args
+
+        info["transfer_bytes_per_epoch"] = (
+            np_x.size * np.dtype(dt).itemsize + np_y.nbytes + np_w.nbytes
+        )
+    else:
+        epoch_fn = make_train_epoch_fn(
+            task, engine, opt, mesh=None, local_iterations=1,
+            pipeline="device", donate_state=donate,
+        )
+        # inventory: each bench site owns exactly steps*B samples; uploaded
+        # ONCE, outside the timed chains (what the trainer pays per fit)
+        inv_x = jnp.asarray(np_x.reshape((S, steps * B) + np_x.shape[3:]),
+                            dtype=dt)
+        inv_y = jnp.asarray(np_y.reshape(S, steps * B))
+        np_idx = np.broadcast_to(
+            np.arange(steps * B, dtype=np.int32).reshape(1, steps, B),
+            (S, steps, B),
+        ).copy()
+
+        def feed():
+            t0 = time.perf_counter()
+            args = (inv_x, inv_y, jnp.asarray(np_idx))
+            info["host_s"] += time.perf_counter() - t0
+            info["epochs"] += 1
+            return args
+
+        info["transfer_bytes_per_epoch"] = np_idx.nbytes
+
+    from dinunet_implementations_tpu.checks.sanitize import (
+        CompileGuard,
+        sanitize_enabled,
+    )
+
+    guard = (
+        CompileGuard({"epoch_fn": epoch_fn}, label=f"pipeline-{arm}")
+        if sanitize_enabled() else None
+    )
+
+    def run_chain(k: int) -> float:
+        # donation consumes the input state's buffers: every chain starts
+        # from a fresh copy so state0 stays reusable across chains (the copy
+        # is one epoch-state clone, amortized over the chain and cancelled by
+        # the marginal estimator anyway)
+        s = jax.tree.map(jnp.copy, state0) if donate else state0
+        t0 = time.time()
+        for _ in range(k):
+            s, _ = epoch_fn(s, *feed())
+        jax.tree.map(np.asarray, s)
+        t = time.time() - t0
+        if guard is not None:
+            guard.check(context=f"pipeline={arm}, chain={k} epochs")
+        return t
+
+    return run_chain, S * steps * B, info
+
+
+def measure_pipeline_ab(mode: str = "ab", obs: int = 5, n: int = TIMED_EPOCHS,
+                        dims: dict | None = None,
+                        donate: bool = True) -> list[dict]:
+    """Input-pipeline A/B (``--pipeline host|device|ab``): one JSON record
+    per arm with the throughput distribution plus the pipeline-specific
+    fields — ``transfer_bytes_per_epoch`` (the per-epoch host→device bytes;
+    the device arm ships index-plan bytes, not dataset bytes) and
+    ``host_blocked_ms_per_epoch`` (measured host time building/shipping epoch
+    inputs). Arms are interleaved per observation round like --ab-rankdad."""
+    import jax
+
+    arms = ("host", "device") if mode == "ab" else (mode,)
+    chains, infos = {}, {}
+    samples = None
+    for arm in arms:
+        chains[arm], samples, infos[arm] = _setup_pipeline_arm(
+            arm, dims=dims, donate=donate
+        )
+        chains[arm](1)  # compile + warm up before any timing starts
+        infos[arm]["host_s"] = 0.0  # exclude warmup from the host-time stats
+        infos[arm]["epochs"] = 0
+    if len(arms) == 2:
+        dists = interleaved_ab(chains, n, obs=obs)
+    else:
+        pairs = [
+            (chains[arms[0]](n // 2 + 1), chains[arms[0]](n + 1))
+            for _ in range(obs)
+        ]
+        dists = {arms[0]: marginal_distribution(pairs, n)}
+    records = []
+    for arm in arms:
+        info = infos[arm]
+        rec = {
+            "metric": "samples/sec/chip (ICA-LSTM federated round, "
+                      "input-pipeline A/B)",
+            "arm": f"pipeline-{arm}",
+            "pipeline": arm,
+            "sites": (dims or {}).get("sites", NUM_SITES),
+            "backend": jax.default_backend(),
+            "chain_epochs": n,
+            "donate_state": donate,
+            "transfer_bytes_per_epoch": int(info["transfer_bytes_per_epoch"]),
+            "host_blocked_ms_per_epoch": round(
+                1e3 * info["host_s"] / max(info["epochs"], 1), 3
+            ),
+            "samples_per_sec": throughput_stats(dists[arm], samples),
+            "unit": "samples/sec/chip",
+        }
+        if arm == "device" and "host" in infos:
+            rec["transfer_reduction_vs_host"] = round(
+                infos["host"]["transfer_bytes_per_epoch"]
+                / max(info["transfer_bytes_per_epoch"], 1), 1,
+            )
+        if dims:
+            rec["dims"] = dims
+        elif rec["samples_per_sec"]["value"] is not None:
+            rec["mfu"] = round(
+                rec["samples_per_sec"]["value"] * flops_per_sample()
+                / V5E_BF16_PEAK_FLOPS, 4,
+            )
+        records.append(rec)
+    return records
+
+
 def measure_cpu_baseline() -> float:
     """Live re-measurement of the torch reference (optional)."""
     import importlib.util
@@ -442,6 +632,26 @@ def main():
              if "--epochs" in sys.argv else TIMED_EPOCHS)
         dims = SMALL_DIMS if "--small" in sys.argv else None
         for rec in measure_rankdad_ab(obs=obs, n=n, dims=dims):
+            print(json.dumps(rec), flush=True)
+        return
+    if "--pipeline" in sys.argv:
+        # input-pipeline A/B: host (dense per-epoch transfer, the legacy
+        # trainer path) vs device (resident inventory + per-epoch index
+        # plan + donated state). `--pipeline ab` interleaves both arms;
+        # a single arm name runs just that arm (the CI CPU smoke uses
+        # `--pipeline device --small --sanitize` to exercise the device
+        # path + donation under the CompileGuard on every PR).
+        mode = sys.argv[sys.argv.index("--pipeline") + 1]
+        if mode not in ("host", "device", "ab"):
+            raise SystemExit(f"--pipeline expects host|device|ab, got {mode!r}")
+        obs = int(sys.argv[sys.argv.index("--obs") + 1]) if "--obs" in sys.argv else 5
+        n = (int(sys.argv[sys.argv.index("--epochs") + 1])
+             if "--epochs" in sys.argv else TIMED_EPOCHS)
+        dims = SMALL_DIMS if "--small" in sys.argv else None
+        for rec in measure_pipeline_ab(
+            mode=mode, obs=obs, n=n, dims=dims,
+            donate="--no-donate" not in sys.argv,
+        ):
             print(json.dumps(rec), flush=True)
         return
     if "--faults" in sys.argv:

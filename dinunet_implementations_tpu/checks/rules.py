@@ -155,6 +155,9 @@ def _names_exception(node: ast.expr | None, name: str) -> bool:
 
 _LOGGING_ATTRS = {
     "warn", "warning", "error", "exception", "critical", "info", "debug", "log",
+    # the project's own level-gated logger (trainer/logs.py) — R001 routes
+    # library output through these, so they count as surfacing for R002 too
+    "log_info", "log_warning",
 }
 
 
@@ -168,7 +171,7 @@ def _handler_surfaces(handler: ast.ExceptHandler) -> bool:
             f = node.func
             if isinstance(f, ast.Attribute) and f.attr in _LOGGING_ATTRS:
                 return True
-            if isinstance(f, ast.Name) and f.id in ("print",) | _LOGGING_ATTRS:
+            if isinstance(f, ast.Name) and f.id in {"print"} | _LOGGING_ATTRS:
                 return True
     return False
 

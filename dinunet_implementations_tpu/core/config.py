@@ -260,6 +260,27 @@ class TrainConfig:
     # the per-round dynamic-slice arm — the escape hatch for multi-GB epoch
     # inputs where that residency bump matters more than the speed.
     rounds_scan_xs: bool = True
+    # input pipeline (trainer/loop.py): "device" (default) uploads each
+    # site's inventory to the mesh once per fit and drives every epoch from a
+    # compact [S, steps, B] int32 index plan — the jitted epoch gathers
+    # batches on-device, so per-epoch host→device traffic is index-plan
+    # bytes, not dataset bytes (plus a double-buffered background planner
+    # building epoch N+1's plan while epoch N runs). "host" is the legacy
+    # dense path: plan_epoch re-materializes [S, steps, B, ...] on the host
+    # and ships it every epoch (the A/B arm, and the escape hatch if the
+    # padded inventory grid itself cannot fit in HBM).
+    pipeline: str = "device"
+    # donate the carried TrainState's buffers to the epoch program
+    # (jax.jit donate_argnums): the update writes in place instead of
+    # allocating a second params+optimizer copy per epoch. The trainer
+    # snapshots best-state selections, so donation is transparent; False
+    # restores the copying behavior.
+    donate_epoch_state: bool = True
+    # non-empty → persistent XLA compilation cache at this directory
+    # (jax compilation_cache): re-runs and later folds of the same
+    # (engine, topology) program load the compiled epoch from disk instead
+    # of recompiling. CLI: --compile-cache DIR.
+    compile_cache_dir: str = ""
     # non-empty → wrap each fit() in jax.profiler.trace(profile_dir) and
     # write a TensorBoard-compatible device trace there (SURVEY.md §5: the
     # reference only has wall-clock duration lists; this is the TPU upgrade)

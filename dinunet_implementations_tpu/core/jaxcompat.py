@@ -65,4 +65,41 @@ def input_formats_of(compiled):
     return compiled.input_layouts
 
 
-__all__ = ["shard_map", "auto_input_format", "input_formats_of"]
+def enable_compile_cache(path: str) -> None:
+    """Point jax's persistent compilation cache at ``path`` (opt-in via
+    ``TrainConfig.compile_cache_dir`` / CLI ``--compile-cache``).
+
+    Re-runs and per-fold re-fits of the same (engine, topology) program then
+    deserialize the compiled epoch instead of re-running XLA. Idempotent —
+    safe to call once per trainer. The write thresholds are zeroed so even
+    fast-compiling programs (CPU tests, --small benches) populate the cache;
+    the knobs are best-effort across jax versions."""
+    import os
+
+    os.makedirs(path, exist_ok=True)
+    try:
+        from jax.experimental.compilation_cache import compilation_cache as cc
+
+        cc.set_cache_dir(path)
+        # jax latches its cache-used decision on the FIRST compilation of the
+        # process (is_cache_used's once-per-task check); enabling the cache
+        # mid-session (a trainer constructed after other jax work) needs the
+        # latch cleared or nothing is ever written
+        if hasattr(cc, "reset_cache"):
+            cc.reset_cache()
+    except ImportError:
+        jax.config.update("jax_compilation_cache_dir", path)
+    for opt, val in (
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(opt, val)
+        except (AttributeError, ValueError):
+            pass  # older jax without this knob: its default threshold applies
+
+
+__all__ = [
+    "shard_map", "auto_input_format", "input_formats_of",
+    "enable_compile_cache",
+]

@@ -1,5 +1,20 @@
-from .api import DataHandle, SiteArrays, SiteDataset, build_site_dataset
-from .batching import FedBatches, plan_epoch, plan_eval
+from .api import (
+    DataHandle,
+    SiteArrays,
+    SiteDataset,
+    SiteInventory,
+    build_site_dataset,
+    stack_site_inventory,
+)
+from .batching import (
+    EpochPlan,
+    FedBatches,
+    epoch_steps,
+    materialize_plan,
+    plan_epoch,
+    plan_epoch_positions,
+    plan_eval,
+)
 from .freesurfer import FreeSurferDataset, FSVDataHandle, coerce_label, read_aseg_stats
 from .ica import ICADataHandle, ICADataset, load_timecourses, window_timecourses
 from .splits import kfold_splits, load_split_file, resolve_splits, split_by_ratio

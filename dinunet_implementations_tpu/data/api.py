@@ -33,6 +33,48 @@ class SiteArrays:
         return SiteArrays(self.inputs[ix], self.labels[ix], self.indices[ix])
 
 
+@dataclass
+class SiteInventory:
+    """Every site's full dataset stacked on a common ``[S, N_max, ...]`` grid
+    — the unit of DEVICE residency (uploaded to the mesh once per fit; each
+    epoch then gathers its batches on-device from a compact index plan,
+    trainer/steps.py). Sites smaller than ``N_max`` are zero-padded; a plan
+    never points a live slot at a pad row (``counts`` bounds the valid
+    prefix), so the padding is inert ballast, not data."""
+
+    inputs: np.ndarray  # [S, N_max, ...] float32 (cast to compute dtype at upload)
+    labels: np.ndarray  # [S, N_max] int32
+    counts: np.ndarray  # [S] int32 — valid rows per site
+
+    @property
+    def num_sites(self):
+        return self.inputs.shape[0]
+
+    @property
+    def nbytes(self) -> int:
+        return self.inputs.nbytes + self.labels.nbytes
+
+
+def stack_site_inventory(sites: list["SiteArrays"]) -> SiteInventory:
+    """Pad heterogeneous sites (73–120 subjects in the FS fixture) onto one
+    dense ``[S, N_max, ...]`` grid. Host-side and cheap: one copy of the
+    dataset, paid once per fit instead of once per epoch."""
+    n_max = max((len(s) for s in sites), default=0)
+    assert n_max > 0, "all sites empty"
+    feat_shape = next(s.inputs.shape[1:] for s in sites if len(s))
+    S = len(sites)
+    inputs = np.zeros((S, n_max) + feat_shape, np.float32)
+    labels = np.zeros((S, n_max), np.int32)
+    counts = np.zeros((S,), np.int32)
+    for si, s in enumerate(sites):
+        n = len(s)
+        counts[si] = n
+        if n:
+            inputs[si, :n] = s.inputs
+            labels[si, :n] = s.labels
+    return SiteInventory(inputs, labels, counts)
+
+
 class SiteDataset:
     """Base dataset (capability parity with ``COINNDataset``, reconstructed
     from call sites — see SURVEY.md §2.3).

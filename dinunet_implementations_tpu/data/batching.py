@@ -18,6 +18,17 @@ must take the same number of steps per epoch, so we make the step grid dense:
   like the reference's cycling DataLoader.
 - ``"mask"`` (eval): padding gets weight 0; no sample is seen twice (AUC /
   metric correctness).
+
+Two layers since the device-resident pipeline landed:
+
+- :func:`plan_epoch_positions` — the compact plan: ``positions [S, steps, B]``
+  int32 sample positions into each site's inventory (``-1`` = padding). This
+  is the only thing the device pipeline ships to the mesh per epoch
+  (trainer/steps.py gathers batches on-device from the resident inventory).
+- :func:`materialize_plan` — the host path: expand a plan to the dense
+  :class:`FedBatches` arrays. ``plan_epoch`` composes the two, so the host
+  and device pipelines are bit-exact by construction: one plan, two
+  realizations.
 """
 
 from __future__ import annotations
@@ -27,6 +38,31 @@ from dataclasses import dataclass
 import numpy as np
 
 from .api import SiteArrays
+
+
+@dataclass
+class EpochPlan:
+    """A compact epoch plan: per-(site, step, slot) sample positions into each
+    site's own inventory; ``-1`` marks a padding slot (zero inputs/labels,
+    zero weight in the materialized batch)."""
+
+    positions: np.ndarray  # [S, steps, B] int32; -1 = padding
+
+    @property
+    def num_sites(self):
+        return self.positions.shape[0]
+
+    @property
+    def steps(self):
+        return self.positions.shape[1]
+
+    @property
+    def batch_size(self):
+        return self.positions.shape[2]
+
+    @property
+    def nbytes(self) -> int:
+        return self.positions.nbytes
 
 
 @dataclass
@@ -49,7 +85,7 @@ class FedBatches:
         return self.inputs.shape[2]
 
 
-def _site_batches(arr: SiteArrays, batch_size: int, order: np.ndarray, drop_last: bool):
+def _site_batches(arr, batch_size: int, order: np.ndarray, drop_last: bool):
     """Chunk one site's (ordered) samples into batches; returns list of index
     arrays, each of length ``batch_size`` except possibly the last."""
     n = len(order)
@@ -58,15 +94,34 @@ def _site_batches(arr: SiteArrays, batch_size: int, order: np.ndarray, drop_last
     return [order[i : i + batch_size] for i in range(0, n, batch_size)]
 
 
-def plan_epoch(
+def _site_batch_count(n: int, batch_size: int, drop_last: bool) -> int:
+    return n // batch_size if drop_last else -(-n // batch_size)
+
+
+def epoch_steps(sites: list[SiteArrays], batch_size: int,
+                drop_last: bool = True) -> int:
+    """Steps per epoch for this site set — the max per-site batch count (the
+    dense step grid every site is padded/wrapped to). Pure arithmetic, shared
+    with :func:`plan_epoch_positions` so callers (the prefetching planner) can
+    predict round counts without building a plan."""
+    return max(_site_batch_count(len(s), batch_size, drop_last) for s in sites)
+
+
+def plan_epoch_positions(
     sites: list[SiteArrays],
     batch_size: int,
     seed: int = 0,
     shuffle: bool = True,
     drop_last: bool = True,
     pad_mode: str = "wrap",
-) -> FedBatches:
-    """Build the dense [S, steps, B, ...] epoch plan (see module docstring)."""
+) -> EpochPlan:
+    """Build the compact ``[S, steps, B]`` epoch plan (see module docstring).
+
+    Wrap-mode recycling is a single computed tiling of reshuffled orders:
+    draw exactly the permutations the epoch needs, concatenate their
+    batch-aligned prefixes, and reshape — no per-batch list concatenation
+    (the RNG draw sequence is identical to the historical loop, so plans are
+    bit-stable across the refactor)."""
     assert pad_mode in ("wrap", "mask")
     S = len(sites)
     feat_shape = None
@@ -78,12 +133,15 @@ def plan_epoch(
     assert feat_shape is not None, "all sites empty"
 
     rng = np.random.default_rng(seed)
-    per_site: list[list[np.ndarray]] = []
-    for s in sites:
-        order = rng.permutation(len(s)) if shuffle else np.arange(len(s))
-        per_site.append(_site_batches(s, batch_size, order, drop_last))
 
-    steps = max(len(b) for b in per_site)
+    def draw_order(n: int) -> np.ndarray:
+        return rng.permutation(n) if shuffle else np.arange(n)
+
+    first_orders = [draw_order(len(s)) for s in sites]
+    counts = [
+        _site_batch_count(len(s), batch_size, drop_last) for s in sites
+    ]
+    steps = max(counts)
     assert steps > 0, (
         f"no site yields a batch: batch_size={batch_size} exceeds every "
         f"site's sample count {[len(s) for s in sites]} with "
@@ -92,26 +150,80 @@ def plan_epoch(
         "automatically)"
     )
 
-    inputs = np.zeros((S, steps, batch_size) + feat_shape, np.float32)
-    labels = np.zeros((S, steps, batch_size), np.int32)
-    weights = np.zeros((S, steps, batch_size), np.float32)
-    indices = np.full((S, steps, batch_size), -1, np.int32)
+    positions = np.full((S, steps, batch_size), -1, np.int32)
+    for si, (site, order, nb) in enumerate(zip(sites, first_orders, counts)):
+        n = len(site)
+        if nb == 0:
+            continue  # mask-only site: all padding (zero weight downstream)
+        if pad_mode == "wrap" and nb < steps:
+            if drop_last:
+                # full batches only: tile (first + extra) orders' batch-aligned
+                # prefixes and reshape — one vectorized fill per site
+                usable = (n // batch_size) * batch_size
+                extra = -(-(steps - nb) // nb)  # ceil: reshuffles needed
+                tiled = np.concatenate(
+                    [order[:usable]] + [draw_order(n)[:usable] for _ in range(extra)]
+                )
+                positions[si] = (
+                    tiled[: steps * batch_size].reshape(steps, batch_size)
+                )
+                continue
+            # drop_last=False wrap (unused by the trainer, kept for API
+            # parity): ragged batches — linear list extension
+            batches = _site_batches(site, batch_size, order, drop_last)
+            while len(batches) < steps:
+                batches.extend(
+                    _site_batches(site, batch_size, draw_order(n), drop_last)
+                )
+            for bi, ix in enumerate(batches[:steps]):
+                positions[si, bi, : len(ix)] = ix
+            continue
+        for bi, ix in enumerate(
+            _site_batches(site, batch_size, order, drop_last)
+        ):
+            positions[si, bi, : len(ix)] = ix
+    return EpochPlan(positions)
 
-    for si, (site, batches) in enumerate(zip(sites, per_site)):
-        if pad_mode == "wrap" and batches:
-            while len(batches) < steps:  # recycle with a fresh shuffle
-                order = rng.permutation(len(site)) if shuffle else np.arange(len(site))
-                batches = batches + _site_batches(site, batch_size, order, drop_last)
-            batches = batches[:steps]
-        for bi, ix in enumerate(batches):
-            k = len(ix)
-            sel = site.take(ix)
-            inputs[si, bi, :k] = sel.inputs
-            labels[si, bi, :k] = sel.labels
-            weights[si, bi, :k] = 1.0
-            indices[si, bi, :k] = sel.indices
 
+def materialize_plan(sites: list[SiteArrays], plan: EpochPlan) -> FedBatches:
+    """Expand a compact plan to the dense host arrays (the host pipeline /
+    eval path). Padding slots (-1) are zero-filled with zero weight — the
+    exact semantics the device gather reproduces on-chip."""
+    S, steps, B = plan.positions.shape
+    feat_shape = next(s.inputs.shape[1:] for s in sites if len(s))
+    inputs = np.zeros((S, steps, B) + feat_shape, np.float32)
+    labels = np.zeros((S, steps, B), np.int32)
+    weights = np.zeros((S, steps, B), np.float32)
+    indices = np.full((S, steps, B), -1, np.int32)
+    for si, site in enumerate(sites):
+        flat = plan.positions[si].reshape(-1)
+        valid = flat >= 0
+        if not valid.any():
+            continue
+        sel = flat[valid]
+        inputs[si].reshape((steps * B,) + feat_shape)[valid] = site.inputs[sel]
+        labels[si].reshape(-1)[valid] = site.labels[sel]
+        weights[si].reshape(-1)[valid] = 1.0
+        indices[si].reshape(-1)[valid] = site.indices[sel]
     return FedBatches(inputs, labels, weights, indices)
+
+
+def plan_epoch(
+    sites: list[SiteArrays],
+    batch_size: int,
+    seed: int = 0,
+    shuffle: bool = True,
+    drop_last: bool = True,
+    pad_mode: str = "wrap",
+) -> FedBatches:
+    """Build the dense [S, steps, B, ...] epoch plan (see module docstring)."""
+    return materialize_plan(
+        sites,
+        plan_epoch_positions(
+            sites, batch_size, seed=seed, shuffle=shuffle,
+            drop_last=drop_last, pad_mode=pad_mode,
+        ),
+    )
 
 
 def plan_eval(sites: list[SiteArrays], batch_size: int) -> FedBatches:

@@ -245,6 +245,45 @@ def put_site_batch(mesh, arr, dtype=None):
     return jax.device_put(a, sh)
 
 
+def put_site_inventory(mesh, inventory, input_dtype=None):
+    """One-shot placement of a padded ``[S, N_max, ...]`` site inventory
+    (data/api.py SiteInventory) onto the mesh, split over the site axis —
+    the upload the device-resident pipeline pays ONCE per fit (inputs cast to
+    the compute dtype here, so no per-epoch convert+copy ever runs
+    on-device). ``mesh=None`` is the vmap-folded single-device path (plain
+    committed local arrays); multi-host meshes take each process's
+    addressable slices exactly like the per-epoch batches used to
+    (:func:`put_site_batch`)."""
+    import jax.numpy as jnp
+
+    if mesh is None:
+        return (
+            jnp.asarray(inventory.inputs, dtype=input_dtype),
+            jnp.asarray(inventory.labels),
+        )
+    return (
+        put_site_batch(mesh, inventory.inputs, input_dtype),
+        put_site_batch(mesh, inventory.labels),
+    )
+
+
+def put_epoch_plan(mesh, positions, live=None, poison=None):
+    """Ship one epoch's compact plan — the ``[S, steps, B]`` int32 index
+    grid plus the optional ``[S, rounds]`` fault masks — to the mesh. This
+    is the ENTIRE per-epoch host→device traffic of the device pipeline:
+    index-plan bytes, not dataset bytes."""
+    import jax.numpy as jnp
+
+    def put(a):
+        return jnp.asarray(a) if mesh is None else put_site_batch(mesh, a)
+
+    return (
+        put(positions),
+        None if live is None else put(live),
+        None if poison is None else put(poison),
+    )
+
+
 def fetch_site_outputs(tree, mesh):
     """Bring per-site (``P(site)``-sharded) outputs back to host numpy on
     every process. Multi-host meshes need a ``process_allgather`` first —

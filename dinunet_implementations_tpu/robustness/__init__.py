@@ -23,13 +23,14 @@ The liveness-mask/quarantine math itself lives *inside* the compiled epoch
 inputs, so a different fault pattern never recompiles the program.
 """
 
-from .faults import FaultPlan, parse_fault_plan, poison_inputs
+from .faults import FaultPlan, fault_window, parse_fault_plan, poison_inputs
 from .health import default_health, health_summary
 from .preemption import Preempted, PreemptionGuard
 from .retry import with_retry
 
 __all__ = [
     "FaultPlan",
+    "fault_window",
     "Preempted",
     "PreemptionGuard",
     "default_health",

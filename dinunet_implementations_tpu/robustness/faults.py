@@ -165,6 +165,22 @@ def parse_fault_plan(arg: str | None) -> FaultPlan | None:
     return FaultPlan.from_json(arg)
 
 
+def fault_window(plan: FaultPlan | None, num_sites: int, round0: int,
+                 rounds: int):
+    """The per-epoch fault masks for the global round window
+    ``[round0, round0 + rounds)``: ``(liveness, nan_mask)``, or
+    ``(None, None)`` when the plan injects nothing. The ONE place both input
+    pipelines (trainer/loop.py host materialization and device index plans)
+    derive their window math from, so the device==host bit-exactness
+    contract cannot drift between them."""
+    if plan is None or not plan.injects_faults():
+        return None, None
+    return (
+        plan.liveness(num_sites, round0, rounds),
+        plan.nan_mask(num_sites, round0, rounds),
+    )
+
+
 def poison_inputs(inputs: np.ndarray, nan_mask: np.ndarray,
                   local_iterations: int) -> np.ndarray:
     """Data-layer NaN injection: overwrite the poisoned (site, round) cells'

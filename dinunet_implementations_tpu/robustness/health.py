@@ -31,8 +31,14 @@ def default_health(num_sites: int) -> dict:
     # like tests/dcn_worker.py get to set platform/device-count knobs
     import jax.numpy as jnp
 
-    z = jnp.zeros((num_sites,), jnp.int32)
-    return {"streak": z, "skips": z, "quarantined": z}
+    # three DISTINCT arrays, not one shared buffer: the epoch program donates
+    # the carried state (trainer/steps.py donate_state), and XLA rejects the
+    # same buffer appearing twice in a donated argument list
+    return {
+        "streak": jnp.zeros((num_sites,), jnp.int32),
+        "skips": jnp.zeros((num_sites,), jnp.int32),
+        "quarantined": jnp.zeros((num_sites,), jnp.int32),
+    }
 
 
 def health_summary(health) -> dict | None:

@@ -80,6 +80,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "simulated preemption replay identically run to run")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace per fold here")
+    p.add_argument("--pipeline", default=None, choices=["device", "host"],
+                   help="input pipeline: 'device' (default) keeps the site "
+                        "inventory resident on the mesh and ships only a "
+                        "compact int32 index plan per epoch; 'host' is the "
+                        "legacy dense per-epoch transfer (A/B fallback)")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="persistent XLA compilation cache directory: re-runs "
+                        "and per-fold re-fits load the compiled epoch from "
+                        "disk instead of recompiling (TrainConfig."
+                        "compile_cache_dir)")
     p.add_argument("--sanitize", nargs="?", const="1", default=None,
                    metavar="FLAGS",
                    help="runtime sanitizer (checks/sanitize.py): compile-"
@@ -113,6 +123,8 @@ def main(argv: list[str] | None = None) -> int:
         ("model_axis_size", args.model_axis_size),
         ("sites_per_device", args.sites_per_device),
         ("profile_dir", args.profile_dir),
+        ("pipeline", args.pipeline),
+        ("compile_cache_dir", args.compile_cache),
     ):
         if val is not None:
             overrides[key] = val

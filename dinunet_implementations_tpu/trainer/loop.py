@@ -27,8 +27,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.config import TrainConfig
-from ..data.api import SiteArrays
-from ..data.batching import plan_epoch, plan_eval
+from ..data.api import SiteArrays, stack_site_inventory
+from ..data.batching import (
+    epoch_steps,
+    plan_epoch,
+    plan_epoch_positions,
+    plan_eval,
+)
 from ..engines import make_engine
 from .checkpoint import (
     load_checkpoint,
@@ -50,6 +55,7 @@ from .logs import (
     zip_global_results,
 )
 from .metrics import Averages, ClassificationMetrics, MulticlassMetrics, is_improvement
+from .prefetch import EpochPlanPrefetcher
 from .steps import (
     FederatedTask,
     TrainState,
@@ -80,12 +86,29 @@ class FederatedTrainer:
             cfg.agg_engine, precision_bits=cfg.precision_bits, seed=cfg.seed, **task_args
         )
         self.optimizer = make_optimizer(cfg.optimizer, cfg.learning_rate)
+        if cfg.pipeline not in ("device", "host"):
+            raise ValueError(
+                f"cfg.pipeline must be 'device' or 'host', got {cfg.pipeline!r}"
+            )
+        # device pipeline (the default): inventory uploaded once per fit,
+        # epochs driven by compact index plans gathered on-device; the carried
+        # state is donated to the epoch program (see run_epoch/_snapshot)
+        self._pipeline = cfg.pipeline
+        self._donate = bool(cfg.donate_epoch_state)
+        if cfg.compile_cache_dir:
+            from ..core.jaxcompat import enable_compile_cache
+
+            enable_compile_cache(cfg.compile_cache_dir)
         self.epoch_fn = make_train_epoch_fn(
             self.task, self.engine, self.optimizer, mesh, cfg.local_iterations,
             rounds_scan_xs=cfg.rounds_scan_xs,
             quarantine_rounds=cfg.quarantine_rounds,
+            pipeline=self._pipeline,
+            donate_state=self._donate,
         )
         self.eval_fn = make_eval_fn(self.task, mesh)
+        self._inventory = None  # device-resident site inventory, one per fit
+        self._inventory_src = None  # content fingerprint it was built from
         # ship inputs to the device pre-cast to the model's compute dtype
         # (e.g. bf16): the model casts them anyway, and feeding f32 made XLA
         # convert + layout-copy the whole epoch input on-device every epoch
@@ -157,29 +180,106 @@ class FederatedTrainer:
             return put_site_batch(self.mesh, live)
         return jnp.asarray(live)
 
-    def run_epoch(self, state, train_sites, epoch: int, batch_size=None):
+    def _snapshot(self, state):
+        """An independent copy of a state's buffers. With
+        ``cfg.donate_epoch_state`` the NEXT epoch_fn call consumes (donates)
+        its input state's buffers in place — so any state kept past that
+        call (best-state tracking) must be snapshotted, never aliased."""
+        if not self._donate:
+            return state
+        return jax.tree.map(jnp.copy, state)
+
+    def _ensure_inventory(self, train_sites):
+        """Device-resident inventory: uploaded once per fit, inputs pre-cast
+        to the compute dtype at placement. Keyed by a content fingerprint
+        (per-site array identities + sizes), not list identity, so a caller
+        rebuilding its site LIST per run_epoch call (``list(sites)``) still
+        reuses the resident upload — re-uploading per epoch would silently
+        reinstate the dataset-sized transfer this pipeline removes."""
+        key = tuple(
+            (id(s.inputs), id(s.labels), len(s)) for s in train_sites
+        )
+        if self._inventory is None or self._inventory_src != key:
+            from ..parallel.distributed import put_site_inventory
+
+            self._inventory = put_site_inventory(
+                self.mesh, stack_site_inventory(train_sites), self._input_dtype
+            )
+            self._inventory_src = key
+        return self._inventory
+
+    def _build_epoch_payload(self, train_sites, epoch: int, batch_size: int,
+                             round0: int):
+        """One epoch's device-pipeline inputs: the compact index plan plus the
+        FaultPlan masks for its global round window — the complete per-epoch
+        host→device transfer (index-plan bytes, not dataset bytes). Pure
+        function of ``(epoch, round0)``, so the prefetch thread can build
+        epoch N+1 while epoch N runs without changing results."""
+        from ..robustness.faults import fault_window
+
+        plan = plan_epoch_positions(
+            train_sites, batch_size,
+            seed=self.cfg.seed * 100003 + epoch, pad_mode="wrap",
+        )
+        rounds = plan.steps // max(self.cfg.local_iterations, 1)
+        live, nan_mask = fault_window(
+            self.fault_plan, plan.num_sites, round0, rounds
+        )
+        # the NaN gate is fed whenever the PLAN carries nan_at (a fit-static
+        # property), not only in windows that poison — the compiled program
+        # must not change between epochs
+        poison = (
+            nan_mask.astype(np.float32)
+            if nan_mask is not None and self.fault_plan.nan_at else None
+        )
+        from ..parallel.distributed import put_epoch_plan
+
+        return put_epoch_plan(self.mesh, plan.positions, live, poison)
+
+    def run_epoch(self, state, train_sites, epoch: int, batch_size=None,
+                  plan=None):
+        """One training epoch. Device pipeline: gathers batches on-device
+        from the resident inventory, driven by ``plan`` (a prefetched
+        ``_build_epoch_payload`` result; built inline when None). Host
+        pipeline: materializes and ships the dense epoch tensor."""
+        if self._pipeline == "device":
+            if plan is None:
+                plan = self._build_epoch_payload(
+                    train_sites, epoch, batch_size or self.cfg.batch_size,
+                    round0=int(state.round),
+                )
+            idx, live, poison = plan
+            inv_x, inv_y = self._ensure_inventory(train_sites)
+            state, losses = self.epoch_fn(state, inv_x, inv_y, idx, live, poison)
+            return state, np.asarray(losses)
         fb = plan_epoch(
             train_sites,
             batch_size or self.cfg.batch_size,
             seed=self.cfg.seed * 100003 + epoch,
             pad_mode="wrap",
         )
-        live = None
+        # deterministic chaos: masks/poison are pure functions of the plan
+        # and the GLOBAL round window (robustness/faults.py fault_window —
+        # shared with the device path), so resume replays the same fault
+        # pattern the uninterrupted run saw
+        from ..robustness.faults import fault_window
+
+        live = nan_mask = None
         if self.fault_plan is not None and self.fault_plan.injects_faults():
-            # deterministic chaos: masks/poison are pure functions of the
-            # plan and the GLOBAL round window, so resume replays the same
-            # fault pattern the uninterrupted run saw
+            # (the injects_faults gate also keeps the int(state.round) fetch
+            # — a device sync — off the clean path)
             rounds = fb.steps // max(self.cfg.local_iterations, 1)
-            round0 = int(state.round)
-            live = self.fault_plan.liveness(fb.num_sites, round0, rounds)
-            nan_mask = self.fault_plan.nan_mask(fb.num_sites, round0, rounds)
-            if nan_mask.any():  # data-layer injection: real NaN inputs
-                fb = dataclasses.replace(
-                    fb,
-                    inputs=poison_inputs(
-                        fb.inputs, nan_mask, self.cfg.local_iterations
-                    ),
-                )
+            live, nan_mask = fault_window(
+                self.fault_plan, fb.num_sites, int(state.round), rounds
+            )
+        if nan_mask is not None and nan_mask.any():
+            # data-layer injection: real NaN inputs
+            fb = dataclasses.replace(
+                fb,
+                inputs=poison_inputs(
+                    fb.inputs, nan_mask, self.cfg.local_iterations
+                ),
+            )
         state, losses = self.epoch_fn(
             state, *self._put_batch(fb), self._put_live(live)
         )
@@ -349,7 +449,9 @@ class FederatedTrainer:
 
         best_metric = None
         best_epoch = 0
-        best_state = state
+        # snapshot, never alias: with donate_epoch_state the next epoch_fn
+        # call consumes `state`'s buffers in place (trainer/steps.py)
+        best_state = self._snapshot(state)
         since_best = 0
         epoch_losses = []
         iter_durations = []
@@ -375,7 +477,9 @@ class FederatedTrainer:
             # continue the cumulative wall-clock line from its stored total
             if cum:
                 t_start = time.time() - cum[-1]
-            best_state = (
+            # snapshot either way: a load falling back to template leaves
+            # (engine-structure change) would otherwise alias `state`
+            best_state = self._snapshot(
                 load_checkpoint(best_path, state)
                 if (os.path.exists(best_path)
                     or os.path.exists(best_path + ".prev"))
@@ -399,13 +503,32 @@ class FederatedTrainer:
             self.fault_plan.kill_at_round if self.fault_plan is not None else None
         )
         round_before = int(state.round) if kill_round is not None else 0
+        prefetch = None
+        if self._pipeline == "device" and start_epoch <= cfg.epochs:
+            # double-buffered planner (trainer/prefetch.py): a background
+            # thread builds epoch N+1's index plan and dispatches its
+            # KB-sized transfer while epoch N's fused dispatch runs. Plans
+            # are pure functions of (epoch, global round window) — the round
+            # counter extrapolates linearly from here, resume included — so
+            # prefetching cannot change results.
+            rpe = epoch_steps(train_sites, cfg.batch_size) // max(
+                cfg.local_iterations, 1
+            )
+            round0, first = int(state.round), start_epoch
+            prefetch = EpochPlanPrefetcher(
+                lambda e: self._build_epoch_payload(
+                    train_sites, e, cfg.batch_size, round0 + (e - first) * rpe
+                ),
+                start_epoch, cfg.epochs,
+            )
         guard = PreemptionGuard()
         try:
             with guard:
                 for epoch in range(start_epoch, cfg.epochs + 1):
                     e_start = time.time()
                     state, losses = self.run_epoch(
-                        state, train_sites, epoch, batch_size=cfg.batch_size
+                        state, train_sites, epoch, batch_size=cfg.batch_size,
+                        plan=(None if prefetch is None else prefetch.get(epoch)),
                     )
                     # all-dead rounds report NaN loss (trainer/steps.py) —
                     # average over the rounds that actually trained
@@ -429,7 +552,8 @@ class FederatedTrainer:
                             if is_improvement(
                                 score, best_metric, direction if monitor != "loss" else "minimize"
                             ):
-                                best_metric, best_epoch, best_state = score, epoch, state
+                                best_metric, best_epoch = score, epoch
+                                best_state = self._snapshot(state)
                                 since_best = 0
                                 if best_path and self._coordinator():  # save-on-best
                                     save_checkpoint(
@@ -449,7 +573,7 @@ class FederatedTrainer:
                         else:
                             # no validation anywhere (kfold k==2): the latest
                             # state is the selected state; no early stopping
-                            best_epoch, best_state = epoch, state
+                            best_epoch, best_state = epoch, self._snapshot(state)
                             if verbose:
                                 log_info(
                                     f"[fold {fold}] epoch {epoch}: "
@@ -503,6 +627,11 @@ class FederatedTrainer:
                         stop_epoch = epoch
                         break
         finally:
+            # prompt, leak-free shutdown on EVERY exit — early stop,
+            # Preempted (SIGTERM / FaultPlan kill), or a crash: a resumed run
+            # must never inherit a live prefetch thread
+            if prefetch is not None:
+                prefetch.close()
             if cfg.profile_dir:
                 jax.profiler.stop_trace()
 

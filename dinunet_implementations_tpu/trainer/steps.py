@@ -167,6 +167,27 @@ def init_train_state(
     )
 
 
+def _gather_batch(inv_x, inv_y, ixs, poison):
+    """On-device batch gather for ONE site: ``ixs [L, B]`` sample positions
+    into the site's resident inventory (``inv_x [N, ...]``, ``inv_y [N]``);
+    ``-1`` marks padding. Reproduces the host materialization bit-for-bit:
+    padding slots become zero inputs / zero labels / zero weight, and
+    ``poison`` (the round's NaN-injection gate, robustness/faults.py — a
+    traced scalar, non-None only when the epoch was compiled for a
+    NaN-carrying FaultPlan) overwrites the whole round block with NaN exactly
+    like ``poison_inputs`` does on host arrays."""
+    valid = ixs >= 0
+    flat = jnp.maximum(ixs, 0).reshape(-1)
+    xb = jnp.take(inv_x, flat, axis=0).reshape(ixs.shape + inv_x.shape[1:])
+    yb = jnp.take(inv_y, flat, axis=0).reshape(ixs.shape)
+    mask = valid.reshape(valid.shape + (1,) * (xb.ndim - valid.ndim))
+    xb = jnp.where(mask, xb, jnp.zeros((), xb.dtype))
+    yb = jnp.where(valid, yb, 0)
+    if poison is not None:
+        xb = jnp.where(poison > 0, jnp.full((), jnp.nan, xb.dtype), xb)
+    return xb, yb, valid.astype(jnp.float32)
+
+
 def make_train_epoch_fn(
     task: FederatedTask,
     engine: Engine,
@@ -175,6 +196,8 @@ def make_train_epoch_fn(
     local_iterations: int = 1,
     rounds_scan_xs: bool = True,
     quarantine_rounds: int | None = 3,
+    pipeline: str = "host",
+    donate_state: bool = False,
 ):
     """Build the jitted epoch function.
 
@@ -183,6 +206,27 @@ def make_train_epoch_fn(
     ``local_iterations`` micro-batches (trailing remainder < local_iterations
     is dropped, mirroring drop_last at round granularity); returns
     ``(state, per-round weighted loss [rounds])``.
+
+    ``pipeline="device"`` swaps the dense epoch inputs for the
+    device-resident form: the returned function takes ``(state,
+    inv_x [S, N_max, ...], inv_y [S, N_max], idx [S, steps, B], live=None,
+    poison=None)`` — the inventory is uploaded once per fit and reused every
+    epoch, the per-epoch transfer is the int32 index plan
+    (data/batching.py EpochPlan), and batches are gathered on-device
+    round-by-round inside the scan (``jnp.take`` along the inventory axis;
+    weights/padding derived from ``idx``, bit-exact with the host
+    materialization). ``poison [S, rounds]`` is the FaultPlan NaN-injection
+    mask (a traced input like ``live`` — one compiled program per fit
+    regardless of the fault pattern). The device path always delivers rounds
+    as scan xs (the index plan is KB-sized; ``rounds_scan_xs`` only governs
+    the host path's dense arrays).
+
+    ``donate_state=True`` donates the carried ``state`` argument's buffers to
+    the epoch (``jax.jit(donate_argnums=0)``): the update writes in place
+    instead of allocating a second params+optimizer copy per epoch. Callers
+    must treat the passed-in state as CONSUMED — rebind to the returned state
+    and snapshot (copy) anything kept longer (the trainer's best-state
+    tracking does exactly that).
 
     Fault tolerance (robustness/): ``live [S, rounds]`` is the optional
     scheduled-liveness mask — a TRACED input, so a different fault pattern
@@ -210,6 +254,7 @@ def make_train_epoch_fn(
       sites (BASELINE.json north star) at full MXU utilization.
     """
 
+    assert pipeline in ("host", "device"), pipeline
     model_axis = _model_axis_of(mesh)
     if quarantine_rounds is None:
         quarantine_rounds = 3  # the default threshold
@@ -233,8 +278,16 @@ def make_train_epoch_fn(
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
-    def epoch_over_sites(state: TrainState, x, y, w, live, site_axes, inner_axis):
+    def epoch_over_sites(state: TrainState, x, y, w, live, site_axes,
+                         inner_axis, inventory=None, poison=None):
         """Run one epoch for the k in-device sites in ``x [k, steps, B, ...]``.
+
+        Device pipeline (``inventory`` given): ``x`` is the ``[k, steps, B]``
+        int32 index plan instead (``y``/``w`` are None) and each round's batch
+        is gathered on-device from the resident ``inventory = (inv_x, inv_y)``
+        just before its gradients — only one round's ``[k, L, B, ...]`` block
+        is ever materialized, so peak HBM holds the inventory, not the dense
+        epoch tensor.
 
         Only the per-site work (grads, engine aggregation, stat sync) runs
         under the inner vmap; the optimizer update applies ONCE per round on
@@ -260,9 +313,16 @@ def make_train_epoch_fn(
         def split_rounds(a):
             return a[:, :L].reshape((k, rounds, local_iterations) + a.shape[2:])
 
-        x_rounds, y_rounds, w_rounds = (
-            split_rounds(x), split_rounds(y), split_rounds(w)
+        # device pipeline: x IS the index plan; one split covers it. The
+        # index plan is KB-sized, so it always rides as scan xs regardless of
+        # the rounds_scan_xs arm (which exists for multi-GB dense inputs).
+        x_rounds = split_rounds(x)
+        y_rounds, w_rounds = (
+            (None, None) if inventory is not None
+            else (split_rounds(y), split_rounds(w))
         )
+        poison_rounds = None if poison is None else poison[:, :rounds]
+        use_scan_xs = rounds_scan_xs or inventory is not None
         # scheduled liveness, [k, rounds] f32 (None → all live; the branch is
         # trace-time static, so both forms compile once each, never per mask)
         live_rounds = (
@@ -278,12 +338,20 @@ def make_train_epoch_fn(
 
         def one_round(carry, xs):
             params, batch_stats, opt_state, engine_state, health, rng, rnd = carry
-            if rounds_scan_xs:
-                if live_rounds is None:
-                    xb, yb, wb = xs
-                    lb = jnp.ones((k,), jnp.float32)
+            pz = None
+            if use_scan_xs:
+                parts = list(xs)
+                if inventory is not None:
+                    ib = parts.pop(0)  # [k, L, B] — this round's index block
+                    if poison_rounds is not None:
+                        pz = parts.pop(0)  # [k] — this round's NaN gate
                 else:
-                    xb, yb, wb, lb = xs  # [k, L, B, ...] — this round's block
+                    xb, yb, wb = parts[:3]  # [k, L, B, ...] — this round
+                    parts = parts[3:]
+                lb = (
+                    parts.pop(0) if live_rounds is not None
+                    else jnp.ones((k,), jnp.float32)
+                )
             else:
                 xb, yb, wb = (
                     jax.lax.dynamic_index_in_dim(a, xs, axis=1, keepdims=False)
@@ -295,6 +363,16 @@ def make_train_epoch_fn(
                         live_rounds, xs, axis=1, keepdims=False
                     )
                 )
+            if inventory is not None:
+                # on-device batch gather from the resident inventory — only
+                # this round's [k, L, B, ...] block is materialized
+                inv_x, inv_y = inventory
+                if pz is None:
+                    xb, yb, wb = jax.vmap(
+                        lambda ex, ey, ixs: _gather_batch(ex, ey, ixs, None)
+                    )(inv_x, inv_y, ib)
+                else:
+                    xb, yb, wb = jax.vmap(_gather_batch)(inv_x, inv_y, ib, pz)
             rng, sub = jax.random.split(rng)
 
             def site_part(es, hs, ls, xs, ys, ws):
@@ -462,10 +540,16 @@ def make_train_epoch_fn(
         # so peak HBM residency grows by ~1x the epoch-input size. For
         # epoch inputs big enough for that to matter (multi-GB), pass
         # rounds_scan_xs=False.
-        if rounds_scan_xs:
-            xs = tuple(
-                jnp.moveaxis(a, 1, 0) for a in (x_rounds, y_rounds, w_rounds)
-            )
+        if use_scan_xs:
+            if inventory is not None:
+                xs = (jnp.moveaxis(x_rounds, 1, 0),)
+                if poison_rounds is not None:
+                    xs = xs + (jnp.moveaxis(poison_rounds, 1, 0),)
+            else:
+                xs = tuple(
+                    jnp.moveaxis(a, 1, 0)
+                    for a in (x_rounds, y_rounds, w_rounds)
+                )
             if live_rounds is not None:
                 xs = xs + (jnp.moveaxis(live_rounds, 1, 0),)
         else:
@@ -497,7 +581,58 @@ def make_train_epoch_fn(
             state = state.replace(health=default_health(inputs.shape[0]))
         return state
 
-    if mesh is not None:
+    # donate the carried state's buffers to the epoch program: the update
+    # aliases in place instead of allocating a second params+opt copy. The
+    # caller contract (rebind, snapshot what you keep) is documented above.
+    jit_kw = {"donate_argnums": (0,)} if donate_state else {}
+
+    if pipeline == "device" and mesh is not None:
+
+        def epoch_fn_impl(state: TrainState, inv_x, inv_y, idx, live=None,
+                          poison=None):
+            state = _ensure_health(state, idx)
+            specs = _state_specs(state)
+            # optional traced inputs (liveness / NaN gate): trace-time
+            # presence branches, one compiled program per form — a fit feeds
+            # a fixed form, so the compile counter still sees one program
+            extras = [a for a in (live, poison) if a is not None]
+            has_live, has_poison = live is not None, poison is not None
+
+            def wrapped(st, ex, ey, ix, *opt):
+                opt = list(opt)
+                lv = opt.pop(0) if has_live else None
+                pz = opt.pop(0) if has_poison else None
+                return epoch_over_sites(
+                    st, ix, None, None, lv, site_axes=(SITE_AXIS, FOLD_AXIS),
+                    inner_axis=FOLD_AXIS, inventory=(ex, ey), poison=pz,
+                )
+
+            return shard_map(
+                wrapped,
+                mesh=mesh,
+                in_specs=(specs, P(SITE_AXIS), P(SITE_AXIS), P(SITE_AXIS))
+                + (P(SITE_AXIS),) * len(extras),
+                out_specs=(specs, P()),
+                check_vma=False,
+            )(state, inv_x, inv_y, idx, *extras)
+
+        epoch_fn = jax.jit(epoch_fn_impl, **jit_kw)
+
+    elif pipeline == "device":
+
+        def epoch_fn_impl(state: TrainState, inv_x, inv_y, idx, live=None,
+                          poison=None):
+            # all S sites fold onto the local device: the inner vmap IS the
+            # site axis; the gather vmaps over the same leading site dim
+            return epoch_over_sites(
+                _ensure_health(state, idx), idx, None, None, live,
+                site_axes=SITE_AXIS, inner_axis=SITE_AXIS,
+                inventory=(inv_x, inv_y), poison=poison,
+            )
+
+        epoch_fn = jax.jit(epoch_fn_impl, **jit_kw)
+
+    elif mesh is not None:
 
         def shard_wrapped(st, x, y, w, lv=None):
             # x: [k, steps, B, ...] — this device's block of k sites. k > 1 is
@@ -509,8 +644,7 @@ def make_train_epoch_fn(
                 inner_axis=FOLD_AXIS,
             )
 
-        @jax.jit
-        def epoch_fn(state: TrainState, inputs, labels, weights, live=None):
+        def epoch_fn_impl(state: TrainState, inputs, labels, weights, live=None):
             state = _ensure_health(state, inputs)
             specs = _state_specs(state)
             in_specs = (specs, P(SITE_AXIS), P(SITE_AXIS), P(SITE_AXIS))
@@ -526,16 +660,19 @@ def make_train_epoch_fn(
                 check_vma=False,
             )(*args)
 
+        epoch_fn = jax.jit(epoch_fn_impl, **jit_kw)
+
     else:
 
-        @jax.jit
-        def epoch_fn(state: TrainState, inputs, labels, weights, live=None):
+        def epoch_fn_impl(state: TrainState, inputs, labels, weights, live=None):
             # all S sites fold onto the local device: the inner vmap IS the
             # site axis
             return epoch_over_sites(
                 _ensure_health(state, inputs), inputs, labels, weights, live,
                 site_axes=SITE_AXIS, inner_axis=SITE_AXIS,
             )
+
+        epoch_fn = jax.jit(epoch_fn_impl, **jit_kw)
 
     return epoch_fn
 

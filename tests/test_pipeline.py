@@ -1,0 +1,428 @@
+"""Device-resident input pipeline tests: index plans, on-device gather
+bit-exactness, state donation, prefetch lifecycle, and the persistent
+compilation cache (ISSUE 4 tentpole)."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dinunet_implementations_tpu.core.config import TrainConfig
+from dinunet_implementations_tpu.data.api import SiteArrays, stack_site_inventory
+from dinunet_implementations_tpu.data.batching import (
+    epoch_steps,
+    materialize_plan,
+    plan_epoch,
+    plan_epoch_positions,
+)
+from dinunet_implementations_tpu.engines import make_engine
+from dinunet_implementations_tpu.models import MSANNet
+from dinunet_implementations_tpu.parallel import host_mesh
+from dinunet_implementations_tpu.robustness import FaultPlan, Preempted, poison_inputs
+from dinunet_implementations_tpu.trainer import (
+    FederatedTask,
+    FederatedTrainer,
+    init_train_state,
+    make_optimizer,
+    make_train_epoch_fn,
+)
+
+
+def _mk_site(n, d=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    return SiteArrays(X, (X.sum(-1) > 0).astype(np.int32),
+                      np.arange(n, dtype=np.int32))
+
+
+def _hetero_sites():
+    # heterogeneous sizes: wrap recycling, an undersized site, a multi-wrap
+    # site — the shapes the FS fixture (73-120 subjects) produces
+    return [_mk_site(40, seed=1), _mk_site(21, seed=2), _mk_site(33, seed=3)]
+
+
+def _toy_sites(ns, n=40, seed=0):
+    return [_mk_site(n, seed=seed + i) for i in range(ns)]
+
+
+# ---------------------------------------------------------------------------
+# plan_epoch refactor: index plans + the wrap-mode tiling (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _legacy_plan_epoch(sites, batch_size, seed=0, shuffle=True,
+                       drop_last=True, pad_mode="wrap"):
+    """The pre-refactor plan_epoch (repeated list concatenation per site),
+    kept verbatim as the behavioral reference for the index-math rewrite."""
+    def site_batches(order):
+        n = len(order)
+        if drop_last:
+            n = (n // batch_size) * batch_size
+        return [order[i:i + batch_size] for i in range(0, n, batch_size)]
+
+    S = len(sites)
+    feat_shape = next(s.inputs.shape[1:] for s in sites if len(s))
+    rng = np.random.default_rng(seed)
+    per_site = []
+    for s in sites:
+        order = rng.permutation(len(s)) if shuffle else np.arange(len(s))
+        per_site.append(site_batches(order))
+    steps = max(len(b) for b in per_site)
+    inputs = np.zeros((S, steps, batch_size) + feat_shape, np.float32)
+    labels = np.zeros((S, steps, batch_size), np.int32)
+    weights = np.zeros((S, steps, batch_size), np.float32)
+    indices = np.full((S, steps, batch_size), -1, np.int32)
+    for si, (site, batches) in enumerate(zip(sites, per_site)):
+        if pad_mode == "wrap" and batches:
+            while len(batches) < steps:
+                order = rng.permutation(len(site)) if shuffle else np.arange(len(site))
+                batches = batches + site_batches(order)
+            batches = batches[:steps]
+        for bi, ix in enumerate(batches):
+            k = len(ix)
+            sel = site.take(ix)
+            inputs[si, bi, :k] = sel.inputs
+            labels[si, bi, :k] = sel.labels
+            weights[si, bi, :k] = 1.0
+            indices[si, bi, :k] = sel.indices
+    return inputs, labels, weights, indices
+
+
+@pytest.mark.parametrize("pad_mode,drop_last", [
+    ("wrap", True), ("mask", True), ("mask", False), ("wrap", False),
+])
+@pytest.mark.parametrize("seed", [0, 7])
+def test_plan_epoch_bitstable_across_tiling_refactor(pad_mode, drop_last, seed):
+    """The wrap-mode tiling rewrite (single computed tiling of reshuffled
+    orders instead of repeated list concatenation) must reproduce the legacy
+    planner bit-for-bit — same RNG draw sequence, same batches."""
+    sites = _hetero_sites() + [_mk_site(0, seed=9)]  # incl. an empty site
+    fb = plan_epoch(sites, 8, seed=seed, pad_mode=pad_mode, drop_last=drop_last)
+    li, ll, lw, lx = _legacy_plan_epoch(
+        sites, 8, seed=seed, pad_mode=pad_mode, drop_last=drop_last
+    )
+    np.testing.assert_array_equal(fb.inputs, li)
+    np.testing.assert_array_equal(fb.labels, ll)
+    np.testing.assert_array_equal(fb.weights, lw)
+    np.testing.assert_array_equal(fb.indices, lx)
+
+
+def test_plan_positions_are_compact_and_consistent():
+    sites = _hetero_sites()
+    plan = plan_epoch_positions(sites, 8, seed=3, pad_mode="wrap")
+    assert plan.positions.dtype == np.int32
+    assert plan.steps == epoch_steps(sites, 8)
+    # every live position indexes into its own site's inventory
+    for si, s in enumerate(sites):
+        pos = plan.positions[si]
+        assert pos.max() < len(s)
+        live = pos[pos >= 0]
+        assert (live >= 0).all()
+    # the plan is ~bytes where the dense tensor is ~kilobytes per sample
+    fb = materialize_plan(sites, plan)
+    assert plan.nbytes * 4 < fb.inputs.nbytes
+
+
+# ---------------------------------------------------------------------------
+# device path == host path, bit-exact (tentpole invariant)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("pad_mode", ["wrap", "mask"])
+@pytest.mark.parametrize("use_mesh", [False, True])
+def test_device_epoch_matches_host_bit_exact(pad_mode, use_mesh):
+    """The on-device gather epoch must equal the host-materialized epoch
+    bit-for-bit: params, losses, and health, for both pad modes, on both the
+    vmap-folded and shard_map topologies."""
+    sites = _hetero_sites()
+    mesh = host_mesh(3) if use_mesh else None
+    task = FederatedTask(MSANNet(in_size=6, hidden_sizes=(16,), out_size=2))
+    engine = make_engine("dSGD")
+    opt = make_optimizer("adam", 1e-2)
+    plan = plan_epoch_positions(sites, 8, seed=7, pad_mode=pad_mode,
+                                drop_last=(pad_mode == "wrap"))
+    fb = materialize_plan(sites, plan)
+    inv = stack_site_inventory(sites)
+    s0 = init_train_state(task, engine, opt, jax.random.PRNGKey(0),
+                          jnp.ones((4, 6)), num_sites=3)
+    fh = make_train_epoch_fn(task, engine, opt, mesh, 2)
+    fd = make_train_epoch_fn(task, engine, opt, mesh, 2, pipeline="device",
+                             donate_state=True)
+    sh, lh = fh(s0, jnp.asarray(fb.inputs), jnp.asarray(fb.labels),
+                jnp.asarray(fb.weights))
+    s0d = jax.tree.map(jnp.copy, s0)
+    sd, ld = fd(s0d, jnp.asarray(inv.inputs), jnp.asarray(inv.labels),
+                jnp.asarray(plan.positions))
+    np.testing.assert_array_equal(np.asarray(lh), np.asarray(ld))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        (sh.params, sh.health), (sd.params, sd.health),
+    )
+
+
+@pytest.mark.parametrize("use_mesh", [False, True])
+def test_device_epoch_matches_host_with_fault_plan(use_mesh):
+    """Scheduled drops + data-layer NaN poisoning: the device path's traced
+    poison gate must reproduce the host path's poisoned dense tensor —
+    identical losses, params, and quarantine counters."""
+    import dataclasses
+
+    sites = _hetero_sites()
+    mesh = host_mesh(3) if use_mesh else None
+    L = 2
+    fp = FaultPlan(drop=((1, 1, 1),), nan_at=((0, 2),))
+    task = FederatedTask(MSANNet(in_size=6, hidden_sizes=(16,), out_size=2))
+    engine = make_engine("dSGD")
+    opt = make_optimizer("adam", 1e-2)
+    plan = plan_epoch_positions(sites, 8, seed=7, pad_mode="wrap")
+    fb = materialize_plan(sites, plan)
+    rounds = plan.steps // L
+    live = fp.liveness(3, 0, rounds)
+    nan = fp.nan_mask(3, 0, rounds)
+    fb = dataclasses.replace(fb, inputs=poison_inputs(fb.inputs, nan, L))
+    inv = stack_site_inventory(sites)
+    s0 = init_train_state(task, engine, opt, jax.random.PRNGKey(0),
+                          jnp.ones((4, 6)), num_sites=3)
+    fh = make_train_epoch_fn(task, engine, opt, mesh, L)
+    fd = make_train_epoch_fn(task, engine, opt, mesh, L, pipeline="device",
+                             donate_state=True)
+    sh, lh = fh(s0, jnp.asarray(fb.inputs), jnp.asarray(fb.labels),
+                jnp.asarray(fb.weights), jnp.asarray(live))
+    sd, ld = fd(jax.tree.map(jnp.copy, s0), jnp.asarray(inv.inputs),
+                jnp.asarray(inv.labels), jnp.asarray(plan.positions),
+                jnp.asarray(live), jnp.asarray(nan.astype(np.float32)))
+    np.testing.assert_array_equal(np.asarray(lh), np.asarray(ld))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        (sh.params, sh.health), (sd.params, sd.health),
+    )
+
+
+def test_trainer_device_fit_matches_host_fit():
+    """End-to-end: a full fit under cfg.pipeline='device' (donation +
+    prefetch included) equals the host-pipeline fit exactly — losses,
+    selection, and test metrics."""
+    res = {}
+    for pipe in ("host", "device"):
+        cfg = TrainConfig(epochs=5, batch_size=8, pipeline=pipe)
+        tr = FederatedTrainer(
+            cfg, MSANNet(in_size=6, hidden_sizes=(16,), out_size=2),
+            host_mesh(2),
+        )
+        res[pipe] = tr.fit(_toy_sites(2, seed=1), _toy_sites(2, n=16, seed=2),
+                           _toy_sites(2, n=16, seed=3), verbose=False)
+    np.testing.assert_array_equal(res["host"]["epoch_losses"],
+                                  res["device"]["epoch_losses"])
+    assert res["host"]["test_metrics"] == res["device"]["test_metrics"]
+    assert res["host"]["best_val_epoch"] == res["device"]["best_val_epoch"]
+
+
+def test_trainer_device_fit_matches_host_fit_with_faults():
+    """Chaos stays green AND identical on the device path: drops + NaN
+    poisoning through the full trainer produce the same epoch losses and
+    health counters as the host path."""
+    fp = FaultPlan(drop=((1, 2, 3),), nan_at=((1, 0),))
+    res = {}
+    for pipe in ("host", "device"):
+        cfg = TrainConfig(epochs=4, batch_size=8, pipeline=pipe)
+        tr = FederatedTrainer(
+            cfg, MSANNet(in_size=6, hidden_sizes=(16,), out_size=2),
+            host_mesh(2), fault_plan=fp,
+        )
+        res[pipe] = tr.fit(_toy_sites(2, seed=1), _toy_sites(2, n=16, seed=2),
+                           _toy_sites(2, n=16, seed=3), verbose=False)
+    np.testing.assert_allclose(res["host"]["epoch_losses"],
+                               res["device"]["epoch_losses"], rtol=0, atol=0)
+    assert res["host"]["site_health"] == res["device"]["site_health"]
+    assert res["host"]["test_metrics"] == res["device"]["test_metrics"]
+
+
+# ---------------------------------------------------------------------------
+# donation sanity (satellite): donated buffers are consumed, never reused
+# ---------------------------------------------------------------------------
+
+
+def test_donated_state_buffers_are_released():
+    """donate_state=True must actually donate: the input state's buffers are
+    deleted after dispatch, and chaining from the RETURNED state works."""
+    sites = _hetero_sites()
+    task = FederatedTask(MSANNet(in_size=6, hidden_sizes=(8,), out_size=2))
+    engine = make_engine("dSGD")
+    opt = make_optimizer("adam", 1e-2)
+    plan = plan_epoch_positions(sites, 8, seed=1)
+    inv = stack_site_inventory(sites)
+    s0 = init_train_state(task, engine, opt, jax.random.PRNGKey(0),
+                          jnp.ones((4, 6)), num_sites=3)
+    fd = make_train_epoch_fn(task, engine, opt, None, 1, pipeline="device",
+                             donate_state=True)
+    args = (jnp.asarray(inv.inputs), jnp.asarray(inv.labels),
+            jnp.asarray(plan.positions))
+    s1, _ = fd(s0, *args)
+    leaf = s0.params["linear_0"]["kernel"]
+    if not hasattr(leaf, "is_deleted"):
+        pytest.skip("jax build does not expose buffer deletion state")
+    assert leaf.is_deleted(), "input state must be consumed by donation"
+    s2, _ = fd(s1, *args)  # chaining from the returned state stays valid
+    assert np.isfinite(np.asarray(s2.params["linear_0"]["kernel"])).all()
+    # the INVENTORY is not donated: it must survive every epoch
+    assert not args[0].is_deleted()
+
+
+def test_trainer_never_references_donated_buffers():
+    """Guard for future refactors (the donation-sanity satellite): a full
+    fit with donation enabled must keep best-state tracking on live buffers
+    — the selected state evaluates and serializes after epochs that donated
+    the states it was snapshotted from."""
+    cfg = TrainConfig(epochs=6, batch_size=8, patience=50, pipeline="device",
+                      donate_epoch_state=True)
+    tr = FederatedTrainer(cfg, MSANNet(in_size=6, hidden_sizes=(16,), out_size=2),
+                          host_mesh(2))
+    res = tr.fit(_toy_sites(2, seed=4), _toy_sites(2, n=16, seed=5),
+                 _toy_sites(2, n=16, seed=6), verbose=False)
+    # best_state materializes fully (a donated alias would raise here)
+    leaves = jax.tree.leaves(jax.tree.map(np.asarray, res["state"].params))
+    assert all(np.isfinite(a).all() for a in leaves)
+    assert np.isfinite(res["epoch_losses"]).all()
+    # donation off must give the identical trajectory
+    cfg2 = cfg.replace(donate_epoch_state=False)
+    tr2 = FederatedTrainer(cfg2, MSANNet(in_size=6, hidden_sizes=(16,), out_size=2),
+                           host_mesh(2))
+    res2 = tr2.fit(_toy_sites(2, seed=4), _toy_sites(2, n=16, seed=5),
+                   _toy_sites(2, n=16, seed=6), verbose=False)
+    np.testing.assert_array_equal(res["epoch_losses"], res2["epoch_losses"])
+    assert res["test_metrics"] == res2["test_metrics"]
+
+
+# ---------------------------------------------------------------------------
+# prefetch lifecycle (satellite): clean shutdown on Preempted, resume intact
+# ---------------------------------------------------------------------------
+
+
+def _prefetch_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith("dinunet-epoch-prefetch") and t.is_alive()]
+
+
+def test_prefetch_thread_shutdown_clean_on_preempted(tmp_path):
+    """A FaultPlan kill mid-fit raises Preempted AFTER the checkpoint; the
+    prefetch thread must be joined (no leak into the resumed run), and the
+    resumed fit must finish with the exact uninterrupted trajectory."""
+    model = MSANNet(in_size=6, hidden_sizes=(8,), out_size=2)
+    train = _toy_sites(2, seed=4)
+    val, test = _toy_sites(2, n=16, seed=5), _toy_sites(2, n=16, seed=6)
+    cfg = TrainConfig(epochs=6, batch_size=8, pipeline="device")
+
+    full = FederatedTrainer(cfg, model, host_mesh(2),
+                            out_dir=str(tmp_path / "full"))
+    res_full = full.fit(train, val, test, verbose=False)
+    assert not _prefetch_threads()
+
+    # rounds/epoch = 40//8 = 5 → kill crossing round 12 fires during epoch 3
+    fp = FaultPlan(kill_at_round=12)
+    killed = FederatedTrainer(cfg, model, host_mesh(2),
+                              out_dir=str(tmp_path / "killed"), fault_plan=fp)
+    with pytest.raises(Preempted):
+        killed.fit(train, val, test, verbose=False)
+    assert not _prefetch_threads(), "prefetch thread leaked across Preempted"
+
+    resumed = FederatedTrainer(cfg, model, host_mesh(2),
+                               out_dir=str(tmp_path / "killed"))
+    res_res = resumed.fit(train, val, test, verbose=False, resume=True)
+    assert not _prefetch_threads()
+    assert len(res_res["epoch_losses"]) == len(res_full["epoch_losses"])
+    np.testing.assert_allclose(res_res["epoch_losses"],
+                               res_full["epoch_losses"], atol=1e-6)
+    assert res_res["test_metrics"] == res_full["test_metrics"]
+
+
+def test_prefetcher_builder_error_surfaces():
+    """A crash on the builder thread must re-raise in the consumer, not
+    vanish into the thread (and close() must still be clean)."""
+    from dinunet_implementations_tpu.trainer.prefetch import EpochPlanPrefetcher
+
+    def bad_build(epoch):
+        raise RuntimeError(f"boom at {epoch}")
+
+    pf = EpochPlanPrefetcher(bad_build, 1, 3)
+    with pytest.raises(RuntimeError, match="boom"):
+        pf.get(1)
+    assert not _prefetch_threads()
+
+
+def test_prefetcher_early_stop_close_joins():
+    """Stopping mid-sequence (early stopping) leaves no thread behind even
+    while the builder is blocked on the full queue."""
+    from dinunet_implementations_tpu.trainer.prefetch import EpochPlanPrefetcher
+
+    pf = EpochPlanPrefetcher(lambda e: e * 10, 1, 100)
+    assert pf.get(1) == 10
+    pf.close()
+    pf.close()  # idempotent
+    assert not _prefetch_threads()
+
+
+# ---------------------------------------------------------------------------
+# persistent compile cache (tentpole layer c)
+# ---------------------------------------------------------------------------
+
+
+def test_compile_cache_dir_populates(tmp_path):
+    """cfg.compile_cache_dir wires jax's persistent compilation cache: a fit
+    populates the directory so re-runs/fold re-fits skip XLA."""
+    import os
+
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_secs = jax.config.jax_persistent_cache_min_compile_time_secs
+    prev_size = jax.config.jax_persistent_cache_min_entry_size_bytes
+    cache = str(tmp_path / "xla-cache")
+    try:
+        cfg = TrainConfig(epochs=1, batch_size=8, compile_cache_dir=cache)
+        tr = FederatedTrainer(cfg, MSANNet(in_size=6, hidden_sizes=(8,), out_size=2),
+                              host_mesh(2))
+        assert jax.config.jax_compilation_cache_dir == cache
+        tr.fit(_toy_sites(2, seed=1), _toy_sites(2, n=16, seed=2),
+               _toy_sites(2, n=16, seed=3), verbose=False)
+        assert os.listdir(cache), "fit should populate the compilation cache"
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", prev_secs)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", prev_size)
+
+
+def test_cli_exposes_pipeline_and_compile_cache():
+    from dinunet_implementations_tpu.runner.cli import build_parser
+
+    args = build_parser().parse_args(
+        ["--data-path", ".", "--pipeline", "host", "--compile-cache", "/tmp/cc"]
+    )
+    assert args.pipeline == "host"
+    assert args.compile_cache == "/tmp/cc"
+
+
+# ---------------------------------------------------------------------------
+# sanitizer: one epoch compilation with the device pipeline + donation
+# ---------------------------------------------------------------------------
+
+
+def test_device_pipeline_one_epoch_compile_under_sanitizer(monkeypatch):
+    """CompileGuard acceptance: the device pipeline with donation enabled
+    still compiles exactly ONE epoch program per (engine, topology) fit."""
+    from dinunet_implementations_tpu.checks.sanitize import (
+        jit_cache_size,
+        sanitized_fit,
+    )
+
+    monkeypatch.setenv("DINUNET_SANITIZE", "compile")
+    cfg = TrainConfig(epochs=4, batch_size=8, pipeline="device",
+                      donate_epoch_state=True)
+    tr = FederatedTrainer(cfg, MSANNet(in_size=6, hidden_sizes=(16,), out_size=2),
+                          host_mesh(2))
+    if jit_cache_size(tr.epoch_fn) is None:
+        pytest.skip("jax build exposes no jit cache counter")
+    with sanitized_fit(tr, label="device-pipeline") as report:
+        res = tr.fit(_toy_sites(2, seed=1), _toy_sites(2, n=16, seed=2),
+                     _toy_sites(2, n=16, seed=3), verbose=False)
+        report.note_result(res)
+    assert jit_cache_size(tr.epoch_fn) == 1
