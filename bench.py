@@ -400,17 +400,20 @@ def _setup_pipeline_arm(arm: str, dims: dict | None = None,
       ``pipeline="device"``), with the carried state donated.
 
     Returns ``(run_chain, samples_per_epoch, info)``; ``info`` carries
-    ``transfer_bytes_per_epoch`` and a mutable ``host_s``/``epochs``
-    accumulator for the measured per-epoch host-blocked time (plan build +
-    transfer dispatch — the work the device waits on between fused epoch
-    dispatches). Both arms run the plain jitted epoch (no AOT layouts) so the
-    comparison isolates the input path."""
+    ``transfer_bytes_per_epoch`` and a :class:`SpanTracer` whose ``feed``
+    spans time the per-epoch host-blocked input path (plan build + transfer
+    dispatch — the work the device waits on between fused epoch dispatches).
+    The tracer replaced the hand-rolled ``host_s``/``epochs`` timer dict
+    (telemetry/tracer.py is the one timing helper). Both arms run the plain
+    jitted epoch (no AOT layouts) so the comparison isolates the input
+    path."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from dinunet_implementations_tpu.engines import make_engine
     from dinunet_implementations_tpu.models import ICALstm
+    from dinunet_implementations_tpu.telemetry import SpanTracer
     from dinunet_implementations_tpu.trainer import (
         FederatedTask,
         init_train_state,
@@ -440,7 +443,7 @@ def _setup_pipeline_arm(arm: str, dims: dict | None = None,
         task, engine, opt, jax.random.PRNGKey(0), jnp.asarray(np_x[0, 0]),
         num_sites=S,
     )
-    info = {"host_s": 0.0, "epochs": 0}
+    info = {"tracer": SpanTracer()}
 
     if arm == "host":
         epoch_fn = make_train_epoch_fn(
@@ -449,12 +452,9 @@ def _setup_pipeline_arm(arm: str, dims: dict | None = None,
         )
 
         def feed():
-            t0 = time.perf_counter()
-            args = (jnp.asarray(np_x, dtype=dt), jnp.asarray(np_y),
-                    jnp.asarray(np_w))
-            info["host_s"] += time.perf_counter() - t0
-            info["epochs"] += 1
-            return args
+            with info["tracer"].span("feed"):
+                return (jnp.asarray(np_x, dtype=dt), jnp.asarray(np_y),
+                        jnp.asarray(np_w))
 
         info["transfer_bytes_per_epoch"] = (
             np_x.size * np.dtype(dt).itemsize + np_y.nbytes + np_w.nbytes
@@ -475,11 +475,8 @@ def _setup_pipeline_arm(arm: str, dims: dict | None = None,
         ).copy()
 
         def feed():
-            t0 = time.perf_counter()
-            args = (inv_x, inv_y, jnp.asarray(np_idx))
-            info["host_s"] += time.perf_counter() - t0
-            info["epochs"] += 1
-            return args
+            with info["tracer"].span("feed"):
+                return (inv_x, inv_y, jnp.asarray(np_idx))
 
         info["transfer_bytes_per_epoch"] = np_idx.nbytes
 
@@ -530,8 +527,7 @@ def measure_pipeline_ab(mode: str = "ab", obs: int = 5, n: int = TIMED_EPOCHS,
             arm, dims=dims, donate=donate
         )
         chains[arm](1)  # compile + warm up before any timing starts
-        infos[arm]["host_s"] = 0.0  # exclude warmup from the host-time stats
-        infos[arm]["epochs"] = 0
+        infos[arm]["tracer"].reset()  # exclude warmup from the feed stats
     if len(arms) == 2:
         dists = interleaved_ab(chains, n, obs=obs)
     else:
@@ -554,7 +550,8 @@ def measure_pipeline_ab(mode: str = "ab", obs: int = 5, n: int = TIMED_EPOCHS,
             "donate_state": donate,
             "transfer_bytes_per_epoch": int(info["transfer_bytes_per_epoch"]),
             "host_blocked_ms_per_epoch": round(
-                1e3 * info["host_s"] / max(info["epochs"], 1), 3
+                1e3 * info["tracer"].total_seconds("feed")
+                / max(info["tracer"].count("feed"), 1), 3
             ),
             "samples_per_sec": throughput_stats(dists[arm], samples),
             "unit": "samples/sec/chip",

@@ -26,7 +26,7 @@ from .core.config import (
 )
 from .parallel.mesh import MODEL_AXIS, SITE_AXIS, host_mesh, make_site_mesh
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"
 
 
 def __getattr__(name):
@@ -48,4 +48,8 @@ def __getattr__(name):
         from . import robustness
 
         return getattr(robustness, name)
+    if name in ("SpanTracer", "FitTelemetry"):
+        from . import telemetry
+
+        return getattr(telemetry, name)
     raise AttributeError(name)

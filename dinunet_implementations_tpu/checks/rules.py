@@ -23,6 +23,7 @@ PRINT_ALLOWED_FILES = {
     "data/demo.py",  # demo-tree generator CLI
     "analysis.py",  # notebook-parity report CLI (prints summary_markdown)
     "checks/__main__.py",  # this analyzer's own CLI
+    "telemetry/report.py",  # telemetry run-summary CLI (tables on stdout)
 }
 
 #: R002 — packages where a swallowed ``except Exception`` can eat the
@@ -72,6 +73,10 @@ TRAIN_STATE_FILE = "trainer/steps.py"
 CHECKPOINT_FILE = "trainer/checkpoint.py"
 #: payload keys that are serializer bookkeeping, not TrainState fields
 CHECKPOINT_EXTRA_KEYS = {"meta_json"}
+
+#: R007 — telemetry API calls whose NAME argument (positional 0 or ``name=``)
+#: must be trace-stable (telemetry/tracer.py span/event/counter).
+TELEMETRY_NAME_CALLS = {"span", "event", "counter"}
 
 
 # -- registry ---------------------------------------------------------------
@@ -435,6 +440,55 @@ def r005_no_tracer_escapes(sf: SourceFile):
                     )
 
     yield from scan(sf.tree.body, False)
+
+
+# -- R007 -------------------------------------------------------------------
+
+
+def _is_trace_stable_name(arg: ast.expr) -> bool:
+    """A span/metric name the trace consumer can grep for: a string literal,
+    or an UPPER_CASE module-level-constant reference (``SPAN_EPOCH``,
+    ``tracer_names.FIT``)."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return True
+    if isinstance(arg, ast.Name):
+        return arg.id == arg.id.upper()
+    if isinstance(arg, ast.Attribute):
+        return arg.attr == arg.attr.upper()
+    return False
+
+
+@rule(
+    "R007",
+    "telemetry span/metric names are string literals or constants",
+    "pass a string literal (or an UPPER_CASE module-level constant) as the "
+    "span/event/counter name — f-strings and runtime-built names make traces "
+    "ungreppable and unstable across runs; put variable parts in keyword "
+    "attributes instead (tracer.span('epoch', epoch=e))",
+)
+def r007_telemetry_names(sf: SourceFile):
+    """The telemetry artifacts are only as useful as their names are stable:
+    a span named ``f"epoch-{i}"`` explodes one logical phase into N trace
+    rows, breaks the report CLI's phase table, and defeats grepping a trace
+    for a known phase. Names must be literals (or constants); the variable
+    part belongs in span attributes."""
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _callee_name(node) not in TELEMETRY_NAME_CALLS:
+            continue
+        args = [a for a in node.args]
+        for kw in node.keywords:
+            if kw.arg == "name":
+                args.insert(0, kw.value)
+        if not args:
+            continue
+        if not _is_trace_stable_name(args[0]):
+            yield (
+                args[0].lineno, args[0].col_offset,
+                "telemetry name is not a string literal or UPPER_CASE "
+                "constant (trace-stability contract)",
+            )
 
 
 # -- R006 -------------------------------------------------------------------

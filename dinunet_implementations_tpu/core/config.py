@@ -285,6 +285,23 @@ class TrainConfig:
     # write a TensorBoard-compatible device trace there (SURVEY.md §5: the
     # reference only has wall-clock duration lists; this is the TPU upgrade)
     profile_dir: str = ""
+    # unified telemetry (telemetry/): "on" threads the span tracer through
+    # the fit, accumulates on-device per-round per-site metrics (grad/update
+    # norms, engine residual, payload bytes) in TrainState.telemetry, and
+    # writes manifest.json / metrics.jsonl / Perfetto-loadable trace files
+    # under <out_dir>/telemetry/fold_<k>. "off" (default) statically
+    # compiles the device metrics out — the epoch program is bitwise-equal
+    # to the pre-telemetry one (same pattern as quarantine_rounds=-1).
+    telemetry: str = "off"
+    # non-empty → telemetry artifacts land here instead of
+    # <out_dir>/telemetry (useful when out_dir is unset or shared)
+    telemetry_dir: str = ""
+    # non-empty → jax.profiler capture around the xprof_window epoch range
+    # only (CLI --xprof-dir). Windowed alternative to profile_dir (which
+    # traces the WHOLE fit); the two are mutually exclusive per fit.
+    xprof_dir: str = ""
+    # (first, last) epochs of the xprof capture window, 1-based inclusive
+    xprof_window: tuple = (1, 1)
     # fault tolerance (robustness/): a site whose round gradient is
     # non-finite for this many CONSECUTIVE rounds is quarantined — zero
     # weight for the rest of the fit, params advance on the live sites'

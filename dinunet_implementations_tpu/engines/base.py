@@ -20,6 +20,13 @@ it inside the remote aggregator across ``num_reducers`` worker processes
 Engines must be shape/dtype-preserving on the gradient pytree and jit-safe
 (static control flow only; the liveness mask is a traced value, so a changing
 fault pattern never recompiles).
+
+Telemetry (telemetry/metrics.py): an engine may also carry ``wire_bytes``, a
+STATIC model ``grads_template -> bytes`` of its per-round per-site collective
+payload (what one site actually ships: full gradients for dSGD, rank-r
+factors for the compression engines). Pure shape arithmetic evaluated once at
+trace time — never a traced value; ``None`` falls back to the dense-f32
+estimate.
 """
 
 from __future__ import annotations
@@ -53,6 +60,19 @@ class Engine:
     name: str
     init: Callable  # grads -> state
     aggregate: Callable  # (grads, state, weight, axis_name) -> (agg, state)
+    # static per-round per-site collective payload model (module docstring);
+    # None -> telemetry's dense-f32 fallback
+    wire_bytes: Callable | None = None
+
+
+def dense_wire_bytes(grads, itemsize: int = 4) -> int:
+    """Payload model for a dense full-gradient exchange: every leaf shipped
+    whole at ``itemsize`` bytes per element."""
+    import math
+
+    return sum(
+        math.prod(g.shape) * itemsize for g in jax.tree.leaves(grads)
+    )
 
 
 _REGISTRY: dict[str, Callable] = {}

@@ -10,14 +10,27 @@ accumulating in fp32.
 
 from __future__ import annotations
 
-from ..parallel.collectives import payload_cast, payload_uncast, site_weighted_mean
-from .base import Engine, mask_dead_site, register_engine
+import numpy as np
+
+from ..parallel.collectives import (
+    payload_cast,
+    payload_dtype,
+    payload_uncast,
+    site_weighted_mean,
+)
+from .base import Engine, dense_wire_bytes, mask_dead_site, register_engine
 
 
 @register_engine("dSGD")
 def make_dsgd(precision_bits="32", **_unused) -> Engine:
+    itemsize = np.dtype(payload_dtype(precision_bits)).itemsize
+
     def init(grads):
         return {}
+
+    def wire_bytes(grads) -> int:
+        # dSGD ships every gradient leaf whole, cast to the payload dtype
+        return dense_wire_bytes(grads, itemsize)
 
     def aggregate(grads, state, weight, axis_name, live=None):
         # dead/quarantined sites: payload zeroed, weight zeroed — the
@@ -27,4 +40,4 @@ def make_dsgd(precision_bits="32", **_unused) -> Engine:
         agg = site_weighted_mean(payload, weight, axis_name)
         return payload_uncast(agg, grads), state
 
-    return Engine("dSGD", init, aggregate)
+    return Engine("dSGD", init, aggregate, wire_bytes=wire_bytes)

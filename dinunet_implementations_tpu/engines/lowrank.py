@@ -22,6 +22,28 @@ def is_compressible(g, min_rank_dim: int = 2) -> bool:
     return g.ndim >= 2 and min(_matrix_shape(g)) >= min_rank_dim
 
 
+def lowrank_wire_bytes(grads, rank: int, itemsize: int) -> int:
+    """Modeled per-round per-site collective payload of a low-rank factor
+    exchange (the shared ``Engine.wire_bytes`` body for rankDAD and
+    powerSGD, telemetry/metrics.py): each compressible leaf ships two
+    factors ``[m, r]`` + ``[n, r]`` at ``itemsize`` bytes per element with
+    the effective rank ``min(rank, m, n)``; 1-D leaves ride the dense f32
+    psum path. Pure shape arithmetic on THIS module's compressibility
+    criterion — safe on tracers, and a criterion change here changes the
+    payload model with it."""
+    total = 0
+    for g in jax.tree.leaves(grads):
+        if is_compressible(g):
+            m, n = _matrix_shape(g)
+            total += min(rank, m, n) * (m + n) * itemsize
+        else:
+            size = 1
+            for d in g.shape:
+                size *= d
+            total += size * 4
+    return total
+
+
 def lp_matmul(a, b, dtype=None):
     """``a @ b``, optionally with both operands cast to a low-precision
     ``dtype`` (bf16) while ACCUMULATING in f32 (``preferred_element_type``) —

@@ -26,6 +26,7 @@ from .base import Engine, mask_dead_site, register_engine
 from .lowrank import (
     from_matrix,
     is_compressible,
+    lowrank_wire_bytes,
     lp_matmul,
     orthonormalize,
     to_matrix,
@@ -63,6 +64,16 @@ def make_powersgd(
             "q": jax.tree.unflatten(treedef, qs),
             "e": jax.tree.unflatten(treedef, es),
         }
+
+    def wire_bytes(grads) -> int:
+        # two psum'd factors per compressible leaf — P [m,r] and Q' [n,r] —
+        # wire-compressed to the payload dtype; shared low-rank payload
+        # model (engines/lowrank.py lowrank_wire_bytes)
+        import numpy as np
+
+        return lowrank_wire_bytes(
+            grads, dad_reduction_rank, np.dtype(pdtype).itemsize
+        )
 
     def aggregate(grads, state, weight, axis_name, live=None):
         # Dead-site round: G zeroed (NaN-safe where) and weight zeroed, so
@@ -113,4 +124,4 @@ def make_powersgd(
         }
         return agg, new_state
 
-    return Engine("powerSGD", init, aggregate)
+    return Engine("powerSGD", init, aggregate, wire_bytes=wire_bytes)

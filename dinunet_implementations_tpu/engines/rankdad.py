@@ -50,6 +50,7 @@ from .lowrank import (
     default_omega,
     from_matrix,
     is_compressible,
+    lowrank_wire_bytes,
     subspace_iteration_grouped,
     to_matrix,
 )
@@ -86,6 +87,16 @@ def make_rankdad(
             for g in leaves
         ]
         return {"omega": jax.tree.unflatten(treedef, oms)}
+
+    def wire_bytes(grads) -> int:
+        # factor exchange per compressible leaf: P + Q in the payload dtype
+        # (one packed gather per rank class — same bytes); shared low-rank
+        # payload model (engines/lowrank.py lowrank_wire_bytes)
+        import numpy as np
+
+        return lowrank_wire_bytes(
+            grads, dad_reduction_rank, np.dtype(pdtype).itemsize
+        )
 
     def aggregate(grads, state, weight, axis_name, live=None):
         # Dead-site round: G zeroed (NaN-safe where) + weight zeroed — the
@@ -155,4 +166,4 @@ def make_rankdad(
         )
         return jax.tree.unflatten(treedef, out), new_state
 
-    return Engine("rankDAD", init, aggregate)
+    return Engine("rankDAD", init, aggregate, wire_bytes=wire_bytes)

@@ -80,6 +80,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "simulated preemption replay identically run to run")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace per fold here")
+    p.add_argument("--telemetry", default=None, choices=["on", "off"],
+                   help="unified telemetry (telemetry/): span tracer + "
+                        "on-device per-round per-site metrics + "
+                        "manifest.json/metrics.jsonl/Perfetto trace under "
+                        "<out-dir>/telemetry/fold_<k>. 'off' (default) "
+                        "compiles the device metrics out entirely")
+    p.add_argument("--xprof-dir", default=None, metavar="DIR",
+                   help="jax.profiler capture around a configurable epoch "
+                        "window only (TrainConfig.xprof_window, default "
+                        "epoch 1; override via --set xprof_window=[3,5]). "
+                        "Windowed alternative to --profile-dir")
     p.add_argument("--pipeline", default=None, choices=["device", "host"],
                    help="input pipeline: 'device' (default) keeps the site "
                         "inventory resident on the mesh and ships only a "
@@ -123,6 +134,8 @@ def main(argv: list[str] | None = None) -> int:
         ("model_axis_size", args.model_axis_size),
         ("sites_per_device", args.sites_per_device),
         ("profile_dir", args.profile_dir),
+        ("telemetry", args.telemetry),
+        ("xprof_dir", args.xprof_dir),
         ("pipeline", args.pipeline),
         ("compile_cache_dir", args.compile_cache),
     ):

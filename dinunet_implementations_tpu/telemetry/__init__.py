@@ -1,0 +1,71 @@
+"""Unified telemetry: span tracer, on-device round metrics, xprof hooks,
+and the per-fit manifest/metrics sink.
+
+The observability layer the ROADMAP's production north star needs (r10).
+Before this package, a run's only windows were the level-gated stdout
+logger (trainer/logs.py), ad-hoc timers in bench.py, and
+scripts/profile_epoch.py's one-off attribution. Now:
+
+- :mod:`.tracer` — thread-safe host-side **span tracer**: monotonic nested
+  spans (safe across the trainer/prefetch.py planner thread), emitted as
+  JSONL and as Chrome trace-event JSON (load in Perfetto / chrome://tracing).
+  Also the home of the ONE ``duration`` bookkeeping helper (formerly
+  trainer/logs.py) and of bench.py's feed timing.
+- :mod:`.metrics` — **on-device round metrics** riding the epoch's rounds
+  scan (trainer/steps.py): per-site grad/update norms, engine aggregation
+  residual, modeled collective payload bytes — accumulated in
+  ``TrainState.telemetry`` (sharded ``P(site)`` like ``health``, donation-
+  and checkpoint-safe, statically compiled out when
+  ``TrainConfig.telemetry="off"``).
+- :mod:`.xprof` — ``jax.profiler`` capture hooks: a start/stop window over a
+  configurable epoch range (``TrainConfig.xprof_dir`` / ``xprof_window``,
+  CLI ``--xprof-dir``) plus the device-op trace summarizer
+  scripts/profile_epoch.py consumes.
+- :mod:`.sink` — the per-fit ``manifest.json`` (config hash, jax versions,
+  mesh topology, engine, git rev) and ``metrics.jsonl`` artifact writers,
+  with the schema validators CI gates on.
+- :mod:`.report` — ``python -m dinunet_implementations_tpu.telemetry.report``
+  renders a run summary (phase time table, per-site rollup, compile/transfer
+  counters) from those artifacts.
+
+Distinct from ``DINUNET_SANITIZE`` (checks/sanitize.py): the sanitizer is a
+debug mode that FAILS a run violating invariants; telemetry OBSERVES healthy
+runs and writes artifacts. They compose — the sanitizer's compile counter is
+one of the counters telemetry exports.
+"""
+
+from .tracer import NULL_TRACER, SpanTracer, duration
+
+__all__ = [
+    "NULL_TRACER",
+    "SpanTracer",
+    "duration",
+    "FitTelemetry",
+    "default_round_telemetry",
+    "payload_bytes_of",
+    "telemetry_summary",
+    "validate_manifest",
+    "validate_metrics_rows",
+    "XprofWindow",
+    "summarize_device_ops",
+]
+
+
+def __getattr__(name):
+    # jax-adjacent halves load lazily: the tracer must stay importable from
+    # stdlib-only contexts (the report CLI on a bare box, bench's host-side
+    # feed timing) without pulling jax in.
+    if name in ("FitTelemetry", "validate_manifest", "validate_metrics_rows"):
+        from . import sink
+
+        return getattr(sink, name)
+    if name in ("default_round_telemetry", "payload_bytes_of",
+                "telemetry_summary"):
+        from . import metrics
+
+        return getattr(metrics, name)
+    if name in ("XprofWindow", "summarize_device_ops"):
+        from . import xprof
+
+        return getattr(xprof, name)
+    raise AttributeError(name)

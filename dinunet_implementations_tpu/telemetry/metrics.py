@@ -1,0 +1,117 @@
+"""On-device round metrics — the device half of the telemetry layer.
+
+The epoch's rounds scan (trainer/steps.py) computes, per site per round, a
+small set of scalars the operator otherwise cannot see without rerunning
+under a bespoke harness:
+
+- ``grad_sq_last`` — this round's squared gradient norm (``Σ g²`` over the
+  site's accumulated round gradient). NaN/Inf survives here verbatim — "site
+  3's gradients blew up" is the signal, and the health counters say when;
+- ``grad_sq_sum`` / ``grad_sq_max`` — finite-only accumulators across rounds
+  (a non-finite round would poison the sums forever, so it is excluded there
+  and visible in ``last`` + ``health.streak`` instead);
+- ``residual_sq_sum`` — the engine aggregation residual ``Σ ‖g_site − ĝ‖²``:
+  how far the engine's aggregate moved this site's raw gradient. For
+  compression engines (rankDAD/powerSGD) on homogeneous sites this IS the
+  compression error; for dSGD it measures cross-site gradient disagreement;
+- ``update_sq_last`` / ``update_sq_sum`` — squared norm of the applied
+  optimizer update (replicated per site: the update is global);
+- ``payload_bytes`` — modeled collective wire bytes shipped per round
+  (:func:`payload_bytes_of`, from the engine's ``wire_bytes`` model);
+- ``rounds`` — rounds counted into the accumulators.
+
+All leaves carry a leading ``[num_sites]`` axis and ride ``TrainState
+.telemetry`` sharded ``P(site)`` exactly like ``health`` (trainer/steps.py
+``_state_specs``): no extra host syncs per round, no recompiles (the values
+are traced), checkpointed (trainer/checkpoint.py), and distinct arrays so
+state donation never aliases a buffer twice. ``TrainConfig.telemetry="off"``
+compiles all of it out — the epoch program is bitwise-identical to the
+pre-telemetry one (tests/test_telemetry.py).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+#: metric keys of the TrainState.telemetry pytree (trace-stable; keep sorted)
+TELEMETRY_KEYS = (
+    "grad_sq_last",
+    "grad_sq_max",
+    "grad_sq_sum",
+    "payload_bytes",
+    "residual_sq_sum",
+    "rounds",
+    "update_sq_last",
+    "update_sq_sum",
+)
+
+
+def default_round_telemetry(num_sites: int) -> dict:
+    """Fresh all-zero accumulators with the per-site leading axis."""
+    # jax deferred to the call, same reasoning as robustness/health.py:
+    # keep this module importable without locking in jax backend config
+    import jax.numpy as jnp
+
+    # distinct arrays per key (not one shared buffer): the epoch program
+    # donates the carried state and XLA rejects twice-donated buffers
+    return {
+        k: (jnp.zeros((num_sites,), jnp.int32) if k == "rounds"
+            else jnp.zeros((num_sites,), jnp.float32))
+        for k in TELEMETRY_KEYS
+    }
+
+
+def tree_sq_sum(tree):
+    """``Σ x²`` over every leaf, accumulated in f32 leaf-by-leaf in tree
+    order. The SAME helper runs inside the compiled epoch and in the
+    host-recomputation tests — bit-exact equality depends on both sides
+    reducing in this order."""
+    import jax
+    import jax.numpy as jnp
+
+    s = jnp.zeros((), jnp.float32)
+    for leaf in jax.tree.leaves(tree):
+        s = s + jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+    return s
+
+
+def payload_bytes_of(engine, grads_template) -> float:
+    """Modeled per-round collective payload bytes for one site.
+
+    Uses the engine's own ``wire_bytes`` model (engines/base.py) when it has
+    one; otherwise the dense-f32 fallback (every leaf shipped whole). A
+    static Python float — computed once at trace time from the gradient
+    pytree's shapes, never a traced value."""
+    wb = getattr(engine, "wire_bytes", None)
+    if wb is not None:
+        return float(wb(grads_template))
+    import jax
+
+    return float(sum(
+        math.prod(leaf.shape) * 4 for leaf in jax.tree.leaves(grads_template)
+    ))
+
+
+def telemetry_summary(telemetry) -> dict | None:
+    """Host-side rollup of a fit's final ``TrainState.telemetry`` for results
+    dicts / ``logs.json`` / ``metrics.jsonl``: plain float lists, norms
+    un-squared. ``None`` in → ``None`` out (telemetry off)."""
+    if telemetry is None:
+        return None
+    t = {k: np.asarray(v) for k, v in telemetry.items()}
+    rounds = np.maximum(t["rounds"].astype(np.float64), 1.0)
+
+    def norms(a):
+        return [float(v) for v in np.sqrt(np.maximum(a.astype(np.float64), 0.0))]
+
+    return {
+        "site_grad_norm_last": [float(v) for v in np.sqrt(t["grad_sq_last"])],
+        "site_grad_norm_max": norms(t["grad_sq_max"]),
+        "site_grad_norm_mean": norms(t["grad_sq_sum"] / rounds),
+        "site_residual_norm_mean": norms(t["residual_sq_sum"] / rounds),
+        "update_norm_last": float(np.sqrt(max(float(t["update_sq_last"][0]), 0.0))),
+        "payload_bytes_per_round": float(t["payload_bytes"][0] / rounds[0]),
+        "rounds": int(t["rounds"][0]),
+    }

@@ -1,0 +1,115 @@
+"""``jax.profiler`` capture hooks — the device-trace half of telemetry.
+
+Two consumers:
+
+- the trainer (trainer/loop.py): :class:`XprofWindow` starts/stops a
+  profiler capture around a configurable epoch window
+  (``TrainConfig.xprof_dir`` + ``xprof_window``, CLI ``--xprof-dir``) —
+  profile epochs 3..5 of a long fit without paying trace overhead for the
+  whole run. Complements ``profile_dir`` (whole-fit trace, SURVEY.md §5);
+  the two are mutually exclusive per fit.
+- scripts/profile_epoch.py: :func:`capture` (an explicit trace context) and
+  :func:`summarize_device_ops` (top device ops by total duration from a
+  written trace) — the script is a thin consumer of these instead of owning
+  its own gzip/trace-parsing code.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import os
+import shutil
+from contextlib import contextmanager
+
+
+class XprofWindow:
+    """Start/stop a ``jax.profiler`` trace around epochs
+    ``[first, last]`` (inclusive, 1-based — ``TrainConfig.xprof_window``).
+
+    Call :meth:`epoch_begin` / :meth:`epoch_end` from the epoch loop and
+    :meth:`close` from its ``finally`` — an early stop or ``Preempted``
+    inside the window still finalizes the trace file."""
+
+    def __init__(self, xprof_dir: str, window=(1, 1), label: str = ""):
+        self.dir = xprof_dir
+        w = tuple(window or (1, 1))
+        self.first, self.last = int(w[0]), int(w[-1])
+        self.label = label
+        self._active = False
+
+    def epoch_begin(self, epoch: int) -> None:
+        # range test, not equality: a resumed fit whose start_epoch lands
+        # INSIDE the window (preempted mid-window) must still capture the
+        # remaining windowed epochs
+        if (self.dir and not self._active
+                and self.first <= epoch <= self.last):
+            import jax
+
+            jax.profiler.start_trace(os.path.join(self.dir, self.label))
+            self._active = True
+
+    def epoch_end(self, epoch: int) -> None:
+        if self._active and epoch >= self.last:
+            self.close()
+
+    def close(self) -> None:
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+
+
+@contextmanager
+def capture(trace_dir: str, fresh: bool = True):
+    """One explicit profiler capture into ``trace_dir`` (``fresh=True``
+    clears a previous capture first — jax appends run dirs otherwise)."""
+    import jax
+
+    if fresh:
+        shutil.rmtree(trace_dir, ignore_errors=True)
+    with jax.profiler.trace(trace_dir):
+        yield trace_dir
+
+
+def trace_files(trace_dir: str) -> list[str]:
+    """The ``.trace.json.gz`` files a capture wrote under ``trace_dir``."""
+    return sorted(glob.glob(
+        os.path.join(trace_dir, "plugins/profile/*/*.trace.json.gz")
+    ))
+
+
+def summarize_device_ops(trace_dir: str, top: int = 25) -> list[dict]:
+    """Top device ops by total duration from a written profiler trace.
+
+    Aggregates complete (``"X"``) events on the XLA/module device lanes of
+    the first trace file — the analysis scripts/profile_epoch.py prints
+    (the tool that found the conv-emitter dW_hh lowering and the
+    whole-input relayout copy). Returns
+    ``[{"name", "total_us", "count"}, ...]``, longest first."""
+    paths = trace_files(trace_dir)
+    if not paths:
+        raise FileNotFoundError(f"no .trace.json.gz under {trace_dir}")
+    with gzip.open(paths[0]) as fh:
+        d = json.load(fh)
+    names = {}
+    for e in d.get("traceEvents", []):
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            names[(e["pid"], e["tid"])] = e["args"]["name"]
+    agg: collections.Counter = collections.Counter()
+    cnt: collections.Counter = collections.Counter()
+    for e in d.get("traceEvents", []):
+        if e.get("ph") != "X":
+            continue
+        tname = str(names.get((e["pid"], e["tid"]), "?"))
+        if "XLA" not in tname and "Module" not in tname:
+            continue
+        agg[e["name"]] += float(e.get("dur", 0))
+        cnt[e["name"]] += 1
+    return [
+        {"name": n, "total_us": v, "count": cnt[n]}
+        for n, v in agg.most_common(top)
+    ]

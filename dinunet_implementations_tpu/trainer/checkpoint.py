@@ -114,6 +114,7 @@ def save_checkpoint(
         "rng": state.rng,
         "round": state.round,
         "health": state.health if state.health is not None else {},
+        "telemetry": state.telemetry if state.telemetry is not None else {},
         # meta rides INSIDE the msgpack so state+meta are one atomic unit (a
         # kill between two separate files would pair epoch-N state with
         # epoch-(N-1) bookkeeping and resume from the wrong epoch)
@@ -159,6 +160,7 @@ def load_checkpoint(path: str, like: TrainState, with_meta: bool = False,
     meta_json = raw.pop("meta_json", None)
     eng_raw = raw.pop("engine_state", None)
     health_raw = raw.pop("health", None)
+    telemetry_raw = raw.pop("telemetry", None)
     restored = flax.serialization.from_state_dict(template, raw)
     restored["meta_json"] = meta_json
     try:
@@ -183,6 +185,21 @@ def load_checkpoint(path: str, like: TrainState, with_meta: bool = False,
                 "not match the current run (site count changed?); resuming "
                 "with fresh health counters."
             )
+    # telemetry accumulators restore the same tolerant way: absent in
+    # pre-0.5 checkpoints (or when the resuming run has telemetry off) →
+    # fresh zeros / None, never a failed resume
+    telemetry = like.telemetry
+    if telemetry_raw and like.telemetry is not None:
+        try:
+            telemetry = flax.serialization.from_state_dict(
+                like.telemetry, telemetry_raw
+            )
+        except (KeyError, TypeError, ValueError):
+            warnings.warn(
+                f"[warn] checkpoint {path}: stored telemetry accumulators do "
+                "not match the current run (site count or schema changed?); "
+                "resuming with fresh accumulators."
+            )
     state = TrainState(
         params=restored["params"],
         batch_stats=restored["batch_stats"],
@@ -191,6 +208,7 @@ def load_checkpoint(path: str, like: TrainState, with_meta: bool = False,
         rng=jnp.asarray(restored["rng"]),
         round=jnp.asarray(restored["round"]),
         health=health,
+        telemetry=telemetry,
     )
     if with_meta:
         meta = restored.get("meta_json")

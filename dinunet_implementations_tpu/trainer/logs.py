@@ -23,8 +23,11 @@ import logging
 import os
 import shutil
 import sys
-import time
 import zipfile
+
+# the ONE duration-list helper now lives with the span tracer
+# (telemetry/tracer.py); re-exported here for the established import path
+from ..telemetry.tracer import duration  # noqa: F401
 
 # ---------------------------------------------------------------------------
 # Level-gated logger — the ONE sanctioned output path for library code
@@ -66,13 +69,6 @@ def log_info(msg: str) -> None:
 def log_warning(msg: str) -> None:
     """Recoverable-but-noteworthy conditions (clamps, empty splits)."""
     get_logger().warning(msg)
-
-
-def duration(cache: dict, start: float, key: str):
-    """Append elapsed seconds since ``start`` to ``cache[key]`` (reference
-    ``coinstac_dinunet.utils.duration``, used at ``local.py:51-52``)."""
-    cache.setdefault(key, []).append(time.time() - start)
-    return cache[key][-1]
 
 
 def fold_dir(out_dir: str, site: str, task_id: str, fold: int) -> str:
@@ -126,6 +122,32 @@ def health_log_fields(site_health: dict | None, site_index: int | None = None) -
     return {
         "skipped_rounds": site_health["site_skipped_rounds"][site_index],
         "quarantined": site_health["site_quarantined"][site_index],
+    }
+
+
+def telemetry_log_fields(summary: dict | None, site_index: int | None = None) -> dict:
+    """``logs.json`` fields for the per-site telemetry rollup
+    (telemetry/metrics.py ``telemetry_summary``): grad-norm statistics next
+    to the health counters, so the notebook-facing contract surfaces them
+    too. ``site_index=None`` returns the remote-side full lists; an index
+    returns that one site's scalars (for ``local{i}/logs.json``). ``{}``
+    when telemetry was off."""
+    if not summary:
+        return {}
+    if site_index is None:
+        return {
+            "site_grad_norm_last": list(summary["site_grad_norm_last"]),
+            "site_grad_norm_max": list(summary["site_grad_norm_max"]),
+            "site_grad_norm_mean": list(summary["site_grad_norm_mean"]),
+            "site_residual_norm_mean": list(summary["site_residual_norm_mean"]),
+            "update_norm_last": summary["update_norm_last"],
+            "payload_bytes_per_round": summary["payload_bytes_per_round"],
+        }
+    return {
+        "grad_norm_last": summary["site_grad_norm_last"][site_index],
+        "grad_norm_max": summary["site_grad_norm_max"][site_index],
+        "grad_norm_mean": summary["site_grad_norm_mean"][site_index],
+        "residual_norm_mean": summary["site_residual_norm_mean"][site_index],
     }
 
 

@@ -44,12 +44,13 @@ from .checkpoint import (
 from ..robustness.faults import poison_inputs
 from ..robustness.health import health_summary
 from ..robustness.preemption import Preempted, PreemptionGuard
+from ..telemetry.tracer import NULL_TRACER, SpanTracer, duration
 from .logs import (
-    duration,
     fold_dir,
     health_log_fields,
     log_info,
     log_warning,
+    telemetry_log_fields,
     write_logs_json,
     write_test_metrics_csv,
     zip_global_results,
@@ -99,12 +100,29 @@ class FederatedTrainer:
             from ..core.jaxcompat import enable_compile_cache
 
             enable_compile_cache(cfg.compile_cache_dir)
+        # unified telemetry (telemetry/): span tracer + on-device round
+        # metrics + manifest/metrics artifacts. Off = a disabled (no-op)
+        # tracer and a telemetry-free epoch program (bitwise-equal to the
+        # pre-telemetry one).
+        if cfg.telemetry not in ("on", "off"):
+            raise ValueError(
+                f"cfg.telemetry must be 'on' or 'off', got {cfg.telemetry!r}"
+            )
+        self._telemetry_on = cfg.telemetry == "on"
+        if cfg.xprof_dir and cfg.profile_dir:
+            raise ValueError(
+                "profile_dir (whole-fit trace) and xprof_dir (windowed "
+                "capture) are mutually exclusive — jax.profiler supports one "
+                "active trace"
+            )
+        self.tracer = SpanTracer() if self._telemetry_on else NULL_TRACER
         self.epoch_fn = make_train_epoch_fn(
             self.task, self.engine, self.optimizer, mesh, cfg.local_iterations,
             rounds_scan_xs=cfg.rounds_scan_xs,
             quarantine_rounds=cfg.quarantine_rounds,
             pipeline=self._pipeline,
             donate_state=self._donate,
+            telemetry=self._telemetry_on,
         )
         self.eval_fn = make_eval_fn(self.task, mesh)
         self._inventory = None  # device-resident site inventory, one per fit
@@ -116,6 +134,7 @@ class FederatedTrainer:
         # stay full precision.
         self._input_dtype = getattr(model, "compute_dtype", None) or None
         self._cache: dict = {}  # duration bookkeeping, reference-keyed
+        self._last_transfer_bytes = 0  # per-epoch host→device traffic
 
     def _coordinator(self) -> bool:
         """Multi-host runs: only process 0 writes logs/checkpoints (every
@@ -147,6 +166,7 @@ class FederatedTrainer:
         state = init_train_state(
             self.task, self.engine, self.optimizer, rng, sample_x,
             num_sites=num_sites or getattr(self, "_num_sites", 1),
+            telemetry=self._telemetry_on,
         )
         return self._place_state(state)
 
@@ -202,9 +222,11 @@ class FederatedTrainer:
         if self._inventory is None or self._inventory_src != key:
             from ..parallel.distributed import put_site_inventory
 
-            self._inventory = put_site_inventory(
-                self.mesh, stack_site_inventory(train_sites), self._input_dtype
-            )
+            with self.tracer.span("inventory-upload"):
+                self._inventory = put_site_inventory(
+                    self.mesh, stack_site_inventory(train_sites),
+                    self._input_dtype,
+                )
             self._inventory_src = key
         return self._inventory
 
@@ -214,27 +236,30 @@ class FederatedTrainer:
         FaultPlan masks for its global round window — the complete per-epoch
         host→device transfer (index-plan bytes, not dataset bytes). Pure
         function of ``(epoch, round0)``, so the prefetch thread can build
-        epoch N+1 while epoch N runs without changing results."""
+        epoch N+1 while epoch N runs without changing results (the tracer's
+        ``plan-build`` spans land on whichever thread ran the build — the
+        prefetch thread in steady state)."""
         from ..robustness.faults import fault_window
 
-        plan = plan_epoch_positions(
-            train_sites, batch_size,
-            seed=self.cfg.seed * 100003 + epoch, pad_mode="wrap",
-        )
-        rounds = plan.steps // max(self.cfg.local_iterations, 1)
-        live, nan_mask = fault_window(
-            self.fault_plan, plan.num_sites, round0, rounds
-        )
-        # the NaN gate is fed whenever the PLAN carries nan_at (a fit-static
-        # property), not only in windows that poison — the compiled program
-        # must not change between epochs
-        poison = (
-            nan_mask.astype(np.float32)
-            if nan_mask is not None and self.fault_plan.nan_at else None
-        )
-        from ..parallel.distributed import put_epoch_plan
+        with self.tracer.span("plan-build", epoch=epoch):
+            plan = plan_epoch_positions(
+                train_sites, batch_size,
+                seed=self.cfg.seed * 100003 + epoch, pad_mode="wrap",
+            )
+            rounds = plan.steps // max(self.cfg.local_iterations, 1)
+            live, nan_mask = fault_window(
+                self.fault_plan, plan.num_sites, round0, rounds
+            )
+            # the NaN gate is fed whenever the PLAN carries nan_at (a
+            # fit-static property), not only in windows that poison — the
+            # compiled program must not change between epochs
+            poison = (
+                nan_mask.astype(np.float32)
+                if nan_mask is not None and self.fault_plan.nan_at else None
+            )
+            from ..parallel.distributed import put_epoch_plan
 
-        return put_epoch_plan(self.mesh, plan.positions, live, poison)
+            return put_epoch_plan(self.mesh, plan.positions, live, poison)
 
     def run_epoch(self, state, train_sites, epoch: int, batch_size=None,
                   plan=None):
@@ -250,6 +275,10 @@ class FederatedTrainer:
                 )
             idx, live, poison = plan
             inv_x, inv_y = self._ensure_inventory(train_sites)
+            # the device pipeline's ENTIRE per-epoch host→device traffic
+            self._last_transfer_bytes = int(sum(
+                a.nbytes for a in (idx, live, poison) if a is not None
+            ))
             state, losses = self.epoch_fn(state, inv_x, inv_y, idx, live, poison)
             return state, np.asarray(losses)
         fb = plan_epoch(
@@ -280,9 +309,13 @@ class FederatedTrainer:
                     fb.inputs, nan_mask, self.cfg.local_iterations
                 ),
             )
-        state, losses = self.epoch_fn(
-            state, *self._put_batch(fb), self._put_live(live)
+        batch = self._put_batch(fb)
+        live_dev = self._put_live(live)
+        self._last_transfer_bytes = int(
+            sum(a.nbytes for a in batch)
+            + (live_dev.nbytes if live_dev is not None else 0)
         )
+        state, losses = self.epoch_fn(state, *batch, live_dev)
         return state, np.asarray(losses)
 
     @staticmethod
@@ -326,13 +359,14 @@ class FederatedTrainer:
         ``per_site=True`` also returns each site's own (Averages, metrics) —
         the eval step already computes per-site probs/loss sums, so per-site
         logs (reference ``local{i}/logs.json``) come for free."""
-        fb = plan_eval(sites, batch_size or self.cfg.batch_size)
-        outs = self.eval_fn(state, *self._put_batch(fb))
-        from ..parallel.distributed import fetch_site_outputs
+        with self.tracer.span("eval"):
+            fb = plan_eval(sites, batch_size or self.cfg.batch_size)
+            outs = self.eval_fn(state, *self._put_batch(fb))
+            from ..parallel.distributed import fetch_site_outputs
 
-        # [S, steps, B, C] probs + per-site sums; multi-host meshes gather
-        # the P(site)-sharded outputs before the host fetch
-        probs, loss_sum, wsum = fetch_site_outputs(outs, self.mesh)
+            # [S, steps, B, C] probs + per-site sums; multi-host meshes
+            # gather the P(site)-sharded outputs before the host fetch
+            probs, loss_sum, wsum = fetch_site_outputs(outs, self.mesh)
         loss = float(loss_sum.sum() / max(wsum.sum(), 1.0))
         m = self._add_probs(
             self._new_metrics(probs.shape[-1]), probs, fb.labels, fb.weights
@@ -368,6 +402,41 @@ class FederatedTrainer:
             # GUI mode=test (compspec.json mode field): inference only, no
             # training — load the fold's best checkpoint and evaluate.
             return self.test_only(test_sites, fold=fold)
+        # telemetry envelope: the whole fit runs under one "fit" span, and
+        # the artifact sink (opened inside _fit_impl once paths are known)
+        # ALWAYS finalizes — early stop, Preempted, or a crash still leave a
+        # complete manifest/metrics.jsonl/trace set on disk.
+        self._fit_tel = None
+        self._fit_summary: dict = {}
+        try:
+            with self.tracer.span("fit", fold=fold):
+                return self._fit_impl(
+                    train_sites, val_sites, test_sites, fold=fold,
+                    verbose=verbose, resume=resume,
+                )
+        finally:
+            fit_tel = self._fit_tel
+            if fit_tel is not None:
+                from ..checks.sanitize import jit_cache_size
+
+                compiles0 = self._fit_summary.pop("_compiles0", 0)
+                self._fit_summary["epoch_compiles"] = (
+                    (jit_cache_size(self.epoch_fn) or 0) - compiles0
+                )
+                fit_tel.append(self._fit_summary)
+                fit_tel.close()
+                self._fit_tel = None
+
+    def _fit_impl(
+        self,
+        train_sites: list[SiteArrays],
+        val_sites: list[SiteArrays],
+        test_sites: list[SiteArrays],
+        fold: int = 0,
+        verbose: bool = True,
+        resume: bool = False,
+    ) -> dict:
+        cfg = self.cfg
         t_start = time.time()
         self._num_sites = len(train_sites)
         # Fail fast on splits that are empty at EVERY site; per-site emptiness
@@ -433,6 +502,33 @@ class FederatedTrainer:
                  or os.path.exists(latest_path + ".prev"))
         )
 
+        # --- telemetry artifact sink (manifest.json + metrics.jsonl +
+        # trace files under <out_dir>/telemetry/fold_<k>): one per fit, on
+        # the coordinator only (same single-writer rule as checkpoints)
+        if self._telemetry_on:
+            tel_root = cfg.telemetry_dir or (
+                os.path.join(self.out_dir, "telemetry") if self.out_dir else ""
+            )
+            if tel_root and self._coordinator():
+                from ..checks.sanitize import jit_cache_size
+                from ..telemetry.sink import FitTelemetry
+
+                self._fit_tel = FitTelemetry.open(
+                    os.path.join(tel_root, f"fold_{fold}"), cfg,
+                    mesh=self.mesh, fold=fold, tracer=self.tracer,
+                )
+                self._fit_summary = {
+                    "kind": "summary", "fold": fold, "epochs_run": 0,
+                    "best_val_epoch": 0, "best_val_metric": None,
+                    "_compiles0": jit_cache_size(self.epoch_fn) or 0,
+                }
+            elif not tel_root and verbose:
+                log_warning(
+                    "[warn] telemetry='on' but neither out_dir nor "
+                    "telemetry_dir is set — spans and device metrics are "
+                    "collected but no artifacts will be written"
+                )
+
         # --- warm starts — skipped when resuming: load_checkpoint below
         # replaces the state wholesale, so pretraining first would be pure
         # wasted compute on every restart
@@ -495,6 +591,16 @@ class FederatedTrainer:
             jax.profiler.start_trace(
                 os.path.join(cfg.profile_dir, f"fold_{fold}")
             )
+        # windowed jax.profiler capture (telemetry/xprof.py): trace only the
+        # cfg.xprof_window epoch range — mutually exclusive with profile_dir
+        # (checked at construction)
+        xprof = None
+        if cfg.xprof_dir:
+            from ..telemetry.xprof import XprofWindow
+
+            xprof = XprofWindow(
+                cfg.xprof_dir, cfg.xprof_window, label=f"fold_{fold}"
+            )
         stop_epoch = cfg.epochs
         # kill-at-round chaos arm: track the global round window per epoch so
         # the kill fires exactly once, when training CROSSES the round (a
@@ -526,10 +632,17 @@ class FederatedTrainer:
             with guard:
                 for epoch in range(start_epoch, cfg.epochs + 1):
                     e_start = time.time()
-                    state, losses = self.run_epoch(
-                        state, train_sites, epoch, batch_size=cfg.batch_size,
-                        plan=(None if prefetch is None else prefetch.get(epoch)),
-                    )
+                    if xprof is not None:
+                        xprof.epoch_begin(epoch)
+                    with self.tracer.span("epoch", epoch=epoch):
+                        state, losses = self.run_epoch(
+                            state, train_sites, epoch,
+                            batch_size=cfg.batch_size,
+                            plan=(None if prefetch is None
+                                  else prefetch.get(epoch)),
+                        )
+                    if xprof is not None:
+                        xprof.epoch_end(epoch)
                     # all-dead rounds report NaN loss (trainer/steps.py) —
                     # average over the rounds that actually trained
                     lived = losses[np.isfinite(losses)]
@@ -542,6 +655,10 @@ class FederatedTrainer:
                     # its rounds.
                     rounds = max(len(losses), 1)
                     iter_durations.extend([(time.time() - e_start) / rounds] * rounds)
+                    if self._fit_tel is not None:
+                        self._epoch_row(fold, epoch, epoch_loss, e_start,
+                                        state)
+                        self._fit_summary["epochs_run"] = len(epoch_losses)
 
                     if epoch % cfg.validation_epochs == 0:
                         if has_val:
@@ -556,12 +673,18 @@ class FederatedTrainer:
                                 best_state = self._snapshot(state)
                                 since_best = 0
                                 if best_path and self._coordinator():  # save-on-best
-                                    save_checkpoint(
-                                        best_path, best_state,
-                                        meta={"best_val_epoch": best_epoch,
-                                              "best_val_metric": best_metric, "fold": fold},
-                                        rotate=True,
-                                    )
+                                    with self.tracer.span("checkpoint"):
+                                        save_checkpoint(
+                                            best_path, best_state,
+                                            meta={"best_val_epoch": best_epoch,
+                                                  "best_val_metric": best_metric, "fold": fold},
+                                            rotate=True,
+                                        )
+                                    if self._fit_tel is not None:
+                                        self._fit_tel.event(
+                                            "checkpoint", epoch=epoch,
+                                            which="best",
+                                        )
                             else:
                                 since_best += cfg.validation_epochs
                             if verbose:
@@ -591,23 +714,29 @@ class FederatedTrainer:
                     # checksummed): preemption granularity is one epoch, and a
                     # torn/corrupt latest falls back to the previous generation
                     if latest_path and self._coordinator():
-                        save_checkpoint(
-                            latest_path, state,
-                            meta={"epoch": epoch, "best_val_epoch": best_epoch,
-                                  "best_val_metric": best_metric,
-                                  "since_best": since_best, "fold": fold,
-                                  "epoch_losses": epoch_losses,
-                                  "iter_durations": iter_durations,
-                                  "time_spent_on_computation": self._cache.get(
-                                      "time_spent_on_computation", []),
-                                  "cumulative_total_duration": self._cache.get(
-                                      "cumulative_total_duration", [])},
-                            rotate=True,
-                        )
+                        with self.tracer.span("checkpoint"):
+                            save_checkpoint(
+                                latest_path, state,
+                                meta={"epoch": epoch, "best_val_epoch": best_epoch,
+                                      "best_val_metric": best_metric,
+                                      "since_best": since_best, "fold": fold,
+                                      "epoch_losses": epoch_losses,
+                                      "iter_durations": iter_durations,
+                                      "time_spent_on_computation": self._cache.get(
+                                          "time_spent_on_computation", []),
+                                      "cumulative_total_duration": self._cache.get(
+                                          "cumulative_total_duration", [])},
+                                rotate=True,
+                            )
                     # -- preemption: a SIGTERM/SIGINT that landed during the
                     # epoch exits here, AFTER the rotating checkpoint, so
                     # resume=True continues bit-exact from this boundary
                     if guard.requested is not None:
+                        if self._fit_tel is not None:
+                            self._fit_tel.event(
+                                "preempted", epoch=epoch,
+                                signum=int(guard.requested),
+                            )
                         raise Preempted(
                             f"signal {guard.requested} during epoch {epoch}; "
                             f"state saved to {latest_path or '(no out_dir)'}",
@@ -616,6 +745,11 @@ class FederatedTrainer:
                     if kill_round is not None:
                         round_after = int(state.round)
                         if round_before <= kill_round < round_after:
+                            if self._fit_tel is not None:
+                                self._fit_tel.event(
+                                    "preempted", epoch=epoch,
+                                    kill_at_round=int(kill_round),
+                                )
                             raise Preempted(
                                 f"FaultPlan kill_at_round={kill_round} crossed "
                                 f"during epoch {epoch}; state saved to "
@@ -631,7 +765,16 @@ class FederatedTrainer:
             # Preempted (SIGTERM / FaultPlan kill), or a crash: a resumed run
             # must never inherit a live prefetch thread
             if prefetch is not None:
+                if self._fit_tel is not None:
+                    # stall/queue-depth counters into the summary row, read
+                    # BEFORE close() while the stats are final-but-live
+                    self._fit_summary.update({
+                        f"prefetch_{k}": v
+                        for k, v in prefetch.stats().items()
+                    })
                 prefetch.close()
+            if xprof is not None:
+                xprof.close()
             if cfg.profile_dir:
                 jax.profiler.stop_trace()
 
@@ -649,9 +792,10 @@ class FederatedTrainer:
                 best_epoch, best_state = stop_epoch, state
 
         # --- test with the best state (reference: best-epoch checkpoint)
-        results = self._test_results(best_state, test_sites, best_epoch,
-                                     best_metric, stop_epoch, epoch_losses,
-                                     batch_size=cfg.batch_size)
+        with self.tracer.span("test"):
+            results = self._test_results(best_state, test_sites, best_epoch,
+                                         best_metric, stop_epoch, epoch_losses,
+                                         batch_size=cfg.batch_size)
         # per-site fault-tolerance counters from the FINAL state (best_state
         # may predate a quarantine event): rounds skipped, quarantine flags
         if state.health is not None:
@@ -660,8 +804,25 @@ class FederatedTrainer:
             results["site_health"] = health_summary(
                 fetch_site_outputs(state.health, self.mesh)
             )
+        # per-site round-metric rollup from the FINAL state, same rationale
+        if state.telemetry is not None:
+            from ..parallel.distributed import fetch_site_outputs
+            from ..telemetry.metrics import telemetry_summary
+
+            results["site_telemetry"] = telemetry_summary(
+                fetch_site_outputs(state.telemetry, self.mesh)
+            )
+        if self._fit_tel is not None:
+            self._fit_summary.update(
+                best_val_epoch=int(best_epoch),
+                best_val_metric=best_metric,
+            )
+            for key in ("site_skipped_rounds", "site_quarantined"):
+                if results.get("site_health"):
+                    self._fit_summary[key] = results["site_health"][key]
         if self.out_dir:
-            self._write_outputs(results, iter_durations, best_state, fold)
+            with self.tracer.span("write-outputs"):
+                self._write_outputs(results, iter_durations, best_state, fold)
         results["state"] = best_state
         return results
 
@@ -724,6 +885,43 @@ class FederatedTrainer:
 
     # -- internals -------------------------------------------------------
 
+    def _epoch_row(self, fold, epoch, epoch_loss, e_start, state):
+        """One per-epoch metrics.jsonl record: loss/timing/transfer plus the
+        on-device per-site accumulators. The losses fetch in run_epoch
+        already synchronized the epoch, so reading the small [S] telemetry
+        arrays here adds no extra device round trip of consequence."""
+        from ..parallel.distributed import fetch_site_outputs
+
+        row = {
+            "kind": "epoch", "fold": fold, "epoch": epoch,
+            "train_loss": epoch_loss,
+            "epoch_seconds": round(time.time() - e_start, 6),
+            "transfer_bytes": self._last_transfer_bytes,
+        }
+        t = (
+            fetch_site_outputs(state.telemetry, self.mesh)
+            if state.telemetry is not None else None
+        )
+        if t is not None:
+            row.update(
+                site_grad_sq_last=[float(v) for v in t["grad_sq_last"]],
+                site_grad_sq_sum=[float(v) for v in t["grad_sq_sum"]],
+                site_grad_sq_max=[float(v) for v in t["grad_sq_max"]],
+                site_residual_sq_sum=[
+                    float(v) for v in t["residual_sq_sum"]
+                ],
+                update_sq_last=float(t["update_sq_last"][0]),
+                payload_bytes=float(t["payload_bytes"][0]),
+                rounds=int(t["rounds"][0]),
+            )
+        else:  # epoch rows keep one schema even if metrics are absent
+            row.update(
+                site_grad_sq_last=[], site_grad_sq_sum=[],
+                site_grad_sq_max=[], site_residual_sq_sum=[],
+                update_sq_last=0.0, payload_bytes=0.0, rounds=0,
+            )
+        self._fit_tel.append(row)
+
     def _pretrain(self, state, train_sites, val_sites, verbose):
         pa = self.cfg.pretrain_args
         largest = int(np.argmax([len(s) for s in train_sites]))
@@ -751,15 +949,20 @@ class FederatedTrainer:
             rng=state.rng,
             round=state.round,
             health=state.health,
+            # pre_epoch_fn is built telemetry-off (warm-up metrics would
+            # pollute the federated accumulators); None matches its program
+            telemetry=None,
         )
-        for epoch in range(1, pa.epochs + 1):
-            fb = plan_epoch(
-                masked, pa.batch_size, seed=self.cfg.seed * 7 + epoch, pad_mode="mask"
-            )
-            pre_state, losses = pre_epoch_fn(pre_state, *self._put_batch(fb))
-            if verbose:
-                log_info(f"[pretrain site {largest}] epoch {epoch}: "
-                         f"loss={np.asarray(losses).mean():.4f}")
+        with self.tracer.span("pretrain"):
+            for epoch in range(1, pa.epochs + 1):
+                fb = plan_epoch(
+                    masked, pa.batch_size, seed=self.cfg.seed * 7 + epoch,
+                    pad_mode="mask",
+                )
+                pre_state, losses = pre_epoch_fn(pre_state, *self._put_batch(fb))
+                if verbose:
+                    log_info(f"[pretrain site {largest}] epoch {epoch}: "
+                             f"loss={np.asarray(losses).mean():.4f}")
         # warm-started params; fresh optimizer (and health) for the federated
         # phase — pretrain skips/quarantines must not leak into the real run
         return TrainState(
@@ -770,6 +973,7 @@ class FederatedTrainer:
             rng=state.rng,
             round=pre_state.round,
             health=state.health,
+            telemetry=state.telemetry,
         )
 
     def _write_outputs(self, results, iter_durations, best_state, fold):
@@ -793,13 +997,15 @@ class FederatedTrainer:
                 cum, comp, iter_durations, side="local",
                 extra={"site_index": i, "pooled_test_metrics": results["test_metrics"],
                        "durations_shared_across_sites": True,
-                       **health_log_fields(results.get("site_health"), i)},
+                       **health_log_fields(results.get("site_health"), i),
+                       **telemetry_log_fields(results.get("site_telemetry"), i)},
             )
         d = fold_dir(self.out_dir, "remote", cfg.task_id, fold)
         write_logs_json(
             d, cfg.agg_engine, results["test_metrics"], results["best_val_epoch"],
             cum, comp, iter_durations, side="remote",
-            extra=health_log_fields(results.get("site_health")),
+            extra={**health_log_fields(results.get("site_health")),
+                   **telemetry_log_fields(results.get("site_telemetry"))},
         )
         write_test_metrics_csv(d, fold, results["test_scores"])
         save_checkpoint(

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 
 from .logs import log_warning
 
@@ -39,6 +40,12 @@ class EpochPlanPrefetcher:
     for that epoch. Epochs are consumed strictly in order ``first..last`` via
     :meth:`get`; a mismatch (defensive — the trainer consumes sequentially)
     falls back to building synchronously.
+
+    Telemetry: the prefetcher keeps its own counters — time the consumer
+    spent BLOCKED waiting on the builder (``stall_s``: the double-buffering
+    failure signal), gets served, inline-build fallbacks, and the summed
+    queue depth at get time — surfaced via :meth:`stats` into the fit's
+    ``metrics.jsonl`` summary row (telemetry/sink.py).
     """
 
     def __init__(self, build, first_epoch: int, last_epoch: int):
@@ -46,6 +53,10 @@ class EpochPlanPrefetcher:
         self._queue: queue.Queue = queue.Queue(maxsize=1)
         self._stop = threading.Event()
         self._error: BaseException | None = None
+        self._stall_s = 0.0
+        self._gets = 0
+        self._inline_builds = 0
+        self._depth_sum = 0
         self._thread = threading.Thread(
             target=self._run, args=(first_epoch, last_epoch),
             name="dinunet-epoch-prefetch", daemon=True,
@@ -78,22 +89,42 @@ class EpochPlanPrefetcher:
     def get(self, epoch: int):
         """The prefetched payload for ``epoch`` (blocking briefly if the
         builder is still working on it). Re-raises a builder crash."""
-        while True:
-            if self._error is not None:
-                err, self._error = self._error, None
-                self.close()
-                raise err
-            if not self._thread.is_alive() and self._queue.empty():
-                # builder finished (or died after its warning): build inline
+        t0 = time.perf_counter()
+        self._gets += 1
+        self._depth_sum += self._queue.qsize()
+        try:
+            while True:
+                if self._error is not None:
+                    err, self._error = self._error, None
+                    self.close()
+                    raise err
+                if not self._thread.is_alive() and self._queue.empty():
+                    # builder finished (or died after its warning): build inline
+                    self._inline_builds += 1
+                    return self._build(epoch)
+                try:
+                    got_epoch, payload = self._queue.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                if got_epoch == epoch:
+                    return payload
+                # out-of-order consumption (defensive): drop and build inline
+                self._inline_builds += 1
                 return self._build(epoch)
-            try:
-                got_epoch, payload = self._queue.get(timeout=0.1)
-            except queue.Empty:
-                continue
-            if got_epoch == epoch:
-                return payload
-            # out-of-order consumption (defensive): drop and build inline
-            return self._build(epoch)
+        finally:
+            self._stall_s += time.perf_counter() - t0
+
+    def stats(self) -> dict:
+        """Counters for the telemetry summary row: consumer-blocked seconds,
+        gets served, inline-build fallbacks, mean queue depth at get."""
+        return {
+            "stall_s": round(self._stall_s, 6),
+            "gets": self._gets,
+            "inline_builds": self._inline_builds,
+            "mean_queue_depth": round(
+                self._depth_sum / max(self._gets, 1), 3
+            ),
+        }
 
     def close(self) -> None:
         """Stop the builder and join the thread. Idempotent; called from the

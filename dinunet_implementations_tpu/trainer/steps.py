@@ -37,6 +37,11 @@ from ..engines.base import Engine
 from ..parallel.collectives import site_weight_scale
 from ..parallel.mesh import FOLD_AXIS, MODEL_AXIS, SITE_AXIS
 from ..robustness.health import default_health
+from ..telemetry.metrics import (
+    default_round_telemetry,
+    payload_bytes_of,
+    tree_sq_sum,
+)
 
 
 def _model_axis_of(mesh) -> str | None:
@@ -63,6 +68,11 @@ class TrainState:
     # skipped-round count, sticky quarantine flag. None only for states built
     # by hand pre-0.3 code paths — the epoch fn fills in zeros then.
     health: Any = None
+    # PER-SITE round-metric accumulators (telemetry/metrics.py): grad/update
+    # norms, engine residual, payload bytes. None whenever
+    # TrainConfig.telemetry="off" — the epoch program then carries no
+    # telemetry ops at all (bitwise-equal to the pre-telemetry program).
+    telemetry: Any = None
 
 
 def _state_specs(state: TrainState):
@@ -80,6 +90,7 @@ def _state_specs(state: TrainState):
         rng=P(),
         round=P(),
         health=jax.tree.map(lambda _: P(SITE_AXIS), state.health),
+        telemetry=jax.tree.map(lambda _: P(SITE_AXIS), state.telemetry),
     )
 
 
@@ -150,6 +161,7 @@ def init_train_state(
     rng,
     sample_x,
     num_sites: int = 1,
+    telemetry: bool = False,
 ) -> TrainState:
     params, batch_stats = task.init_variables(rng, sample_x)
     site_state = engine.init(params)
@@ -164,6 +176,10 @@ def init_train_state(
         rng=rng,
         round=jnp.zeros((), jnp.int32),
         health=default_health(num_sites),
+        # telemetry accumulators only when the epoch fn will maintain them —
+        # a telemetry-carrying state fed to a telemetry-off program would
+        # force a structure change (and a recompile) at the jit boundary
+        telemetry=default_round_telemetry(num_sites) if telemetry else None,
     )
 
 
@@ -198,6 +214,7 @@ def make_train_epoch_fn(
     quarantine_rounds: int | None = 3,
     pipeline: str = "host",
     donate_state: bool = False,
+    telemetry: bool = False,
 ):
     """Build the jitted epoch function.
 
@@ -243,6 +260,14 @@ def make_train_epoch_fn(
     mask statically compiles the fault machinery OUT — the exact
     pre-robustness program, for benchmarking the machinery's cost.
     ``quarantine_rounds=None`` means the default (3).
+
+    Telemetry (telemetry/metrics.py): ``telemetry=True`` accumulates, every
+    round, per-site grad/update norms, the engine aggregation residual and
+    modeled payload bytes into ``state.telemetry`` — traced values riding the
+    same rounds scan (zero extra host syncs, zero recompiles).
+    ``telemetry=False`` (default) statically compiles all of it out and
+    carries ``state.telemetry=None``: the exact pre-telemetry program, same
+    pattern as ``quarantine_rounds=-1``.
 
     Site-axis realization (both run the *same* per-site program):
 
@@ -335,9 +360,42 @@ def make_train_epoch_fn(
         # the exact pre-robustness program (the bench escape hatch)
         guard = quarantine_rounds >= 0 or live is not None
         health = state.health  # filled by epoch_fn before any shard_map
+        # trace-time static: telemetry accumulators exist iff the epoch was
+        # built with telemetry=True (_ensure_aux normalizes the state), so a
+        # telemetry-off program carries zero telemetry ops
+        telem = state.telemetry is not None
+        # modeled per-round per-site collective payload — pure shape
+        # arithmetic over the gradient pytree, folded in as a constant
+        wire_b = payload_bytes_of(engine, state.params) if telem else 0.0
+
+        def _ts_round(ts, site_grad, agg):
+            """One site's accumulator update for this round. ``grad_sq_last``
+            keeps the raw value (NaN = "this site blew up", the signal);
+            the sums/max take finite rounds only, or one bad round would
+            poison them for the rest of the fit. The update-norm slots are
+            filled after the (global) optimizer step in ``one_round``."""
+            if ts is None:
+                return None
+            gsq = tree_sq_sum(site_grad)
+            rsq = tree_sq_sum(
+                jax.tree.map(lambda g, a: g - a, site_grad, agg)
+            )
+            gsq_f = jnp.where(jnp.isfinite(gsq), gsq, 0.0)
+            return {
+                "grad_sq_last": gsq,
+                "grad_sq_max": jnp.maximum(ts["grad_sq_max"], gsq_f),
+                "grad_sq_sum": ts["grad_sq_sum"] + gsq_f,
+                "payload_bytes": ts["payload_bytes"] + wire_b,
+                "residual_sq_sum": ts["residual_sq_sum"]
+                + jnp.where(jnp.isfinite(rsq), rsq, 0.0),
+                "rounds": ts["rounds"] + 1,
+                "update_sq_last": ts["update_sq_last"],
+                "update_sq_sum": ts["update_sq_sum"],
+            }
 
         def one_round(carry, xs):
-            params, batch_stats, opt_state, engine_state, health, rng, rnd = carry
+            (params, batch_stats, opt_state, engine_state, health, telem_st,
+             rng, rnd) = carry
             pz = None
             if use_scan_xs:
                 parts = list(xs)
@@ -375,7 +433,7 @@ def make_train_epoch_fn(
                     xb, yb, wb = jax.vmap(_gather_batch)(inv_x, inv_y, ib, pz)
             rng, sub = jax.random.split(rng)
 
-            def site_part(es, hs, ls, xs, ys, ws):
+            def site_part(es, hs, ts, ls, xs, ys, ws):
                 site_ix = jax.lax.axis_index(site_axes)
 
                 def micro(acc, mb):
@@ -416,7 +474,8 @@ def make_train_epoch_fn(
                     loss_round = jax.lax.psum(
                         loss_sums.sum(), site_axes
                     ) / jnp.maximum(jax.lax.psum(n_sum, site_axes), 1.0)
-                    return agg, es_new, hs, new_stats, loss_round, None
+                    return (agg, es_new, hs, _ts_round(ts, site_grad, agg),
+                            new_stats, loss_round, None)
                 # -- liveness: scheduled-live AND finite AND not quarantined.
                 # A poisoned batch (data corruption, overflow, fault
                 # injection) yields a non-finite site gradient; that site is
@@ -485,12 +544,13 @@ def make_train_epoch_fn(
                     "skips": hs["skips"] + (contribute <= 0).astype(jnp.int32),
                     "quarantined": quarantined,
                 }
-                return agg, es_new, hs_new, new_stats, loss_round, total_live
+                return (agg, es_new, hs_new, _ts_round(ts, site_grad, agg),
+                        new_stats, loss_round, total_live)
 
-            agg, engine_state, health, stats_k, loss_k, tl_k = jax.vmap(
-                site_part, in_axes=(0, 0, 0, 0, 0, 0),
-                out_axes=(0, 0, 0, 0, 0, 0), axis_name=inner_axis,
-            )(engine_state, health, lb, xb, yb, wb)
+            agg, engine_state, health, telem_k, stats_k, loss_k, tl_k = jax.vmap(
+                site_part, in_axes=(0, 0, 0, 0, 0, 0, 0),
+                out_axes=(0, 0, 0, 0, 0, 0, 0), axis_name=inner_axis,
+            )(engine_state, health, telem_st, lb, xb, yb, wb)
             # agg/stats/loss are psum'd over site_axes → identical across the
             # k in-device rows; collapse to one copy and update once
             agg = jax.tree.map(lambda a: a[0], agg)
@@ -512,9 +572,23 @@ def make_train_epoch_fn(
                 )
             else:
                 params, opt_state = new_params, new_opt_state
+            if telem:
+                # the applied optimizer update's squared norm — global (the
+                # update is replicated), broadcast into every site's row; a
+                # zero-live round applied nothing, so it records 0
+                usq = tree_sq_sum(updates)
+                if guard:
+                    usq = jnp.where(tl_k[0] > 0, usq, 0.0)
+                telem_k = {
+                    **telem_k,
+                    "update_sq_last": jnp.zeros_like(
+                        telem_k["update_sq_last"]
+                    ) + usq,
+                    "update_sq_sum": telem_k["update_sq_sum"] + usq,
+                }
             return (
-                params, batch_stats, opt_state, engine_state, health, rng,
-                rnd + 1,
+                params, batch_stats, opt_state, engine_state, health,
+                telem_k, rng, rnd + 1,
             ), loss_k[0]
 
         carry0 = (
@@ -523,6 +597,7 @@ def make_train_epoch_fn(
             state.opt_state,
             state.engine_state,
             health,
+            state.telemetry,
             jax.random.fold_in(state.rng, state.round),
             state.round,
         )
@@ -554,9 +629,8 @@ def make_train_epoch_fn(
                 xs = xs + (jnp.moveaxis(live_rounds, 1, 0),)
         else:
             xs = jnp.arange(rounds)
-        (params, stats, opt_state, engine_state, health, rng, rnd), losses = (
-            jax.lax.scan(one_round, carry0, xs)
-        )
+        (params, stats, opt_state, engine_state, health, telem_out, rng,
+         rnd), losses = jax.lax.scan(one_round, carry0, xs)
         new_state = TrainState(
             params=params,
             batch_stats=stats,
@@ -565,6 +639,7 @@ def make_train_epoch_fn(
             rng=state.rng,
             round=rnd,
             health=health,
+            telemetry=telem_out,
         )
         return new_state, losses
 
@@ -579,6 +654,21 @@ def make_train_epoch_fn(
             or state.health["streak"].shape[0] != inputs.shape[0]
         ):
             state = state.replace(health=default_health(inputs.shape[0]))
+        # telemetry accumulators mirror the flag this epoch was built with:
+        # off drops any carried accumulators (a checkpoint from a telemetry
+        # run resumed with telemetry off — the program stays the legacy
+        # one), on fills/resizes them like health. Trace-time structure
+        # normalization, so the compiled form is stable per flag.
+        if not telemetry:
+            if state.telemetry is not None:
+                state = state.replace(telemetry=None)
+        elif (
+            state.telemetry is None
+            or state.telemetry["rounds"].shape[0] != inputs.shape[0]
+        ):
+            state = state.replace(
+                telemetry=default_round_telemetry(inputs.shape[0])
+            )
         return state
 
     # donate the carried state's buffers to the epoch program: the update

@@ -37,12 +37,8 @@ Usage: python scripts/profile_epoch.py [--aot] [--epochs N] [--engine E]
   --small  harness-validation dims (CPU-friendly); records dims + backend
 """
 
-import collections
-import glob
-import gzip
 import json
 import os
-import shutil
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
@@ -53,6 +49,11 @@ import numpy as np
 
 import bench
 from dinunet_implementations_tpu.engines import make_engine
+from dinunet_implementations_tpu.telemetry.xprof import (
+    capture,
+    summarize_device_ops,
+    trace_files,
+)
 from dinunet_implementations_tpu.engines.base import Engine, register_engine
 from dinunet_implementations_tpu.engines.lowrank import (
     from_matrix,
@@ -284,35 +285,19 @@ def main():
         s, _ = epoch_fn(s, x, y, w)
     jax.tree.map(np.asarray, s)
 
-    shutil.rmtree(TRACE_DIR, ignore_errors=True)
-    with jax.profiler.trace(TRACE_DIR):
+    # capture + summarize via telemetry/xprof.py — this script is a thin
+    # consumer of the tracer layer, not an owner of trace-parsing code
+    with capture(TRACE_DIR, fresh=True):
         s = state0
         for _ in range(epochs):
             s, _ = epoch_fn(s, x, y, w)
         jax.tree.map(np.asarray, s)
 
-    path = glob.glob(os.path.join(
-        TRACE_DIR, "plugins/profile/*/*.trace.json.gz"))[0]
-    with gzip.open(path) as fh:
-        d = json.load(fh)
-    names = {}
-    for e in d.get("traceEvents", []):
-        if e.get("ph") == "M" and e.get("name") == "thread_name":
-            names[(e["pid"], e["tid"])] = e["args"]["name"]
-    agg = collections.Counter()
-    cnt = collections.Counter()
-    for e in d.get("traceEvents", []):
-        if e.get("ph") != "X":
-            continue
-        tname = str(names.get((e["pid"], e["tid"]), "?"))
-        if "XLA" not in tname and "Module" not in tname:
-            continue
-        agg[e["name"]] += float(e.get("dur", 0))
-        cnt[e["name"]] += 1
     print(f"top 25 device ops for {engine_name} "
-          f"(us over {epochs} epochs; trace: {path})")
-    for n, v in agg.most_common(25):
-        print(f"{v:10.0f}  x{cnt[n]:4d}  {n[:80]}")
+          f"(us over {epochs} epochs; trace: {trace_files(TRACE_DIR)[0]})")
+    for rec in summarize_device_ops(TRACE_DIR, top=25):
+        print(f"{rec['total_us']:10.0f}  x{rec['count']:4d}  "
+              f"{rec['name'][:80]}")
 
 
 if __name__ == "__main__":

@@ -227,6 +227,35 @@ def _ckpt_fixture(payload_keys, template_keys, pops=()):
     """
 
 
+def test_r007_telemetry_name_stability(tmp_path):
+    """Span/event/counter names must be greppable: literals and UPPER_CASE
+    constant references pass; f-strings, lowercase variables and
+    runtime-built names are flagged (variable parts belong in attrs)."""
+    ok = (
+        'SPAN_EPOCH = "epoch"\n'
+        "def f(tracer, names, e):\n"
+        '    with tracer.span("epoch", epoch=e):\n'
+        "        pass\n"
+        "    with tracer.span(SPAN_EPOCH):\n"
+        "        pass\n"
+        "    tracer.event(names.CHECKPOINT)\n"
+        '    tracer.counter("queue-depth", e)\n'
+    )
+    assert _scan(tmp_path / "ok", {"trainer/t.py": ok}) == []
+    bad = (
+        "def f(tracer, e, name):\n"
+        "    with tracer.span(f\"epoch-{e}\"):\n"
+        "        pass\n"
+        "    tracer.event(name)\n"
+        '    tracer.counter("x" + str(e), 1)\n'
+    )
+    fs = _scan(tmp_path / "bad", {"trainer/t.py": bad})
+    assert _rules(fs) == ["R007"] * 3
+    # the name= keyword form is checked like positional
+    kw = "def f(tr, n):\n    tr.event(name=n)\n"
+    assert _rules(_scan(tmp_path / "kw", {"trainer/k.py": kw})) == ["R007"]
+
+
 def test_r006_schema_consistent(tmp_path):
     fs = _scan(tmp_path, {
         "trainer/steps.py": _STEPS_FIXTURE,
@@ -277,6 +306,10 @@ _TRIGGERS = {
     "R005": ("engines/e.py",
              "def f(x):\n    return int(x)",
              "def f(x):\n    return int(x)  # jaxlint: disable=R005"),
+    "R007": ("telemetry/f.py",
+             "def f(tr, i):\n    with tr.span(f'epoch-{i}'):\n        pass",
+             "def f(tr, i):\n    with tr.span(f'epoch-{i}'):"
+             "  # jaxlint: disable=R007\n        pass"),
 }
 
 
