@@ -23,11 +23,23 @@ Static rules (``python -m dinunet_implementations_tpu.checks``):
 - **R006** ``TrainState`` fields round-trip through the checkpoint
   serializer's key set (schema-drift guard).
 
+Semantic tier (``--semantic``, rules S001-S005 — ``semantic.py``): the AST
+rules check what the source promises; the semantic tier traces the REAL
+epoch programs for an engine × topology × pipeline matrix on CPU and
+verifies the traced/lowered/compiled forms — collective/mesh-axis audit
+over every sub-jaxpr (S001), traced collective payload bytes vs each
+engine's ``wire_bytes`` model (S002), compiled input-output aliasing for
+donated state buffers (S003), precision flow on the wire path (S004), and
+normalized-lowering program identity for the telemetry/faults/sanitizer
+off-forms (S005, backed by the ``lowering.py`` differ).
+
 Findings support inline ``# jaxlint: disable=Rxxx`` suppression and a
-checked-in baseline (``checks/baseline.json``, shipped empty). The analyzer
-half is stdlib-only; the runtime sanitizer (``sanitize.py``,
-``DINUNET_SANITIZE=1``) adds a compile-counter guard, leak checking, and
-debug-NaN mode around real fits.
+checked-in baseline per tier (``checks/baseline.json`` /
+``checks/baseline_semantic.json``, both shipped empty; semantic findings
+baseline-only — there is no source line to suppress on). The AST tier is
+stdlib-only; the runtime sanitizer (``sanitize.py``, ``DINUNET_SANITIZE=1``)
+adds a compile-counter guard, leak checking, and debug-NaN mode around real
+fits.
 """
 
 from .core import (
@@ -53,13 +65,30 @@ __all__ = [
     "DEFAULT_BASELINE",
     "Finding",
     "PACKAGE_ROOT",
+    "SEMANTIC_BASELINE",
     "SanitizerViolation",
     "apply_baseline",
+    "diff_report",
     "jit_cache_size",
     "load_baseline",
     "run_checks",
+    "run_semantic_checks",
     "sanitize_enabled",
     "sanitize_flags",
     "sanitized_fit",
     "save_baseline",
 ]
+
+
+def __getattr__(name):
+    # the semantic tier imports jax; load it lazily so the stdlib-only AST
+    # tier (and bare `import ...checks`) stays jax-free
+    if name in ("run_semantic_checks", "SEMANTIC_BASELINE"):
+        from . import semantic
+
+        return getattr(semantic, name)
+    if name == "diff_report":
+        from .lowering import diff_report
+
+        return diff_report
+    raise AttributeError(name)

@@ -1,9 +1,21 @@
 """CLI: ``python -m dinunet_implementations_tpu.checks [paths...]``.
 
+Two tiers behind one gate:
+
+- default: the stdlib-only AST tier (jaxlint, rules R001-R007) over source
+  files;
+- ``--semantic``: the traced-program tier (jaxprlint, rules S001-S005,
+  semantic.py) — traces the real epoch programs for the
+  engine × topology × pipeline matrix on CPU virtual devices and verifies
+  collective axes, wire-byte models, donation aliasing, precision flow, and
+  program identity. Each tier has its own baseline file
+  (``baseline.json`` / ``baseline_semantic.json``, both shipped empty).
+
 Exit code 0 when every finding is baselined (or there are none), 1 when new
-findings exist — the tier-1/CI lint gate. ``--baseline`` regenerates the
-checked-in baseline from the current findings (for grandfathering during a
-large refactor; the shipped baseline is empty and should stay that way).
+findings exist — the tier-1/CI gate. ``--baseline`` regenerates the active
+tier's baseline from the current findings. ``--format json`` emits one JSON
+object per finding (CI artifact); ``--format sarif`` emits a SARIF 2.1.0
+document for code-scanning annotation; human text stays the default.
 """
 
 from __future__ import annotations
@@ -22,44 +34,109 @@ from .core import (
 )
 
 
+def _sarif(findings: list, tool: str) -> dict:
+    """Minimal SARIF 2.1.0 document — enough for GitHub code-scanning /
+    generic SARIF viewers to annotate findings by file/line."""
+    rules = sorted({f.rule for f in findings})
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message + (f"\nfix: {f.fixit}" if f.fixit else "")},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {
+                        "startLine": max(f.line, 1),
+                        "startColumn": f.col + 1,
+                    },
+                },
+            }],
+        })
+    return {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                   "master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": tool,
+                "informationUri": "https://github.com/trendscenter/"
+                                  "dinunet_implementations",
+                "rules": [{"id": r} for r in rules],
+            }},
+            "results": results,
+        }],
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m dinunet_implementations_tpu.checks",
         description="jaxlint: codebase-specific SPMD-invariant analyzer "
-                    "(rules R001-R006; see the checks package docstring).",
+                    "(AST rules R001-R007; --semantic adds the traced-"
+                    "program rules S001-S005 — see the checks package and "
+                    "semantic.py docstrings).",
     )
     p.add_argument("paths", nargs="*",
                    help="files/directories to scan (default: the installed "
-                        "dinunet_implementations_tpu package)")
+                        "dinunet_implementations_tpu package; ignored with "
+                        "--semantic, which traces programs, not files)")
+    p.add_argument("--semantic", action="store_true",
+                   help="run the semantic tier: trace the real epoch "
+                        "programs on CPU and verify collectives/mesh axes "
+                        "(S001), wire-byte models (S002), donation aliasing "
+                        "(S003), precision flow (S004), and lowering "
+                        "identity (S005)")
     p.add_argument("--baseline", action="store_true",
-                   help="regenerate the baseline file from the current "
-                        "findings and exit 0")
-    p.add_argument("--baseline-file", default=DEFAULT_BASELINE,
-                   help=f"baseline path (default: {DEFAULT_BASELINE})")
+                   help="regenerate the active tier's baseline file from "
+                        "the current findings and exit 0")
+    p.add_argument("--baseline-file", default=None,
+                   help="baseline path (default: the active tier's shipped "
+                        f"baseline, e.g. {DEFAULT_BASELINE})")
     p.add_argument("--no-baseline", action="store_true",
                    help="ignore the baseline: report every finding")
+    p.add_argument("--format", choices=("human", "json", "sarif"),
+                   default=None, dest="fmt",
+                   help="output format (default: human; json = one object "
+                        "per finding, sarif = one SARIF 2.1.0 document)")
     p.add_argument("--json", action="store_true", dest="as_json",
-                   help="one JSON object per finding on stdout")
+                   help="(deprecated) same as --format json")
     args = p.parse_args(argv)
+    fmt = args.fmt or ("json" if args.as_json else "human")
 
-    findings = []
-    for root in (args.paths or [PACKAGE_ROOT]):
-        findings.extend(run_checks(root))
+    if args.semantic:
+        # late import: the semantic tier needs jax + virtual CPU devices;
+        # the AST tier must stay stdlib-only
+        from .semantic import SEMANTIC_BASELINE, run_semantic_checks
+
+        findings = run_semantic_checks()
+        default_baseline = SEMANTIC_BASELINE
+        tool = "jaxprlint"
+    else:
+        findings = []
+        for root in (args.paths or [PACKAGE_ROOT]):
+            findings.extend(run_checks(root))
+        default_baseline = DEFAULT_BASELINE
+        tool = "jaxlint"
+    baseline_file = args.baseline_file or default_baseline
 
     if args.baseline:
-        path = save_baseline(findings, args.baseline_file)
-        print(f"jaxlint: wrote {len(findings)} baseline entries to {path}")
+        path = save_baseline(findings, baseline_file)
+        print(f"{tool}: wrote {len(findings)} baseline entries to {path}")
         return 0
 
-    baseline = [] if args.no_baseline else load_baseline(args.baseline_file)
+    baseline = [] if args.no_baseline else load_baseline(baseline_file)
     new, matched = apply_baseline(findings, baseline)
-    if args.as_json:
+    if fmt == "json":
         for f in new:
             print(json.dumps(f.to_dict()))
+    elif fmt == "sarif":
+        print(json.dumps(_sarif(new, tool), indent=2))
     else:
         for f in new:
             print(f.format())
-    tail = f"jaxlint: {len(new)} finding(s)"
+    tail = f"{tool}: {len(new)} finding(s)"
     if matched:
         tail += f" ({matched} baselined)"
     print(tail, file=sys.stderr)

@@ -1,0 +1,106 @@
+"""Normalized-lowering differ — the "off == compiled out" claims as a library.
+
+The repo stakes several correctness/perf claims on PROGRAM IDENTITY, not
+value identity: ``telemetry="off"`` must compile the exact pre-telemetry
+epoch, the fault machinery's static opt-out must really remove it, the
+sanitizer's observation modes must not perturb what they observe. PR 2/PR 5
+asserted those with ad-hoc ``lowered.as_text() == ...`` string comparisons —
+a raw equality whose failure mode is a useless multi-megabyte diff. This
+module is the shared replacement:
+
+- :func:`normalize_lowering` canonicalizes a lowered program's text
+  (StableHLO MLIR from ``Lowered.as_text()`` or post-optimization HLO from
+  ``Compiled.as_text()``): location/metadata stripped, SSA/instruction ids
+  renamed to appearance order, module names unified — so an identity check
+  survives cosmetic churn (id renumbering, debug-info toggles) while any
+  STRUCTURAL change (one extra op) still diverges;
+- :func:`diff_report` compares two normalized programs (the
+  ``Lowered.as_text()`` strings) and returns ``None`` on identity or a
+  compact human-readable first-divergence report (the thing a failed `==`
+  never gave us).
+
+Used by the S005 semantic rule (checks/semantic.py) as a CLI gate and by the
+parametrized off==baseline test harness (tests/test_lowering_identity.py).
+"""
+
+from __future__ import annotations
+
+import difflib
+import re
+
+#: ``loc(...)`` MLIR location attributes (one level of nested parens is
+#: enough for jax's emitted forms: ``loc("x"("f.py":1:2))``)
+_LOC_RE = re.compile(r"\s*loc\((?:[^()]|\([^()]*\))*\)")
+#: HLO-text ``metadata={op_name=... source_file=...}`` operand suffixes
+_METADATA_RE = re.compile(r",?\s*metadata=\{[^{}]*\}")
+#: SSA values / HLO instruction names: ``%arg0``, ``%123``, ``%add.42``
+_ID_RE = re.compile(r"%[A-Za-z_][\w.]*|%\d+")
+#: module headers carry build-dependent names: ``module @jit_epoch_fn_impl``,
+#: ``HloModule jit_epoch_fn_impl, ...``
+_MODULE_RE = re.compile(r"(module @)\S+|(HloModule )\S+?(?=[, ])")
+
+
+def normalize_lowering(text: str) -> list[str]:
+    """Canonicalize one lowered program's text into comparable lines.
+
+    Order of appearance drives id renaming, so two programs are equal after
+    normalization iff they consist of the same ops with the same structure
+    and dataflow — the property the "off == compiled out" claims mean.
+    """
+    text = _LOC_RE.sub("", text)
+    text = _METADATA_RE.sub("", text)
+    text = _MODULE_RE.sub(lambda m: (m.group(1) or m.group(2)) + "<m>", text)
+    ids: dict[str, str] = {}
+
+    def rename(m: re.Match) -> str:
+        tok = m.group(0)
+        if tok not in ids:
+            ids[tok] = f"%v{len(ids)}"
+        return ids[tok]
+
+    text = _ID_RE.sub(rename, text)
+    lines = []
+    for ln in text.splitlines():
+        ln = ln.strip()
+        if not ln or ln.startswith("#loc"):
+            continue
+        lines.append(re.sub(r"\s+", " ", ln))
+    return lines
+
+
+def diff_report(
+    a: str,
+    b: str,
+    label_a: str = "baseline",
+    label_b: str = "variant",
+    context: int = 2,
+    max_lines: int = 12,
+) -> str | None:
+    """``None`` when the two programs are identical after normalization;
+    otherwise a human-readable report of the FIRST structural divergence
+    (with ``context`` surrounding lines) plus total divergence counts.
+
+    Divergences come from ``difflib`` edit opcodes, not positional
+    comparison, so one inserted instruction mid-program reads as ONE
+    insertion at its true location — not as every subsequent line
+    "differing" by a one-line offset."""
+    la, lb = normalize_lowering(a), normalize_lowering(b)
+    if la == lb:
+        return None
+    opcodes = difflib.SequenceMatcher(a=la, b=lb, autojunk=False).get_opcodes()
+    edits = [op for op in opcodes if op[0] != "equal"]
+    differing = sum(max(i2 - i1, j2 - j1) for _, i1, i2, j1, j2 in edits)
+    tag, i1, i2, j1, j2 = edits[0]
+    out = [
+        f"lowering divergence: {label_a} ({len(la)} lines) != "
+        f"{label_b} ({len(lb)} lines); {differing} differing line(s), "
+        f"first at line {i1 + 1} ({tag}):",
+    ]
+    body = [f"  [{k + 1}]: {la[k]}" for k in range(max(0, i1 - context), i1)]
+    body += [f"> {label_a}[{k + 1}]: {la[k]}" for k in range(i1, i2)]
+    body += [f"> {label_b}[{k + 1}]: {lb[k]}" for k in range(j1, j2)]
+    body += [f"  [{k + 1}]: {la[k]}" for k in range(i2, min(len(la), i2 + context))]
+    out += body[:max_lines]
+    if len(body) > max_lines:
+        out.append(f"  ... ({len(body) - max_lines} more line(s) at this edit)")
+    return "\n".join(out)

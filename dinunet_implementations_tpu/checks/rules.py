@@ -34,6 +34,9 @@ PRINT_ALLOWED_FILES = {
 SWALLOW_SCOPED_DIRS = ("robustness/", "trainer/", "runner/", "parallel/", "native/")
 
 #: R003 — collective ops and the positional index of their axis-name operand.
+#: This table is the SHARED definition of "what counts as a collective": the
+#: semantic tier (semantic.py) audits the traced-primitive form of exactly
+#: this set (see semantic.API_TO_PRIM), so the two tiers cannot drift.
 COLLECTIVE_AXIS_ARG = {
     "psum": 1,
     "pmean": 1,
@@ -43,9 +46,15 @@ COLLECTIVE_AXIS_ARG = {
     "all_gather": 1,
     "all_to_all": 1,
     "ppermute": 1,
+    "pbroadcast": 1,
     "axis_index": 0,
     "axis_size": 0,
 }
+
+#: R003 — keyword spellings of an axis-name argument, on ANY call: the lax
+#: collectives' ``axis_name=``, shard_map/vmap-style ``axis_names=`` /
+#: ``spmd_axis_name=``.
+AXIS_NAME_KWARGS = ("axis_name", "axis_names", "spmd_axis_name")
 
 #: R005 — modules whose function bodies execute under jit tracing by design
 #: (reached from the compiled epoch/eval step): every engine/model/kernel,
@@ -313,7 +322,7 @@ def r003_axis_constants(sf: SourceFile):
             if len(node.args) > pos:
                 axis_args.append(node.args[pos])
         for kw in node.keywords:
-            if kw.arg in ("axis_name", "axis_names"):
+            if kw.arg in AXIS_NAME_KWARGS:
                 axis_args.append(kw.value)
         for arg in axis_args:
             consts: list[ast.Constant] = []

@@ -1,0 +1,879 @@
+"""jaxprlint — semantic SPMD verification over TRACED programs (tier 2).
+
+The AST tier (rules.py, R001-R007) checks what the source text promises; the
+properties the repo actually stakes correctness and perf claims on live in
+the traced/lowered/compiled program: "every collective runs over a declared
+mesh axis", "`wire_bytes` models what really goes over the wire", "donated
+buffers really alias", "the bf16 wire is really bf16", "off == compiled
+out". This module traces the REAL fit programs for a small
+engine × topology × pipeline matrix on CPU virtual devices and verifies
+them semantically:
+
+- **S001** — collective/mesh audit: walking every ClosedJaxpr (recursing
+  into scan/while/pjit/shard_map sub-jaxprs), each collective primitive
+  (``psum``, ``all_gather``, ``reduce_scatter``, …) may name only the
+  declared mesh-axis constants (``parallel/mesh.py``; vmap-resolved fold
+  axes appear as positional ints and are fine), and no cross-site
+  communication may sit outside the rounds scan — at 512+ packed sites a
+  per-round stray collective is a silent synchronization cliff.
+- **S002** — wire-byte proof: the per-round per-site collective payload,
+  computed from the TRACED operand shapes/dtypes, must match the engine's
+  static ``wire_bytes`` model exactly. Matching is structural: every entry
+  of the engine's ``wire_shapes`` introspection hook (engines/base.py) must
+  appear as a traced collective operand (site-block axis stripped), every
+  traced payload-sized operand must be covered by the model, and the byte
+  totals must agree. The telemetry layer's ``payload_bytes`` figures
+  (telemetry/metrics.py) become verified, not modeled.
+- **S003** — donation proof: for ``donate_epoch_state`` builds, the compiled
+  executable's input-output aliasing must actually contain every donated
+  TrainState buffer. A donated-but-unaliased arg is a silent HBM/perf bug —
+  jax warns once to stderr and the epoch quietly doubles its params+opt
+  residency.
+- **S004** — precision-flow lint on the aggregation path: each payload
+  operand's wire dtype (resolved through its producer chain, so the
+  ``wire_compress`` bf16→f32 round-trip counts as bf16) must not be wider
+  than the engine's modeled payload dtype, and a ``precision_bits="16"``
+  compression engine must actually lower low-precision ``dot_general`` ops
+  for its power-iteration products (engines/lowrank.py ``lp_matmul``).
+- **S005** — program-identity gate over the normalized-lowering differ
+  (checks/lowering.py): telemetry-off, faults-off(-by-default), and the
+  sanitizer's observation modes must be lowering-identical to the baseline
+  program, and the static opt-outs (``quarantine_rounds=-1``,
+  ``telemetry=True``) must genuinely diverge — if the "compiled out"
+  machinery stops being compiled out, this gate fails.
+
+Run with ``python -m dinunet_implementations_tpu.checks --semantic`` (CPU;
+the CLI provisions virtual devices). Findings ride the same
+:class:`~.core.Finding`/baseline machinery as the AST tier, keyed on
+``(rule, trace://<cell>, snippet)`` — grandfathering goes through
+``checks/baseline_semantic.json`` (shipped EMPTY); there is no inline
+suppression for traced programs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import re
+
+from .core import Finding
+from .rules import COLLECTIVE_AXIS_ARG
+
+#: the semantic tier's grandfather list (empty == every traced program clean)
+SEMANTIC_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline_semantic.json"
+)
+
+# -- collective tables ------------------------------------------------------
+# Derived from the AST tier's COLLECTIVE_AXIS_ARG so the two tiers agree on
+# what counts as a collective (tests/test_semantic.py asserts the mapping is
+# total). Some lax APIs trace to differently-named primitives:
+API_TO_PRIM = {
+    "psum_scatter": "reduce_scatter",
+    "pmean": "psum",  # pmean is psum / axis_size sugar
+    "axis_size": "psum",  # old-jax spelling: psum(1, axis)
+}
+
+#: traced primitives that move data across the site/model axes
+COMM_PRIMS = frozenset({
+    "psum", "pmax", "pmin", "ppermute", "all_gather", "all_to_all",
+    "reduce_scatter", "pbroadcast",
+})
+#: traced primitives that only QUERY the axis (no payload; exempt from the
+#: in-scan and wire-byte rules, still axis-name audited)
+QUERY_PRIMS = frozenset({"axis_index"})
+
+
+def prim_for(api_name: str) -> str:
+    """Traced-primitive name for a lax collective API name."""
+    return API_TO_PRIM.get(api_name, api_name)
+
+
+# tier agreement, enforced at import (a hard raise, not an assert — it must
+# survive python -O): every collective the AST tier knows must trace to a
+# primitive this tier audits
+_unmapped = [
+    n for n in COLLECTIVE_AXIS_ARG
+    if prim_for(n) not in COMM_PRIMS | QUERY_PRIMS
+]
+if _unmapped:
+    raise RuntimeError(
+        f"rules.COLLECTIVE_AXIS_ARG and the semantic tier's COMM/QUERY "
+        f"primitive tables have drifted: {_unmapped} have no traced-"
+        f"primitive mapping (extend API_TO_PRIM/COMM_PRIMS)"
+    )
+
+
+def ensure_cpu_devices(min_devices: int = 2, want: int = 8) -> None:
+    """Provision virtual CPU devices for the trace matrix.
+
+    Must run before the jax backend initializes (the CLI path — jax is
+    imported by the package but uninitialized until first device use); in an
+    already-initialized process (pytest under tests/conftest.py) it is a
+    no-op and the session's device count is used.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={want}"
+        ).strip()
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except (RuntimeError, ValueError):
+        pass  # backend already initialized; run on what the session has
+    cpus = [d for d in jax.devices() if d.platform == "cpu"]
+    if len(cpus) < min_devices:
+        raise RuntimeError(
+            f"the semantic tier traces mesh programs and needs >= "
+            f"{min_devices} CPU devices, have {len(cpus)}; run via `python "
+            f"-m dinunet_implementations_tpu.checks --semantic` (which sets "
+            f"XLA_FLAGS before jax initializes) or export XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={want}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class CollectiveSite:
+    """One collective primitive found in a traced program."""
+
+    prim: str
+    named_axes: tuple  # str axis names only (vmap-resolved folds are ints)
+    operands: tuple  # operand avals
+    scan_depth: int  # 0 == outside every scan/while
+    wire_itemsizes: tuple  # per operand: effective float itemsize of the
+    # payload it carries (_payload_itemsize; None for non-float operands)
+
+
+@dataclasses.dataclass
+class ProgramAudit:
+    """Everything the S-rules need from one traced program."""
+
+    collectives: list
+    dots: list  # (lhs_itemsize, rhs_itemsize, scan_depth) per dot_general
+
+
+#: value-preserving / scaling ops the wire-dtype walk may look through: the
+#: payload chain between "quantized to the wire dtype" and "handed to the
+#: collective" is casts, scale multiplies, liveness selects and layout moves
+_PASSTHROUGH = frozenset({
+    "convert_element_type", "mul", "div", "add", "sub", "neg", "select_n",
+    "reshape", "transpose", "broadcast_in_dim", "squeeze", "expand_dims",
+    "concatenate", "slice", "stop_gradient", "copy",
+})
+
+
+def _sub_jaxprs(params: dict):
+    """All jaxprs nested in one eqn's params (scan/while/pjit/shard_map/
+    custom_* — any param that is a Jaxpr, a ClosedJaxpr, or a sequence of
+    them)."""
+    import jax
+
+    closed = jax.core.ClosedJaxpr
+    plain = jax.core.Jaxpr
+    for v in params.values():
+        if isinstance(v, closed):
+            yield v.jaxpr
+        elif isinstance(v, plain):
+            yield v
+        elif isinstance(v, (list, tuple)):
+            for vv in v:
+                if isinstance(vv, closed):
+                    yield vv.jaxpr
+                elif isinstance(vv, plain):
+                    yield vv
+
+
+def _float_itemsize(dtype):
+    """Itemsize when ``dtype`` is a float (incl. the ml_dtypes extension
+    floats — bfloat16/float8 have numpy kind 'V', not 'f'), else None."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    d = np.dtype(dtype)
+    if d.kind == "f" or jnp.issubdtype(d, jnp.floating):
+        return d.itemsize
+    return None
+
+
+def _is_scale_operand(var, producers: dict) -> bool:
+    """True when ``var`` enters an arithmetic op as a scale/mask rather than
+    as the payload itself: a literal, a scalar, or a broadcast of something
+    smaller than itself. A narrow float there perturbs the payload but does
+    not quantize it, so the wire-dtype walk must not let it narrow the
+    result."""
+    aval = getattr(var, "aval", None)
+    if aval is None:  # jaxpr Literal
+        return True
+    shape = tuple(getattr(aval, "shape", ()))
+    if math.prod(shape) <= 1:
+        return True
+    eqn = producers.get(id(var))
+    if eqn is not None and eqn.primitive.name == "broadcast_in_dim":
+        src = getattr(eqn.invars[0], "aval", None)
+        if src is not None and (
+            math.prod(tuple(getattr(src, "shape", ()))) < math.prod(shape)
+        ):
+            return True
+    return False
+
+
+def _payload_itemsize(var, producers: dict, max_depth: int = 10):
+    """Effective float itemsize of the value ``var`` carries onto the wire —
+    the dtype the payload was QUANTIZED to, even when an f32-accumulating
+    collective consumes the f32 round-trip of a bf16 value
+    (``parallel/collectives.py wire_compress``).
+
+    The walk follows the payload's own dataflow, not every contributor: a
+    cast chain can only narrow (min with the input), an n-ary arithmetic op
+    is only as narrow as its WIDEST data-carrying operand (combining a
+    quantized tensor with a full-precision one leaves the quantized grid —
+    an f32 payload multiplied by a mask that touched bf16 must still read
+    f32), and scale/mask operands (:func:`_is_scale_operand`) are skipped
+    entirely (an f32 grad scaled by a shared bf16 scalar is not a bf16
+    wire, and a bf16 payload scaled by an f32 weight still is one)."""
+
+    def eff(v, depth):
+        aval = getattr(v, "aval", None)
+        if aval is None:
+            return None
+        storage = _float_itemsize(aval.dtype)
+        if storage is None:
+            return None
+        eqn = producers.get(id(v))
+        if eqn is None or depth >= max_depth:
+            return storage
+        if eqn.primitive.name not in _PASSTHROUGH:
+            return storage
+        data = [
+            iv for iv in eqn.invars
+            if len(eqn.invars) == 1 or not _is_scale_operand(iv, producers)
+        ]
+        subs = [s for s in (eff(iv, depth + 1) for iv in data) if s is not None]
+        if not subs:
+            return storage
+        return min(storage, max(subs))
+
+    return eff(var, 0)
+
+
+def _named_axes(params: dict) -> tuple:
+    ax = params.get("axes", params.get("axis_name"))
+    if ax is None:
+        return ()
+    if not isinstance(ax, (tuple, list)):
+        ax = (ax,)
+    return tuple(a for a in ax if isinstance(a, str))
+
+
+def audit_jaxpr(closed_jaxpr) -> ProgramAudit:
+    """Walk a ClosedJaxpr (recursing into every sub-jaxpr) and collect all
+    collective sites + dot_general precision info."""
+    collectives: list = []
+    dots: list = []
+
+    def walk(jaxpr, scan_depth: int):
+        producers: dict = {}
+        for eqn in jaxpr.eqns:
+            for ov in eqn.outvars:
+                producers[id(ov)] = eqn
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name in COMM_PRIMS or name in QUERY_PRIMS:
+                ops = tuple(getattr(v, "aval", None) for v in eqn.invars)
+                wis = tuple(
+                    _payload_itemsize(v, producers) for v in eqn.invars
+                )
+                collectives.append(CollectiveSite(
+                    prim=name,
+                    named_axes=_named_axes(eqn.params),
+                    operands=ops,
+                    scan_depth=scan_depth,
+                    wire_itemsizes=wis,
+                ))
+            elif name == "dot_general":
+                sizes = [
+                    _float_itemsize(v.aval.dtype)
+                    if getattr(v, "aval", None) is not None else None
+                    for v in eqn.invars[:2]
+                ]
+                dots.append((sizes[0], sizes[1], scan_depth))
+            inner_depth = scan_depth + (1 if name in ("scan", "while") else 0)
+            for sub in _sub_jaxprs(eqn.params):
+                walk(sub, inner_depth)
+
+    walk(closed_jaxpr.jaxpr, 0)
+    return ProgramAudit(collectives=collectives, dots=dots)
+
+
+# ---------------------------------------------------------------------------
+# S001 — collective/mesh audit
+# ---------------------------------------------------------------------------
+
+
+def check_collective_axes(
+    collectives: list, path: str, allowed_axes=None,
+    require_in_scan: bool = True,
+) -> list:
+    """S001: every collective names only declared mesh-axis constants, and
+    cross-site communication lives inside the rounds scan."""
+    if allowed_axes is None:
+        from ..parallel.mesh import MODEL_AXIS, SITE_AXIS
+
+        allowed_axes = {SITE_AXIS, MODEL_AXIS}
+    findings = []
+    for site in collectives:
+        rogue = [a for a in site.named_axes if a not in allowed_axes]
+        if rogue:
+            findings.append(Finding(
+                rule="S001", path=path, line=0, col=0,
+                message=(
+                    f"collective '{site.prim}' runs over undeclared axis "
+                    f"name(s) {rogue} (declared mesh axes: "
+                    f"{sorted(allowed_axes)}) — it reduces over something "
+                    f"other than the site/model mesh"
+                ),
+                snippet=f"{site.prim} axes={rogue}",
+                fixit="bind collectives to the parallel/mesh.py axis "
+                      "constants (SITE_AXIS/MODEL_AXIS; folded sites ride "
+                      "vmap and resolve positionally)",
+            ))
+        if require_in_scan and site.prim in COMM_PRIMS and site.scan_depth == 0:
+            findings.append(Finding(
+                rule="S001", path=path, line=0, col=0,
+                message=(
+                    f"cross-site collective '{site.prim}' appears OUTSIDE "
+                    f"the rounds scan — per-epoch stray communication that "
+                    f"the round loop cannot overlap or amortize"
+                ),
+                snippet=f"{site.prim} outside-scan",
+                fixit="move cross-site communication inside the rounds scan "
+                      "(trainer/steps.py one_round) so it ships once per "
+                      "round with the aggregation traffic",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# S002 / S004 — wire-byte proof + precision flow
+# ---------------------------------------------------------------------------
+
+
+def _match_payload(collectives: list, expected: list, block: int):
+    """Assign modeled payload entries to traced collective operands.
+
+    ``expected`` is ``[(shape, np.dtype), ...]`` from the engine's wire
+    model; traced operands are matched by shape after stripping the leading
+    in-device site-block axis (size ``block`` — the k sites vmapped onto one
+    device). Returns ``(matches, missing, leftovers)`` where matches are
+    ``(shape, model_dtype, traced_itemsize, prim)``, missing are unmatched
+    model entries, and leftovers are traced COMM operands covered by
+    nothing (excluding the scalar bookkeeping collectives: loss and
+    weight-normalization psums)."""
+    import numpy as np
+
+    traced = []
+    for site in collectives:
+        if site.prim not in COMM_PRIMS:
+            continue
+        for aval, wi in zip(site.operands, site.wire_itemsizes):
+            if aval is None:
+                continue
+            shp = tuple(aval.shape)
+            stripped = shp[1:] if (shp and shp[0] == block) else shp
+            isz = wi if wi is not None else np.dtype(aval.dtype).itemsize
+            traced.append({
+                "shape": stripped, "itemsize": isz, "prim": site.prim,
+                "matched": False,
+            })
+    matches, missing = [], []
+    for shape, dtype in expected:
+        # prefer an operand at exactly the modeled itemsize so two same-shape
+        # payloads at different dtypes (a bf16 factor next to an f32 dense
+        # leaf) cannot cross-pair; fall back to shape-only so a genuine
+        # upcast still pairs with its model entry (and S004 flags it)
+        # instead of reading as a coverage hole. Stat-shaped operands can't
+        # be excluded here: a dense payload may legitimately share a stat's
+        # shape AND dtype, and then either pairing is byte-identical.
+        cands = [t for t in traced if not t["matched"] and t["shape"] == shape]
+        hit = next(
+            (t for t in cands if t["itemsize"] == dtype.itemsize),
+            cands[0] if cands else None,
+        )
+        if hit is None:
+            missing.append((shape, dtype))
+            continue
+        hit["matched"] = True
+        matches.append((shape, dtype, hit["itemsize"], hit["prim"]))
+    leftovers = [
+        t for t in traced if not t["matched"] and t["shape"] != ()
+    ]
+    return matches, missing, leftovers
+
+
+def check_wire_bytes(
+    collectives: list, engine, params_template, block: int, path: str,
+    stats_shapes=(),
+) -> list:
+    """S002: traced collective payload bytes == ``Engine.wire_bytes``,
+    exactly, with structural coverage both ways."""
+    from ..telemetry.metrics import modeled_wire_shapes, payload_bytes_of
+
+    expected = modeled_wire_shapes(engine, params_template)
+    model_total = sum(
+        math.prod(s) * d.itemsize for s, d in expected
+    )
+    wb = int(payload_bytes_of(engine, params_template))
+    findings = []
+    if model_total != wb:
+        findings.append(Finding(
+            rule="S002", path=path, line=0, col=0,
+            message=(
+                f"engine '{engine.name}': wire_shapes model sums to "
+                f"{model_total} B but wire_bytes reports {wb} B — the "
+                f"structured and scalar payload models have drifted"
+            ),
+            snippet="model-inconsistent",
+            fixit="keep Engine.wire_shapes and Engine.wire_bytes derived "
+                  "from the same shape arithmetic (engines/lowrank.py "
+                  "lowrank_rank_groups)",
+        ))
+    matches, missing, leftovers = _match_payload(collectives, expected, block)
+    for shape, dtype in missing:
+        findings.append(Finding(
+            rule="S002", path=path, line=0, col=0,
+            message=(
+                f"engine '{engine.name}': modeled payload operand "
+                f"{shape}@{dtype} never appears as a traced collective "
+                f"operand — the wire model OVERCOUNTS what ships"
+            ),
+            snippet=f"missing {shape}",
+            fixit="make Engine.wire_shapes mirror the collectives the "
+                  "aggregate actually launches",
+        ))
+    for t in leftovers:
+        if t["shape"] in tuple(stats_shapes):
+            continue  # sync-BN running-stat psums are not engine payload
+        findings.append(Finding(
+            rule="S002", path=path, line=0, col=0,
+            message=(
+                f"engine '{engine.name}': traced collective '{t['prim']}' "
+                f"ships an operand shaped {t['shape']} that no wire-model "
+                f"entry covers — the wire model UNDERCOUNTS what ships"
+            ),
+            snippet=f"unmodeled {t['prim']} {t['shape']}",
+            fixit="add the payload to Engine.wire_shapes/wire_bytes (or "
+                  "stop shipping it)",
+        ))
+    traced_total = sum(
+        math.prod(shape) * isz for shape, _, isz, _ in matches
+    )
+    if not findings and traced_total != wb:
+        findings.append(Finding(
+            rule="S002", path=path, line=0, col=0,
+            message=(
+                f"engine '{engine.name}': traced payload is {traced_total} "
+                f"B/round/site but wire_bytes models {wb} B — telemetry's "
+                f"payload_bytes figures are wrong"
+            ),
+            snippet="bytes-mismatch",
+            fixit="reconcile the traced operand dtypes with the modeled "
+                  "payload dtype (see the S004 findings for which operand "
+                  "widened)",
+        ))
+    return findings
+
+
+def check_precision_flow(
+    collectives: list, engine, params_template, block: int, path: str,
+    require_lowp_dot: bool = False, dots=(),
+) -> list:
+    """S004: no payload rides the wire wider than the engine's modeled
+    payload dtype, and a 16-bit wire on a compression engine really lowers
+    low-precision dots for the power-iteration products."""
+    from ..telemetry.metrics import modeled_wire_shapes
+
+    expected = modeled_wire_shapes(engine, params_template)
+    matches, _, _ = _match_payload(collectives, expected, block)
+    findings = []
+    for shape, dtype, traced_isz, prim in matches:
+        if traced_isz is not None and traced_isz > dtype.itemsize:
+            findings.append(Finding(
+                rule="S004", path=path, line=0, col=0,
+                message=(
+                    f"engine '{engine.name}': payload {shape} rides "
+                    f"'{prim}' at {traced_isz * 8}-bit floats but the wire "
+                    f"model says {dtype} — an accidental upcast on the "
+                    f"wire path (the precision_bits compression is not "
+                    f"happening)"
+                ),
+                snippet=f"upcast {prim} {shape}",
+                fixit="quantize the payload to the wire dtype before the "
+                      "collective (parallel/collectives.py payload_cast / "
+                      "wire_compress)",
+            ))
+    if require_lowp_dot:
+        lowp = any(
+            a is not None and b is not None and a < 4 and b < 4
+            for a, b, _ in dots
+        )
+        if not lowp:
+            findings.append(Finding(
+                rule="S004", path=path, line=0, col=0,
+                message=(
+                    f"engine '{engine.name}' with a 16-bit wire lowers no "
+                    f"low-precision dot_general — the mixed-precision "
+                    f"power-iteration matmuls (engines/lowrank.py "
+                    f"lp_matmul) silently run full f32"
+                ),
+                snippet="no-lowp-dot",
+                fixit="thread matmul_dtype=jnp.bfloat16 through the "
+                      "engine's factorization path when the wire is 16-bit",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# S003 — donation proof
+# ---------------------------------------------------------------------------
+
+#: one `{out_idx}: (param_num, {param_idx}, kind)` entry of the optimized
+#: HLO module's input_output_alias attribute
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{[\d,\s]*\}:\s*\((\d+),\s*\{[^{}]*\},\s*(?:may|must)-alias\)"
+)
+
+
+def check_donation(
+    compiled, args: tuple, donate_argnums: tuple, path: str
+) -> list:
+    """S003: every leaf of every donated argument appears in the compiled
+    executable's input-output aliasing. Parameter numbers in the optimized
+    HLO correspond to the flattened argument leaves in order."""
+    import jax
+
+    aliased = {int(p) for p in _ALIAS_ENTRY_RE.findall(compiled.as_text())}
+    findings = []
+    flat_index = 0
+    for argnum, arg in enumerate(args):
+        leaves = jax.tree_util.tree_flatten_with_path(arg)[0]
+        for keypath, leaf in leaves:
+            if argnum in tuple(donate_argnums) and flat_index not in aliased:
+                kp = jax.tree_util.keystr(keypath)
+                findings.append(Finding(
+                    rule="S003", path=path, line=0, col=0,
+                    message=(
+                        f"donated buffer arg{argnum}{kp} "
+                        f"({tuple(leaf.shape)} {leaf.dtype}) is NOT in the "
+                        f"compiled executable's input-output aliasing — "
+                        f"donation silently dropped, the epoch holds a "
+                        f"second copy of this buffer"
+                    ),
+                    snippet=f"unaliased arg{argnum}{kp}",
+                    fixit="give the donated leaf a same-shape/dtype output "
+                          "to alias into (or stop donating it); see "
+                          "trainer/steps.py donate_state",
+                ))
+            flat_index += 1
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# S005 — program-identity gate
+# ---------------------------------------------------------------------------
+
+
+def check_lowering_identity(pairs: list, path_prefix: str = "lowering://") -> list:
+    """S005: each ``(label, text_a, text_b, expect_identical)`` pair is run
+    through the normalized differ; an unexpected divergence (or an expected
+    divergence that vanished — the opt-out no longer removes anything) is a
+    finding."""
+    from .lowering import diff_report
+
+    findings = []
+    for label, text_a, text_b, expect_identical in pairs:
+        report = diff_report(text_a, text_b, "baseline", label)
+        if expect_identical and report is not None:
+            first = "\n".join(report.splitlines()[:6])
+            findings.append(Finding(
+                rule="S005", path=path_prefix + label, line=0, col=0,
+                message=(
+                    f"'{label}' must be lowering-identical to its baseline "
+                    f"but diverges:\n{first}"
+                ),
+                snippet=f"divergent {label}",
+                fixit="gate the feature behind a trace-time static branch "
+                      "so the off-form compiles the exact baseline program "
+                      "(the telemetry/quarantine_rounds pattern, "
+                      "trainer/steps.py)",
+            ))
+        if not expect_identical and report is None:
+            findings.append(Finding(
+                rule="S005", path=path_prefix + label, line=0, col=0,
+                message=(
+                    f"'{label}' was expected to DIVERGE from its baseline "
+                    f"but the programs are identical — the static opt-out "
+                    f"no longer changes the compiled program (dead flag, "
+                    f"or the machinery is no longer compiled out)"
+                ),
+                snippet=f"non-divergent {label}",
+                fixit="check the trace-time gate (telemetry= / "
+                      "quarantine_rounds) still switches the program form",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# the trace matrix
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceCell:
+    """One (engine, topology, pipeline) corner of the verification matrix."""
+
+    engine: str
+    topology: str  # "vmap" (folded sites) | "mesh" (1/device) | "fold" (k>1)
+    pipeline: str  # "host" | "device"
+    precision_bits: str = "32"
+    donate: bool = False
+    dense_model: bool = False  # non-compressible fallback workload
+    engine_kw: tuple = ()  # sorted (key, value) engine kwargs
+
+    @property
+    def label(self) -> str:
+        name = self.engine
+        if self.dense_model:
+            name += "-dense"
+        if self.precision_bits != "32":
+            name += f"@{self.precision_bits}"
+        if self.donate:
+            name += "+donate"
+        return f"{name}/{self.topology}/{self.pipeline}"
+
+
+@dataclasses.dataclass
+class CellProgram:
+    """A traced matrix cell plus everything the rules consume."""
+
+    cell: TraceCell
+    engine: object
+    state: object
+    args: tuple
+    block: int  # k sites folded per device (vmap: all of them)
+    audit: ProgramAudit
+    compiled: object  # only for donate cells
+    path: str
+
+
+def build_cell_inputs(cell: TraceCell, engine=None) -> tuple:
+    """``(task, engine, opt, state, args, mesh)`` for one matrix cell — the
+    ONE place the tiny CPU corner (model dims, shapes, RNG seeds) is
+    defined. :func:`trace_cell`, the S005 identity gate and the tier-1
+    identity harness (tests/test_lowering_identity.py) all build from here,
+    so a change to the epoch signature or the corner's shapes is made once.
+    ``engine`` overrides the registry engine — the hook test fixtures use it
+    to trace deliberately-broken engines."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..engines import make_engine
+    from ..models import MSANNet
+    from ..parallel.mesh import host_mesh
+    from ..trainer.steps import (
+        FederatedTask,
+        init_train_state,
+        make_optimizer,
+    )
+
+    S = 4 if cell.topology == "fold" else 2
+    steps, B, N = 2, 4, 8
+    if cell.dense_model:
+        # every leaf non-compressible ([1, 2] kernel + bias): the low-rank
+        # engines' dense fallback path carries the whole wire
+        model = MSANNet(in_size=1, hidden_sizes=(), out_size=2)
+    else:
+        model = MSANNet(in_size=6, hidden_sizes=(8,), out_size=2)
+    D = model.in_size
+    task = FederatedTask(model)
+    if engine is None:
+        engine = make_engine(
+            cell.engine, precision_bits=cell.precision_bits,
+            **dict(cell.engine_kw),
+        )
+    opt = make_optimizer("adam", 1e-2)
+    mesh = host_mesh(2) if cell.topology in ("mesh", "fold") else None
+    state = init_train_state(
+        task, engine, opt, jax.random.PRNGKey(0),
+        jnp.ones((B, D), jnp.float32), num_sites=S,
+    )
+    rng = np.random.default_rng(0)
+    if cell.pipeline == "device":
+        args = (
+            state,
+            jnp.asarray(rng.normal(size=(S, N, D)).astype(np.float32)),
+            jnp.zeros((S, N), jnp.int32),
+            jnp.zeros((S, steps, B), jnp.int32),
+        )
+    else:
+        args = (
+            state,
+            jnp.asarray(rng.normal(size=(S, steps, B, D)).astype(np.float32)),
+            jnp.zeros((S, steps, B), jnp.int32),
+            jnp.ones((S, steps, B), jnp.float32),
+        )
+    return task, engine, opt, state, args, mesh
+
+
+def trace_cell(cell: TraceCell, engine=None) -> CellProgram:
+    """Build and trace one matrix cell's REAL epoch program (tiny shapes,
+    CPU)."""
+    from ..parallel.mesh import SITE_AXIS
+    from ..trainer.steps import epoch_program_artifacts, make_train_epoch_fn
+
+    task, engine, opt, state, args, mesh = build_cell_inputs(cell, engine)
+    fn = make_train_epoch_fn(
+        task, engine, opt, mesh=mesh, pipeline=cell.pipeline,
+        donate_state=cell.donate,
+    )
+    closed, _, comp = epoch_program_artifacts(fn, *args, compiled=cell.donate)
+    S = args[1].shape[0]
+    block = S if mesh is None else S // dict(mesh.shape)[SITE_AXIS]
+    return CellProgram(
+        cell=cell, engine=engine, state=state, args=args, block=block,
+        audit=audit_jaxpr(closed), compiled=comp,
+        path=f"trace://{cell.label}",
+    )
+
+
+#: engine corners: the three registry engines plus the low-rank engines'
+#: non-compressible fallback (the "fourth engine" — same registry entry,
+#: dense-only workload, entirely different wire)
+_ENGINE_CORNERS = (
+    ("dSGD", (), False),
+    ("rankDAD", (("dad_num_pow_iters", 2), ("dad_reduction_rank", 2)), False),
+    ("powerSGD", (("dad_reduction_rank", 2),), False),
+    ("rankDAD", (("dad_reduction_rank", 4),), True),
+)
+
+
+def default_matrix() -> list:
+    """The full engine × topology × pipeline matrix plus the precision-flow
+    and donation-audit corners."""
+    cells = [
+        TraceCell(name, topo, pipe, engine_kw=kw, dense_model=dense)
+        for name, kw, dense in _ENGINE_CORNERS
+        for topo in ("vmap", "mesh", "fold")
+        for pipe in ("host", "device")
+    ]
+    # bf16 wire: S002's byte proof must survive quantization and S004 must
+    # see the low-precision dots
+    cells += [
+        TraceCell(name, "mesh", "host", precision_bits="16", engine_kw=kw)
+        for name, kw, dense in _ENGINE_CORNERS
+        if not dense
+    ]
+    # donation proof: compiled executables for the trainer's real default
+    # (device pipeline + donated state) on both topologies
+    cells += [
+        TraceCell("dSGD", "vmap", "device", donate=True),
+        TraceCell(
+            "powerSGD", "mesh", "device", donate=True,
+            engine_kw=(("dad_reduction_rank", 2),),
+        ),
+    ]
+    return cells
+
+
+#: the S005 identity pairs, declaratively: label -> (epoch-build kwargs,
+#: expect_identical). Off-forms (True) must compile the exact baseline
+#: program; opt-outs/opt-ins (False) must genuinely change it — if those
+#: stop diverging, "compiled out" has silently stopped being true. ``None``
+#: kwargs means the DEFAULT build traced under ``jax.checking_leaks`` (the
+#: sanitizer's observation mode, which must not perturb what it observes).
+#: tests/test_lowering_identity.py is the tier-1 mirror of exactly this
+#: table — extend it here and both the CLI gate and the tests pick it up.
+IDENTITY_CASES = {
+    "telemetry-off": (dict(telemetry=False), True),
+    "faults-default": (dict(quarantine_rounds=3), True),
+    "sanitize-leaks": (None, True),
+    "faults-opt-out": (dict(quarantine_rounds=-1), False),
+    "telemetry-on": (dict(telemetry=True), False),
+}
+
+
+def _identity_gate() -> list:
+    """The S005 program-identity pairs (:data:`IDENTITY_CASES`) on the
+    flagship corner (dSGD/vmap/host)."""
+    import jax
+
+    from ..trainer.steps import make_train_epoch_fn
+
+    task, engine, opt, _, args, mesh = build_cell_inputs(
+        TraceCell("dSGD", "vmap", "host")
+    )
+
+    def text(**kw):
+        fn = make_train_epoch_fn(task, engine, opt, mesh=mesh, **kw)
+        return fn.lower(*args).as_text()
+
+    base = text()
+    pairs = []
+    for label, (kw, expect_identical) in IDENTITY_CASES.items():
+        if kw is None:
+            with jax.checking_leaks():
+                variant = text()
+        else:
+            variant = text(**kw)
+        pairs.append((label, base, variant, expect_identical))
+    return check_lowering_identity(pairs)
+
+
+def run_semantic_checks(cells=None) -> list:
+    """Trace the matrix and run every S-rule; returns findings sorted like
+    the AST tier's. The CLI gates on this list (after the semantic
+    baseline); tests assert it is empty."""
+    ensure_cpu_devices()
+    findings: list = []
+    for cell in (default_matrix() if cells is None else cells):
+        prog = trace_cell(cell)
+        findings += check_collective_axes(prog.audit.collectives, prog.path)
+        if cell.topology in ("mesh", "fold"):
+            # the vmap topology folds all sites onto one device — its
+            # "collectives" are local reductions with no wire, so the
+            # byte/precision proofs run where communication is real
+            import jax
+
+            stats_shapes = tuple(
+                tuple(leaf.shape)
+                for leaf in jax.tree_util.tree_leaves(prog.state.batch_stats)
+            )
+            findings += check_wire_bytes(
+                prog.audit.collectives, prog.engine, prog.state.params,
+                prog.block, prog.path, stats_shapes=stats_shapes,
+            )
+            findings += check_precision_flow(
+                prog.audit.collectives, prog.engine, prog.state.params,
+                prog.block, prog.path,
+                require_lowp_dot=(
+                    cell.precision_bits == "16"
+                    and cell.engine in ("rankDAD", "powerSGD")
+                    and not cell.dense_model
+                ),
+                dots=prog.audit.dots,
+            )
+        if cell.donate:
+            findings += check_donation(
+                prog.compiled, prog.args, (0,), prog.path
+            )
+    findings += _identity_gate()
+    findings.sort(key=lambda f: (f.path, f.rule, f.snippet))
+    return findings

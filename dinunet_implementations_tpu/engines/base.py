@@ -27,12 +27,23 @@ payload (what one site actually ships: full gradients for dSGD, rank-r
 factors for the compression engines). Pure shape arithmetic evaluated once at
 trace time — never a traced value; ``None`` falls back to the dense-f32
 estimate.
+
+Wire introspection (checks/semantic.py, rule S002): ``wire_shapes`` is the
+STRUCTURED form of the same model — ``grads_template -> [(shape, dtype),
+...]``, one entry per collective payload operand the engine's ``aggregate``
+emits per round per site (dSGD: every leaf at the payload dtype; rankDAD:
+one packed factor block per rank class plus dense 1-D leaves; powerSGD: two
+factor psums per compressible leaf). ``wire_dtype`` names the payload dtype
+the engine quantizes its wire to. The semantic analyzer cross-checks these
+against the TRACED program's collective operands, so a ``wire_bytes`` figure
+the telemetry layer reports is verified, not merely modeled; the shape sum
+must equal ``wire_bytes`` exactly.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Callable
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
@@ -63,6 +74,13 @@ class Engine:
     # static per-round per-site collective payload model (module docstring);
     # None -> telemetry's dense-f32 fallback
     wire_bytes: Callable | None = None
+    # structured payload model: grads -> [(shape, dtype), ...] per collective
+    # operand (module docstring); None -> dense-f32 fallback. Verified against
+    # the traced program by checks/semantic.py rule S002.
+    wire_shapes: Callable | None = None
+    # the payload dtype this engine quantizes its wire to (numpy dtype);
+    # audited by checks/semantic.py rule S004 on the traced aggregation path
+    wire_dtype: Any = None
 
 
 def dense_wire_bytes(grads, itemsize: int = 4) -> int:
@@ -73,6 +91,15 @@ def dense_wire_bytes(grads, itemsize: int = 4) -> int:
     return sum(
         math.prod(g.shape) * itemsize for g in jax.tree.leaves(grads)
     )
+
+
+def dense_wire_shapes(grads, dtype=None) -> list:
+    """Structured payload model for a dense exchange: one collective operand
+    per leaf, shipped whole at ``dtype`` (default f32)."""
+    import numpy as np
+
+    d = np.dtype(np.float32 if dtype is None else dtype)
+    return [(tuple(g.shape), d) for g in jax.tree.leaves(grads)]
 
 
 _REGISTRY: dict[str, Callable] = {}

@@ -18,12 +18,19 @@ from ..parallel.collectives import (
     payload_uncast,
     site_weighted_mean,
 )
-from .base import Engine, dense_wire_bytes, mask_dead_site, register_engine
+from .base import (
+    Engine,
+    dense_wire_bytes,
+    dense_wire_shapes,
+    mask_dead_site,
+    register_engine,
+)
 
 
 @register_engine("dSGD")
 def make_dsgd(precision_bits="32", **_unused) -> Engine:
-    itemsize = np.dtype(payload_dtype(precision_bits)).itemsize
+    pdtype = np.dtype(payload_dtype(precision_bits))
+    itemsize = pdtype.itemsize
 
     def init(grads):
         return {}
@@ -31,6 +38,11 @@ def make_dsgd(precision_bits="32", **_unused) -> Engine:
     def wire_bytes(grads) -> int:
         # dSGD ships every gradient leaf whole, cast to the payload dtype
         return dense_wire_bytes(grads, itemsize)
+
+    def wire_shapes(grads):
+        # one psum per leaf; the operand is quantized to the payload dtype
+        # before the f32-accumulating collective (parallel/collectives.py)
+        return dense_wire_shapes(grads, pdtype)
 
     def aggregate(grads, state, weight, axis_name, live=None):
         # dead/quarantined sites: payload zeroed, weight zeroed — the
@@ -40,4 +52,5 @@ def make_dsgd(precision_bits="32", **_unused) -> Engine:
         agg = site_weighted_mean(payload, weight, axis_name)
         return payload_uncast(agg, grads), state
 
-    return Engine("dSGD", init, aggregate, wire_bytes=wire_bytes)
+    return Engine("dSGD", init, aggregate, wire_bytes=wire_bytes,
+                  wire_shapes=wire_shapes, wire_dtype=pdtype)

@@ -22,6 +22,25 @@ def is_compressible(g, min_rank_dim: int = 2) -> bool:
     return g.ndim >= 2 and min(_matrix_shape(g)) >= min_rank_dim
 
 
+def lowrank_rank_groups(grads, rank: int) -> tuple:
+    """``(groups, dense)`` — the engine-order wire structure of a low-rank
+    factor exchange: ``groups`` is ``[(effective_rank, [(m, n), ...]), ...]``
+    sorted by rank class (the exact grouping/order the rankDAD aggregate
+    packs its gathers in), ``dense`` the 1-D/non-compressible leaf shapes
+    that ride the dense psum path. The structured half of
+    :func:`lowrank_wire_bytes`, used by the engines' ``wire_shapes``
+    introspection hooks (checks/semantic.py S002)."""
+    groups: dict[int, list] = {}
+    dense = []
+    for g in jax.tree.leaves(grads):
+        if is_compressible(g):
+            m, n = _matrix_shape(g)
+            groups.setdefault(min(rank, m, n), []).append((m, n))
+        else:
+            dense.append(tuple(g.shape))
+    return sorted(groups.items()), dense
+
+
 def lowrank_wire_bytes(grads, rank: int, itemsize: int) -> int:
     """Modeled per-round per-site collective payload of a low-rank factor
     exchange (the shared ``Engine.wire_bytes`` body for rankDAD and
@@ -249,6 +268,11 @@ def subspace_iteration_grouped(groups, num_iters: int, tol: float,
     updating once its own relative σ-estimate change drops below ``tol``.
     """
     mm = lp_matmul
+    if not groups:
+        # a fully non-compressible gradient tree (all 1-D/vector leaves):
+        # nothing to factorize — the engines' dense fallback carries the
+        # whole exchange. The while_loop below cannot carry an empty tuple.
+        return []
     prepped = []  # (Gs_f32, omegas_f32) per group, ranks clamped
     for Gs, rank, omegas in groups:
         Gs = [G.astype(jnp.float32) for G in Gs]

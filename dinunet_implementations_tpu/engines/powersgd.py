@@ -26,6 +26,7 @@ from .base import Engine, mask_dead_site, register_engine
 from .lowrank import (
     from_matrix,
     is_compressible,
+    lowrank_rank_groups,
     lowrank_wire_bytes,
     lp_matmul,
     orthonormalize,
@@ -74,6 +75,21 @@ def make_powersgd(
         return lowrank_wire_bytes(
             grads, dad_reduction_rank, np.dtype(pdtype).itemsize
         )
+
+    def wire_shapes(grads):
+        # per compressible leaf TWO psum'd factors — P [m, r] then Q' [n, r],
+        # wire-compressed to the payload dtype — plus a dense f32 psum per
+        # 1-D leaf. Must sum to wire_bytes (verified by S002).
+        import numpy as np
+
+        groups, dense = lowrank_rank_groups(grads, dad_reduction_rank)
+        pd = np.dtype(pdtype)
+        shapes = []
+        for r, mns in groups:
+            for m, n in mns:
+                shapes.append(((m, r), pd))
+                shapes.append(((n, r), pd))
+        return shapes + [(s, np.dtype(np.float32)) for s in dense]
 
     def aggregate(grads, state, weight, axis_name, live=None):
         # Dead-site round: G zeroed (NaN-safe where) and weight zeroed, so
@@ -124,4 +140,7 @@ def make_powersgd(
         }
         return agg, new_state
 
-    return Engine("powerSGD", init, aggregate, wire_bytes=wire_bytes)
+    import numpy as np
+
+    return Engine("powerSGD", init, aggregate, wire_bytes=wire_bytes,
+                  wire_shapes=wire_shapes, wire_dtype=np.dtype(pdtype))

@@ -50,6 +50,7 @@ from .lowrank import (
     default_omega,
     from_matrix,
     is_compressible,
+    lowrank_rank_groups,
     lowrank_wire_bytes,
     subspace_iteration_grouped,
     to_matrix,
@@ -97,6 +98,20 @@ def make_rankdad(
         return lowrank_wire_bytes(
             grads, dad_reduction_rank, np.dtype(pdtype).itemsize
         )
+
+    def wire_shapes(grads):
+        # what `aggregate` actually launches per round per site: ONE packed
+        # all_gather per rank class — P_i/Q_i factors concatenated on axis 0,
+        # [Σ(m_i+n_i), r] at the payload dtype — plus a dense f32 psum per
+        # 1-D leaf. Must sum to wire_bytes (verified by S002).
+        import numpy as np
+
+        groups, dense = lowrank_rank_groups(grads, dad_reduction_rank)
+        shapes = [
+            ((sum(m + n for m, n in mns), r), np.dtype(pdtype))
+            for r, mns in groups
+        ]
+        return shapes + [(s, np.dtype(np.float32)) for s in dense]
 
     def aggregate(grads, state, weight, axis_name, live=None):
         # Dead-site round: G zeroed (NaN-safe where) + weight zeroed — the
@@ -166,4 +181,7 @@ def make_rankdad(
         )
         return jax.tree.unflatten(treedef, out), new_state
 
-    return Engine("rankDAD", init, aggregate, wire_bytes=wire_bytes)
+    import numpy as np
+
+    return Engine("rankDAD", init, aggregate, wire_bytes=wire_bytes,
+                  wire_shapes=wire_shapes, wire_dtype=np.dtype(pdtype))

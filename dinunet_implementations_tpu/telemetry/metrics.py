@@ -83,7 +83,9 @@ def payload_bytes_of(engine, grads_template) -> float:
     Uses the engine's own ``wire_bytes`` model (engines/base.py) when it has
     one; otherwise the dense-f32 fallback (every leaf shipped whole). A
     static Python float — computed once at trace time from the gradient
-    pytree's shapes, never a traced value."""
+    pytree's shapes, never a traced value. Since r11 this figure is VERIFIED,
+    not just modeled: checks/semantic.py rule S002 cross-checks it against
+    the traced epoch program's actual collective operand shapes/dtypes."""
     wb = getattr(engine, "wire_bytes", None)
     if wb is not None:
         return float(wb(grads_template))
@@ -92,6 +94,25 @@ def payload_bytes_of(engine, grads_template) -> float:
     return float(sum(
         math.prod(leaf.shape) * 4 for leaf in jax.tree.leaves(grads_template)
     ))
+
+
+def modeled_wire_shapes(engine, grads_template) -> list:
+    """The structured payload model behind :func:`payload_bytes_of`:
+    ``[(shape, numpy dtype), ...]`` — one entry per collective payload
+    operand the engine ships per round per site (``Engine.wire_shapes``,
+    engines/base.py), falling back to one dense-f32 operand per leaf for
+    engines without the hook. checks/semantic.py matches every entry against
+    a traced collective operand and requires the byte sum to equal
+    ``wire_bytes`` exactly."""
+    ws = getattr(engine, "wire_shapes", None)
+    if ws is not None:
+        return [(tuple(s), np.dtype(d)) for s, d in ws(grads_template)]
+    import jax
+
+    return [
+        (tuple(leaf.shape), np.dtype(np.float32))
+        for leaf in jax.tree.leaves(grads_template)
+    ]
 
 
 def telemetry_summary(telemetry) -> dict | None:

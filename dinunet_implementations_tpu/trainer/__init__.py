@@ -10,6 +10,7 @@ from .steps import (
     FederatedTask,
     TrainState,
     compile_epoch_aot,
+    epoch_program_artifacts,
     init_train_state,
     make_eval_fn,
     make_optimizer,

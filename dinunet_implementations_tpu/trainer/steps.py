@@ -767,6 +767,31 @@ def make_train_epoch_fn(
     return epoch_fn
 
 
+def epoch_program_artifacts(epoch_fn, *args, lowered: bool = False,
+                            compiled: bool = False):
+    """The traced/lowered/compiled forms of one epoch program, for semantic
+    auditing (checks/semantic.py): ``(ClosedJaxpr, Lowered | None,
+    Compiled | None)``.
+
+    The jaxpr is what rules S001/S002/S004 walk (collective axes, payload
+    operand shapes/dtypes, precision flow); the lowering feeds the
+    program-identity differ (checks/lowering.py, S005); the compiled
+    executable exposes the input-output aliasing S003 proves donation
+    against. Tracing only — no execution; safe on CPU for any topology the
+    epoch builder supports."""
+    trace = getattr(epoch_fn, "trace", None)
+    if trace is not None and (lowered or compiled):
+        # one trace serves both artifacts (the AOT Traced stage lowers from
+        # the jaxpr it already holds); older jax lacks .trace and pays two
+        traced = trace(*args)
+        closed, low = traced.jaxpr, traced.lower()
+    else:
+        closed = jax.make_jaxpr(epoch_fn)(*args)
+        low = epoch_fn.lower(*args) if (lowered or compiled) else None
+    comp = low.compile() if compiled else None
+    return closed, low, comp
+
+
 def compile_epoch_aot(epoch_fn, state: TrainState, x, y, w, live=None):
     """AOT-compile an epoch function letting XLA choose the INPUT layout for
     the (large, resident) epoch inputs.

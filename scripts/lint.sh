@@ -1,8 +1,12 @@
 #!/usr/bin/env bash
 # Lint gate: ruff (hard-error style/correctness families, [tool.ruff] in
 # pyproject.toml) + jaxlint (the codebase-specific SPMD-invariant analyzer,
-# dinunet_implementations_tpu/checks — rules R001-R006, empty baseline).
-# Run from anywhere; CI (.github/workflows/ci.yml) runs exactly this script.
+# dinunet_implementations_tpu/checks — AST rules R001-R007, empty baseline)
+# + jaxprlint (the semantic tier, rules S001-S005: traces the real epoch
+# programs on CPU and verifies collectives/wire bytes/donation/precision/
+# program identity). Run from anywhere; CI (.github/workflows/ci.yml) runs
+# exactly this script (the dedicated `semantic` CI job sets
+# JAXPRLINT_SEMANTIC=0 here and runs the tier itself, with artifact upload).
 set -uo pipefail
 
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
@@ -20,5 +24,10 @@ fi
 
 echo "== jaxlint =="
 JAX_PLATFORMS=cpu python -m dinunet_implementations_tpu.checks || rc=1
+
+if [ "${JAXPRLINT_SEMANTIC:-1}" != "0" ]; then
+  echo "== jaxprlint (semantic) =="
+  JAX_PLATFORMS=cpu python -m dinunet_implementations_tpu.checks --semantic || rc=1
+fi
 
 exit $rc

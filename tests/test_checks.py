@@ -140,6 +140,24 @@ def test_r003_literal_axis_names(tmp_path):
     assert all(f.path == "engines/bad.py" for f in fs)
 
 
+def test_r003_covers_scatter_broadcast_and_shard_map_kwargs(tmp_path):
+    """The collective table the semantic tier shares: psum_scatter /
+    pbroadcast positionals and shard_map/vmap-style axis_names= /
+    spmd_axis_name= keywords all count as collectives."""
+    fs = _scan(tmp_path, {
+        "engines/bad.py": """
+            import jax
+            def agg(g, f):
+                a = jax.lax.psum_scatter(g, "site")
+                b = jax.lax.pbroadcast(g, "model", 0)
+                m = jax.shard_map(f, axis_names=("site",))
+                v = jax.vmap(f, spmd_axis_name="site")
+                return a, b, m, v
+        """,
+    })
+    assert _rules(fs) == ["R003"] * 4
+
+
 def test_r004_cfg_mutation(tmp_path):
     fs = _scan(tmp_path, {
         "trainer/bad.py": """

@@ -205,9 +205,12 @@ def test_scheduled_dropout_renormalizes_every_engine(engine):
 
 def test_quarantine_minus_one_compiles_machinery_out():
     """quarantine_rounds=-1 with no FaultPlan is the static escape hatch: the
-    epoch program carries no fault machinery and trains identically (values
-    match the default program bit-for-bit when every site is healthy)."""
+    epoch program REALLY carries no fault machinery (the lowered programs
+    structurally diverge — checked through the shared normalized differ,
+    checks/lowering.py) and trains identically (values match the default
+    program bit-for-bit when every site is healthy)."""
     import jax.numpy as jnp
+    from dinunet_implementations_tpu.checks.lowering import diff_report
     from dinunet_implementations_tpu.engines import make_engine
     from dinunet_implementations_tpu.trainer import (
         FederatedTask, init_train_state, make_optimizer, make_train_epoch_fn,
@@ -222,12 +225,16 @@ def test_quarantine_minus_one_compiles_machinery_out():
     x = jnp.asarray(rng.normal(size=(2, 3, 4, 6)).astype(np.float32))
     y = jnp.asarray((rng.random((2, 3, 4)) > 0.5).astype(np.int32))
     w = jnp.ones((2, 3, 4), jnp.float32)
-    outs = {}
+    outs, texts = {}, {}
     for qr in (3, -1):
         fn = make_train_epoch_fn(task, engine, opt, mesh=None,
                                  quarantine_rounds=qr)
+        texts[qr] = fn.lower(state0, x, y, w).as_text()
         st, losses = fn(state0, x, y, w)
         outs[qr] = (st, losses)
+    # structurally different programs (machinery genuinely compiled out)...
+    assert diff_report(texts[3], texts[-1], "qr=3", "qr=-1") is not None
+    # ...computing identical values on a healthy run:
     jax.tree.map(
         lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
         outs[3][0].params, outs[-1][0].params,

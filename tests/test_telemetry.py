@@ -265,7 +265,12 @@ def test_telemetry_off_program_identical_and_outputs_bitwise():
     """telemetry="off" (the default) must compile the exact pre-telemetry
     program: identical lowering to a build that never mentions telemetry,
     state.telemetry stays None, and the on-arm trains bitwise-identically
-    (the metrics observe, never perturb)."""
+    (the metrics observe, never perturb). Program identity goes through the
+    shared normalized differ (checks/lowering.py) — the parametrized
+    off==baseline harness in tests/test_lowering_identity.py and the S005
+    semantic gate run the same comparison."""
+    from dinunet_implementations_tpu.checks.lowering import diff_report
+
     task, engine, opt, _, x, y, w = _epoch_setup("dSGD", steps=3,
                                                  telemetry=False)
     state0 = init_train_state(task, engine, opt, jax.random.PRNGKey(0),
@@ -273,10 +278,12 @@ def test_telemetry_off_program_identical_and_outputs_bitwise():
     fn_off = make_train_epoch_fn(task, engine, opt, mesh=None,
                                  telemetry=False)
     fn_default = make_train_epoch_fn(task, engine, opt, mesh=None)
-    assert (
-        fn_off.lower(state0, x, y, w).as_text()
-        == fn_default.lower(state0, x, y, w).as_text()
+    report = diff_report(
+        fn_off.lower(state0, x, y, w).as_text(),
+        fn_default.lower(state0, x, y, w).as_text(),
+        "telemetry=False", "default-build",
     )
+    assert report is None, report
     st_off, losses_off = fn_off(state0, x, y, w)
     assert st_off.telemetry is None
     state_t = init_train_state(task, engine, opt, jax.random.PRNGKey(0),
