@@ -211,6 +211,50 @@ def flops_per_sample() -> float:
     return flops_per_sample_dims(WINDOWS, ENC_IN, ENC_OUT, HIDDEN)
 
 
+def _flagship_arm(engine_name: str = "dSGD", engine_kw: dict | None = None,
+                  dims: dict | None = None, fused_bidir: bool | None = None):
+    """Shared flagship-arm construction for every bench mode: the dims dict
+    (flagship HCP defaults overridden by ``--small``), the ICA-LSTM
+    model/task/engine/optimizer, and the synthetic per-site epoch data as
+    NUMPY arrays (one RNG draw sequence — arms agree bit-for-bit on their
+    inputs). A dims/model/dtype policy change lands here ONCE and every
+    arm — steady-state, pipeline A/B, packed sites sweep — measures the
+    same configuration.
+
+    bf16 matmuls AND streamed activations with f32 carries/accumulation;
+    the fused Pallas kernel keeps W_ih/W_hh resident in VMEM and streams
+    the raw x once per step (ops/lstm_pallas.py). ``fused_bidir=False`` is
+    the A/B arm: two single-direction kernel sweeps instead of the fused
+    bidirectional pooled kernel (VERDICT r4 #1b)."""
+    import numpy as np
+
+    from dinunet_implementations_tpu.engines import make_engine
+    from dinunet_implementations_tpu.models import ICALstm
+    from dinunet_implementations_tpu.trainer import (
+        FederatedTask,
+        make_optimizer,
+    )
+
+    d = dict(sites=NUM_SITES, steps=STEPS_PER_EPOCH, batch=BATCH_PER_SITE,
+             windows=WINDOWS, comps=COMPS, wlen=WLEN, enc_out=ENC_OUT,
+             hidden=HIDDEN, compute_dtype="bfloat16")
+    d.update(dims or {})
+    model = ICALstm(input_size=d["enc_out"], hidden_size=d["hidden"],
+                    num_comps=d["comps"], window_size=d["wlen"], num_cls=2,
+                    compute_dtype=d["compute_dtype"], fused_bidir=fused_bidir)
+    task = FederatedTask(model)
+    engine = make_engine(engine_name, **(engine_kw or {}))
+    opt = make_optimizer("adam", 1e-3)
+    S, steps, B = d["sites"], d["steps"], d["batch"]
+    rng = np.random.default_rng(0)
+    np_x = rng.normal(
+        size=(S, steps, B, d["windows"], d["comps"], d["wlen"])
+    ).astype(np.float32)
+    np_y = (rng.random((S, steps, B)) > 0.5).astype(np.int32)
+    np_w = np.ones((S, steps, B), np.float32)
+    return d, task, engine, opt, np_x, np_y, np_w
+
+
 def _setup_epoch(engine_name: str = "dSGD", engine_kw: dict | None = None,
                  fused_bidir: bool | None = None, dims: dict | None = None,
                  fault_plan=None):
@@ -226,46 +270,25 @@ def _setup_epoch(engine_name: str = "dSGD", engine_kw: dict | None = None,
     import jax.numpy as jnp
     import numpy as np
 
-    from dinunet_implementations_tpu.engines import make_engine
-    from dinunet_implementations_tpu.models import ICALstm
     from dinunet_implementations_tpu.trainer import (
-        FederatedTask,
         compile_epoch_aot,
         init_train_state,
-        make_optimizer,
         make_train_epoch_fn,
     )
 
-    d = dict(sites=NUM_SITES, steps=STEPS_PER_EPOCH, batch=BATCH_PER_SITE,
-             windows=WINDOWS, comps=COMPS, wlen=WLEN, enc_out=ENC_OUT,
-             hidden=HIDDEN, compute_dtype="bfloat16")
-    d.update(dims or {})
-
-    # bf16 matmuls AND streamed activations with f32 carries/accumulation;
-    # the fused Pallas kernel keeps W_ih/W_hh resident in VMEM and streams
-    # the raw x once per step (ops/lstm_pallas.py). fused_bidir=False is the
-    # A/B arm: two single-direction kernel sweeps instead of the fused
-    # bidirectional pooled kernel (VERDICT r4 #1b).
-    model = ICALstm(input_size=d["enc_out"], hidden_size=d["hidden"],
-                    num_comps=d["comps"], window_size=d["wlen"], num_cls=2,
-                    compute_dtype=d["compute_dtype"], fused_bidir=fused_bidir)
-    task = FederatedTask(model)
-    engine = make_engine(engine_name, **(engine_kw or {}))
-    opt = make_optimizer("adam", 1e-3)
-
+    d, task, engine, opt, np_x, np_y, np_w = _flagship_arm(
+        engine_name, engine_kw, dims, fused_bidir
+    )
     S, steps, B = d["sites"], d["steps"], d["batch"]
-    rng = np.random.default_rng(0)
     # ship inputs pre-cast to the model's compute dtype (what the input
     # pipeline does for a bf16 model): halves the resident input footprint
     # and removes XLA's whole-input convert+layout copy from the epoch
     x = jnp.asarray(
-        rng.normal(
-            size=(S, steps, B, d["windows"], d["comps"], d["wlen"])
-        ).astype(np.float32),
+        np_x,
         dtype=jnp.bfloat16 if d["compute_dtype"] == "bfloat16" else None,
     )
-    y = jnp.asarray((rng.random((S, steps, B)) > 0.5).astype(np.int32))
-    w = jnp.ones((S, steps, B), jnp.float32)
+    y = jnp.asarray(np_y)
+    w = jnp.asarray(np_w)
 
     state0 = init_train_state(
         task, engine, opt, jax.random.PRNGKey(0), x[0, 0], num_sites=S
@@ -411,33 +434,14 @@ def _setup_pipeline_arm(arm: str, dims: dict | None = None,
     import jax.numpy as jnp
     import numpy as np
 
-    from dinunet_implementations_tpu.engines import make_engine
-    from dinunet_implementations_tpu.models import ICALstm
     from dinunet_implementations_tpu.telemetry import SpanTracer
     from dinunet_implementations_tpu.trainer import (
-        FederatedTask,
         init_train_state,
-        make_optimizer,
         make_train_epoch_fn,
     )
 
-    d = dict(sites=NUM_SITES, steps=STEPS_PER_EPOCH, batch=BATCH_PER_SITE,
-             windows=WINDOWS, comps=COMPS, wlen=WLEN, enc_out=ENC_OUT,
-             hidden=HIDDEN, compute_dtype="bfloat16")
-    d.update(dims or {})
-    model = ICALstm(input_size=d["enc_out"], hidden_size=d["hidden"],
-                    num_comps=d["comps"], window_size=d["wlen"], num_cls=2,
-                    compute_dtype=d["compute_dtype"])
-    task = FederatedTask(model)
-    engine = make_engine("dSGD")
-    opt = make_optimizer("adam", 1e-3)
+    d, task, engine, opt, np_x, np_y, np_w = _flagship_arm(dims=dims)
     S, steps, B = d["sites"], d["steps"], d["batch"]
-    rng = np.random.default_rng(0)
-    np_x = rng.normal(
-        size=(S, steps, B, d["windows"], d["comps"], d["wlen"])
-    ).astype(np.float32)
-    np_y = (rng.random((S, steps, B)) > 0.5).astype(np.int32)
-    np_w = np.ones((S, steps, B), np.float32)
     dt = jnp.bfloat16 if d["compute_dtype"] == "bfloat16" else jnp.float32
     state0 = init_train_state(
         task, engine, opt, jax.random.PRNGKey(0), jnp.asarray(np_x[0, 0]),
@@ -572,6 +576,158 @@ def measure_pipeline_ab(mode: str = "ab", obs: int = 5, n: int = TIMED_EPOCHS,
     return records
 
 
+def _ensure_host_devices(want: int) -> None:
+    """Provision ``want`` virtual CPU devices for the sites-scaling sweep —
+    BEFORE jax initializes (bench imports jax lazily inside the measure
+    functions, so calling this first in main() is early enough). Only the
+    host-platform device count is touched — never JAX_PLATFORMS — so an
+    accelerator host (pinned or auto-detected) keeps its hardware mesh and
+    the flag only takes effect where jax resolves to the CPU backend."""
+    import os
+
+    plat = os.environ.get("JAX_PLATFORMS", "")
+    if plat and plat != "cpu":
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={want}"
+        ).strip()
+
+
+def _setup_packed_epoch(S: int, K: int, engine_name: str = "dSGD",
+                        engine_kw: dict | None = None,
+                        dims: dict | None = None):
+    """One sites-scaling arm: S virtual sites packed K per device on a real
+    ``(site,)`` mesh — the full federated round as ONE compiled SPMD program
+    with two-level aggregation (trainer/steps.py packed path). Epoch inputs
+    and state are committed to their steady-state shardings up front, so the
+    chains measure the round, not placement, and the program compiles
+    exactly once (asserted under --sanitize).
+
+    Returns ``(run_chain, samples_per_epoch, info)``; ``info`` records the
+    mesh size and the per-device modeled wire bytes (the figure S002
+    verifies against the traced program)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dinunet_implementations_tpu.parallel.mesh import (
+        SITE_AXIS,
+        packed_site_mesh,
+    )
+    from dinunet_implementations_tpu.telemetry.metrics import payload_bytes_of
+    from dinunet_implementations_tpu.trainer import (
+        init_train_state,
+        make_train_epoch_fn,
+    )
+    from dinunet_implementations_tpu.trainer.steps import _state_specs
+
+    mesh = packed_site_mesh(S, K)
+    d, task, engine, opt, np_x, np_y, np_w = _flagship_arm(
+        engine_name, engine_kw, {**(dims or {}), "sites": S}
+    )
+    x = jnp.asarray(
+        np_x,
+        dtype=jnp.bfloat16 if d["compute_dtype"] == "bfloat16" else None,
+    )
+    y, w = jnp.asarray(np_y), jnp.asarray(np_w)
+    state0 = init_train_state(
+        task, engine, opt, jax.random.PRNGKey(0), x[0, 0], num_sites=S
+    )
+    info = {
+        "mesh_devices": int(mesh.devices.size),
+        "wire_bytes_per_device_round": int(
+            payload_bytes_of(engine, state0.params, pack=K)
+        ),
+    }
+    # commit everything to its steady-state sharding: inputs split P(site)
+    # into [K, ...] device blocks, state to the epoch's own specs (the
+    # trainer's _place_state move — avoids a warmup recompile)
+    site_sh = NamedSharding(mesh, P(SITE_AXIS))
+    x, y, w = (jax.device_put(a, site_sh) for a in (x, y, w))
+    state0 = jax.tree.map(
+        lambda a, spec: jax.device_put(a, NamedSharding(mesh, spec)),
+        state0, _state_specs(state0),
+    )
+    epoch_fn = make_train_epoch_fn(
+        task, engine, opt, mesh=mesh, local_iterations=1
+    )
+
+    from dinunet_implementations_tpu.checks.sanitize import (
+        CompileGuard,
+        sanitize_enabled,
+    )
+
+    guard = (
+        CompileGuard({"epoch_fn": epoch_fn}, label=f"sites{S}-pack{K}")
+        if sanitize_enabled() else None
+    )
+
+    def run_chain(k: int) -> float:
+        t = chain_epochs(epoch_fn, state0, x, y, w, k)
+        if guard is not None:
+            guard.check(context=f"sites={S}, pack={K}, chain={k} epochs")
+        return t
+
+    return run_chain, S * d["steps"] * d["batch"], info
+
+
+def measure_sites_scaling(sites_list, packs=None, obs: int = 3,
+                          n: int = TIMED_EPOCHS, dims: dict | None = None,
+                          engine_name: str = "dSGD",
+                          engine_kw: dict | None = None) -> list[dict]:
+    """The sites-scaling sweep (``--sites``): for each virtual site count S,
+    run the packed federated round on the available device mesh and emit one
+    JSON record with ``sites`` / ``sites_per_chip`` / ``pack_factor`` — the
+    proof that site count is no longer capped at device count. ``packs``
+    gives an explicit pack factor per S; default picks the smallest K that
+    divides S with an S/K-member site mesh fitting the device set (every
+    device used when device_count divides S; e.g. 12 sites on 8 devices
+    auto-pack K=2 onto a 6-member mesh)."""
+    import jax
+
+    def auto_pack(S: int, n_dev: int) -> int:
+        k = max(-(-S // n_dev), 1)  # ceil: the densest packing that fits
+        while S % k:  # walk up to the next divisor of S
+            k += 1
+        return k
+
+    records = []
+    n_dev = len(jax.devices())
+    for i, S in enumerate(sites_list):
+        K = packs[i] if packs is not None else auto_pack(S, n_dev)
+        run_chain, samples, info = _setup_packed_epoch(
+            S, K, engine_name=engine_name, engine_kw=engine_kw, dims=dims
+        )
+        run_chain(1)  # compile + warm up outside the timing
+        pairs = [
+            (run_chain(n // 2 + 1), run_chain(n + 1)) for _ in range(obs)
+        ]
+        dist = marginal_distribution(pairs, n)
+        rec = {
+            "metric": "samples/sec (ICA-LSTM federated round, packed "
+                      "sites-scaling sweep)",
+            "engine": engine_name,
+            "sites": S,
+            "pack_factor": K,
+            "sites_per_chip": K,
+            "mesh_devices": info["mesh_devices"],
+            "devices_available": n_dev,
+            "wire_bytes_per_device_round": info["wire_bytes_per_device_round"],
+            "backend": jax.default_backend(),
+            "chain_epochs": n,
+            "samples_per_sec": throughput_stats(dist, samples),
+            "unit": "samples/sec (whole mesh)",
+        }
+        if engine_kw:
+            rec["engine_kw"] = engine_kw
+        if dims:
+            rec["dims"] = {**dims, "sites": S}
+        records.append(rec)
+    return records
+
+
 def measure_cpu_baseline() -> float:
     """Live re-measurement of the torch reference (optional)."""
     import importlib.util
@@ -612,6 +768,40 @@ def main():
         import os
 
         os.environ["DINUNET_SANITIZE"] = "compile"
+    if "--sites" in sys.argv:
+        # sites-scaling sweep: S virtual sites packed K per device on a real
+        # site mesh (two-level aggregation, trainer/steps.py), one JSON line
+        # per S — e.g. `--sites 8,32,128,512 --small` proves 512 sites train
+        # on an 8-device virtual CPU mesh in one compiled program
+        # (docs/bench_sites_scaling_r12.jsonl; regen on TPU with the same
+        # command). `--pack auto` (default) packs every device; an explicit
+        # comma list pins K per S. `--devices N` sizes the virtual CPU mesh
+        # (ignored when a real accelerator platform is pinned).
+        want = (int(sys.argv[sys.argv.index("--devices") + 1])
+                if "--devices" in sys.argv else 8)
+        _ensure_host_devices(want)
+        sites_list = [
+            int(s) for s in sys.argv[sys.argv.index("--sites") + 1].split(",")
+        ]
+        packs = None
+        if "--pack" in sys.argv:
+            raw = sys.argv[sys.argv.index("--pack") + 1]
+            if raw != "auto":
+                packs = [int(p) for p in raw.split(",")]
+                if len(packs) == 1:
+                    packs = packs * len(sites_list)
+        obs = int(sys.argv[sys.argv.index("--obs") + 1]) if "--obs" in sys.argv else 3
+        n = (int(sys.argv[sys.argv.index("--epochs") + 1])
+             if "--epochs" in sys.argv else TIMED_EPOCHS)
+        dims = SMALL_DIMS if "--small" in sys.argv else None
+        engine_name = (sys.argv[sys.argv.index("--engine") + 1]
+                       if "--engine" in sys.argv else "dSGD")
+        for rec in measure_sites_scaling(
+            sites_list, packs=packs, obs=obs, n=n, dims=dims,
+            engine_name=engine_name,
+        ):
+            print(json.dumps(rec), flush=True)
+        return
     baseline = CPU_BASELINE_SAMPLES_PER_SEC
     if "--live-baseline" in sys.argv:
         try:

@@ -166,6 +166,36 @@ def main():
     if "--epochs" in sys.argv:
         epochs = int(sys.argv[sys.argv.index("--epochs") + 1])
 
+    if "--sites" in sys.argv:
+        # sites-scaling sweep at the flagship ICA dims (or --small): the
+        # packed-mesh arm from bench.py, so the matrix and the headline
+        # bench share one measurement path. JSON records sites /
+        # sites_per_chip / pack_factor per line.
+        from bench import SMALL_DIMS, _ensure_host_devices, measure_sites_scaling
+
+        # jax is imported above but its backend initializes lazily — setting
+        # the device-count flags here is still early enough
+        _ensure_host_devices(
+            int(sys.argv[sys.argv.index("--devices") + 1])
+            if "--devices" in sys.argv else 8
+        )
+        sites_list = [
+            int(s) for s in sys.argv[sys.argv.index("--sites") + 1].split(",")
+        ]
+        packs = None
+        if "--pack" in sys.argv:
+            raw = sys.argv[sys.argv.index("--pack") + 1]
+            if raw != "auto":
+                packs = [int(p) for p in raw.split(",")]
+                if len(packs) == 1:
+                    packs = packs * len(sites_list)
+        for rec in measure_sites_scaling(
+            sites_list, packs=packs, n=epochs,
+            dims=SMALL_DIMS if "--small" in sys.argv else None,
+        ):
+            print(json.dumps(rec), flush=True)
+        return
+
     dad = dict(dad_reduction_rank=10, dad_num_pow_iters=5, dad_tol=1e-3)
 
     # 1. FS MLP 2-site dSGD (compspec defaults: 66 → (256,128,64,32) → 2)

@@ -16,13 +16,17 @@ them semantically:
   axes appear as positional ints and are fine), and no cross-site
   communication may sit outside the rounds scan — at 512+ packed sites a
   per-round stray collective is a silent synchronization cliff.
-- **S002** — wire-byte proof: the per-round per-site collective payload,
+- **S002** — wire-byte proof: the per-round PER-DEVICE collective payload,
   computed from the TRACED operand shapes/dtypes, must match the engine's
-  static ``wire_bytes`` model exactly. Matching is structural: every entry
-  of the engine's ``wire_shapes`` introspection hook (engines/base.py) must
-  appear as a traced collective operand (site-block axis stripped), every
-  traced payload-sized operand must be covered by the model, and the byte
-  totals must agree. The telemetry layer's ``payload_bytes`` figures
+  static ``wire_bytes`` model exactly — at the cell's site-packing factor
+  (r12: packed cells verify that psum-shaped exchanges reduce over the
+  packed virtual-site axis in-register BEFORE the wire and stay
+  K-invariant, while the factor gather's ``[K, Σ(m+n), r]`` block is
+  modeled as genuinely K-scaling). Matching is structural: every entry of
+  the engine's ``wire_shapes`` introspection hook (engines/base.py) must
+  appear as a traced collective operand literally, every traced
+  payload-sized operand must be covered by the model, and the byte totals
+  must agree. The telemetry layer's ``payload_bytes`` figures
   (telemetry/metrics.py) become verified, not modeled.
 - **S003** — donation proof: for ``donate_epoch_state`` builds, the compiled
   executable's input-output aliasing must actually contain every donated
@@ -366,16 +370,19 @@ def check_collective_axes(
 # ---------------------------------------------------------------------------
 
 
-def _match_payload(collectives: list, expected: list, block: int):
+def _match_payload(collectives: list, expected: list):
     """Assign modeled payload entries to traced collective operands.
 
     ``expected`` is ``[(shape, np.dtype), ...]`` from the engine's wire
-    model; traced operands are matched by shape after stripping the leading
-    in-device site-block axis (size ``block`` — the k sites vmapped onto one
-    device). Returns ``(matches, missing, leftovers)`` where matches are
-    ``(shape, model_dtype, traced_itemsize, prim)``, missing are unmatched
-    model entries, and leftovers are traced COMM operands covered by
-    nothing (excluding the scalar bookkeeping collectives: loss and
+    model AT THE CELL'S PACK FACTOR; traced operands are matched by shape
+    literally — since the two-level aggregation (r12) the mesh collectives
+    carry exactly the per-device payloads the model describes (psum partials
+    unbatched, the factor gather with its leading ``[pack]`` virtual-site
+    axis), so there is no site-block normalization to undo. Returns
+    ``(matches, missing, leftovers)`` where matches are ``(shape,
+    model_dtype, traced_itemsize, prim)``, missing are unmatched model
+    entries, and leftovers are traced COMM operands covered by nothing
+    (excluding the scalar bookkeeping collectives: loss and
     weight-normalization psums)."""
     import numpy as np
 
@@ -386,11 +393,9 @@ def _match_payload(collectives: list, expected: list, block: int):
         for aval, wi in zip(site.operands, site.wire_itemsizes):
             if aval is None:
                 continue
-            shp = tuple(aval.shape)
-            stripped = shp[1:] if (shp and shp[0] == block) else shp
             isz = wi if wi is not None else np.dtype(aval.dtype).itemsize
             traced.append({
-                "shape": stripped, "itemsize": isz, "prim": site.prim,
+                "shape": tuple(aval.shape), "itemsize": isz, "prim": site.prim,
                 "matched": False,
             })
     matches, missing = [], []
@@ -419,18 +424,21 @@ def _match_payload(collectives: list, expected: list, block: int):
 
 
 def check_wire_bytes(
-    collectives: list, engine, params_template, block: int, path: str,
+    collectives: list, engine, params_template, pack: int, path: str,
     stats_shapes=(),
 ) -> list:
     """S002: traced collective payload bytes == ``Engine.wire_bytes``,
-    exactly, with structural coverage both ways."""
+    exactly, with structural coverage both ways — evaluated at the cell's
+    site-packing factor ``pack`` (the k virtual sites per device), so a
+    model that ignores packing (per-site instead of per-device accounting)
+    is flagged on the packed cells."""
     from ..telemetry.metrics import modeled_wire_shapes, payload_bytes_of
 
-    expected = modeled_wire_shapes(engine, params_template)
+    expected = modeled_wire_shapes(engine, params_template, pack=pack)
     model_total = sum(
         math.prod(s) * d.itemsize for s, d in expected
     )
-    wb = int(payload_bytes_of(engine, params_template))
+    wb = int(payload_bytes_of(engine, params_template, pack=pack))
     findings = []
     if model_total != wb:
         findings.append(Finding(
@@ -445,7 +453,7 @@ def check_wire_bytes(
                   "from the same shape arithmetic (engines/lowrank.py "
                   "lowrank_rank_groups)",
         ))
-    matches, missing, leftovers = _match_payload(collectives, expected, block)
+    matches, missing, leftovers = _match_payload(collectives, expected)
     for shape, dtype in missing:
         findings.append(Finding(
             rule="S002", path=path, line=0, col=0,
@@ -480,8 +488,8 @@ def check_wire_bytes(
             rule="S002", path=path, line=0, col=0,
             message=(
                 f"engine '{engine.name}': traced payload is {traced_total} "
-                f"B/round/site but wire_bytes models {wb} B — telemetry's "
-                f"payload_bytes figures are wrong"
+                f"B/round/device but wire_bytes models {wb} B at pack="
+                f"{pack} — telemetry's payload_bytes figures are wrong"
             ),
             snippet="bytes-mismatch",
             fixit="reconcile the traced operand dtypes with the modeled "
@@ -492,16 +500,17 @@ def check_wire_bytes(
 
 
 def check_precision_flow(
-    collectives: list, engine, params_template, block: int, path: str,
+    collectives: list, engine, params_template, pack: int, path: str,
     require_lowp_dot: bool = False, dots=(),
 ) -> list:
     """S004: no payload rides the wire wider than the engine's modeled
     payload dtype, and a 16-bit wire on a compression engine really lowers
-    low-precision dots for the power-iteration products."""
+    low-precision dots for the power-iteration products. ``pack`` selects
+    the wire model's site-packing factor like :func:`check_wire_bytes`."""
     from ..telemetry.metrics import modeled_wire_shapes
 
-    expected = modeled_wire_shapes(engine, params_template)
-    matches, _, _ = _match_payload(collectives, expected, block)
+    expected = modeled_wire_shapes(engine, params_template, pack=pack)
+    matches, _, _ = _match_payload(collectives, expected)
     findings = []
     for shape, dtype, traced_isz, prim in matches:
         if traced_isz is not None and traced_isz > dtype.itemsize:
@@ -640,7 +649,10 @@ class TraceCell:
     """One (engine, topology, pipeline) corner of the verification matrix."""
 
     engine: str
-    topology: str  # "vmap" (folded sites) | "mesh" (1/device) | "fold" (k>1)
+    # "vmap" (all sites on one device) | "mesh" (1 site/device) |
+    # "fold" (2 packed/device) | "fold4" (4 packed/device — the deeper
+    # site-packing corner, r12)
+    topology: str
     pipeline: str  # "host" | "device"
     precision_bits: str = "32"
     donate: bool = False
@@ -694,7 +706,7 @@ def build_cell_inputs(cell: TraceCell, engine=None) -> tuple:
         make_optimizer,
     )
 
-    S = 4 if cell.topology == "fold" else 2
+    S = {"fold": 4, "fold4": 8}.get(cell.topology, 2)
     steps, B, N = 2, 4, 8
     if cell.dense_model:
         # every leaf non-compressible ([1, 2] kernel + bias): the low-rank
@@ -710,7 +722,7 @@ def build_cell_inputs(cell: TraceCell, engine=None) -> tuple:
             **dict(cell.engine_kw),
         )
     opt = make_optimizer("adam", 1e-2)
-    mesh = host_mesh(2) if cell.topology in ("mesh", "fold") else None
+    mesh = host_mesh(2) if cell.topology in ("mesh", "fold", "fold4") else None
     state = init_train_state(
         task, engine, opt, jax.random.PRNGKey(0),
         jnp.ones((B, D), jnp.float32), num_sites=S,
@@ -781,6 +793,18 @@ def default_matrix() -> list:
         for name, kw, dense in _ENGINE_CORNERS
         if not dense
     ]
+    # deeper site packing (K=4/device, r12): the per-device wire proof at a
+    # pack factor where a per-site model would be 4x wrong — the K-scaling
+    # factor gather (rankDAD), the K-invariant psum wire (dSGD, device
+    # pipeline), and the quantized packed partial (bf16 dSGD)
+    cells += [
+        TraceCell(
+            "rankDAD", "fold4", "host",
+            engine_kw=(("dad_num_pow_iters", 2), ("dad_reduction_rank", 2)),
+        ),
+        TraceCell("dSGD", "fold4", "device"),
+        TraceCell("dSGD", "fold4", "host", precision_bits="16"),
+    ]
     # donation proof: compiled executables for the trainer's real default
     # (device pipeline + donated state) on both topologies
     cells += [
@@ -846,7 +870,7 @@ def run_semantic_checks(cells=None) -> list:
     for cell in (default_matrix() if cells is None else cells):
         prog = trace_cell(cell)
         findings += check_collective_axes(prog.audit.collectives, prog.path)
-        if cell.topology in ("mesh", "fold"):
+        if cell.topology in ("mesh", "fold", "fold4"):
             # the vmap topology folds all sites onto one device — its
             # "collectives" are local reductions with no wire, so the
             # byte/precision proofs run where communication is real

@@ -22,22 +22,27 @@ Engines must be shape/dtype-preserving on the gradient pytree and jit-safe
 fault pattern never recompiles).
 
 Telemetry (telemetry/metrics.py): an engine may also carry ``wire_bytes``, a
-STATIC model ``grads_template -> bytes`` of its per-round per-site collective
-payload (what one site actually ships: full gradients for dSGD, rank-r
-factors for the compression engines). Pure shape arithmetic evaluated once at
-trace time — never a traced value; ``None`` falls back to the dense-f32
-estimate.
+STATIC model ``(grads_template, pack=1) -> bytes`` of its per-round
+PER-PHYSICAL-DEVICE collective payload (what one collective member actually
+ships: full gradients for dSGD, rank-r factors for the compression engines).
+``pack`` is the site-packing factor K (parallel/collectives.py PackedAxis):
+psum-shaped exchanges reduce locally over the packed axis before the wire,
+so their bytes are K-independent; only the factor all-gather (rankDAD) ships
+every virtual site's payload and scales with K. ``pack=1`` is the classic
+one-site-per-member figure. Pure shape arithmetic evaluated once at trace
+time — never a traced value; ``None`` falls back to the dense-f32 estimate.
 
 Wire introspection (checks/semantic.py, rule S002): ``wire_shapes`` is the
-STRUCTURED form of the same model — ``grads_template -> [(shape, dtype),
-...]``, one entry per collective payload operand the engine's ``aggregate``
-emits per round per site (dSGD: every leaf at the payload dtype; rankDAD:
-one packed factor block per rank class plus dense 1-D leaves; powerSGD: two
-factor psums per compressible leaf). ``wire_dtype`` names the payload dtype
-the engine quantizes its wire to. The semantic analyzer cross-checks these
-against the TRACED program's collective operands, so a ``wire_bytes`` figure
-the telemetry layer reports is verified, not merely modeled; the shape sum
-must equal ``wire_bytes`` exactly.
+STRUCTURED form of the same model — ``(grads_template, pack=1) -> [(shape,
+dtype), ...]``, one entry per collective payload operand the engine's
+``aggregate`` emits per round per device (dSGD: every leaf at the payload
+dtype; rankDAD: one ``[pack, Σ(m+n), r]`` factor block per rank class plus
+dense 1-D leaves; powerSGD: two factor psums per compressible leaf).
+``wire_dtype`` names the payload dtype the engine quantizes its wire to. The
+semantic analyzer cross-checks these against the TRACED program's collective
+operands, so a ``wire_bytes`` figure the telemetry layer reports is
+verified, not merely modeled; the shape sum must equal ``wire_bytes``
+exactly — at every pack factor.
 """
 
 from __future__ import annotations
@@ -58,11 +63,22 @@ def mask_dead_site(grads, weight, live):
     typically non-finite and ``NaN * 0 == NaN`` would poison the psum — the
     exact failure this mask exists to stop. Returns ``(grads, weight)``
     unchanged when ``live is None``.
+
+    ``live`` is a scalar on the classic per-member axes; under a
+    :class:`~..parallel.collectives.PackedAxis` it is the ``[K]``
+    virtual-site vector and the mask broadcasts against each leaf's leading
+    pack axis.
     """
     if live is None:
         return grads, weight
     alive = jnp.asarray(live, jnp.float32) > 0
-    grads = jax.tree.map(lambda g: jnp.where(alive, g, jnp.zeros_like(g)), grads)
+    grads = jax.tree.map(
+        lambda g: jnp.where(
+            alive.reshape(alive.shape + (1,) * (g.ndim - alive.ndim)),
+            g, jnp.zeros_like(g),
+        ),
+        grads,
+    )
     return grads, weight * alive.astype(jnp.float32)
 
 
@@ -70,13 +86,19 @@ def mask_dead_site(grads, weight, live):
 class Engine:
     name: str
     init: Callable  # grads -> state
-    aggregate: Callable  # (grads, state, weight, axis_name) -> (agg, state)
-    # static per-round per-site collective payload model (module docstring);
-    # None -> telemetry's dense-f32 fallback
+    # (grads, state, weight, axis_name, live=None) -> (agg, state).
+    # axis_name may be a str/tuple (per-member form: one site per collective
+    # member, leaves unbatched) or a PackedAxis (packed form: leaves carry a
+    # leading [K] virtual-site axis, reductions are two-level — see
+    # parallel/collectives.py). The packed aggregate returns the UNBATCHED
+    # global aggregate and [K]-batched new engine state.
+    aggregate: Callable
+    # static per-round per-device collective payload model, (grads, pack=1)
+    # -> bytes (module docstring); None -> telemetry's dense-f32 fallback
     wire_bytes: Callable | None = None
-    # structured payload model: grads -> [(shape, dtype), ...] per collective
-    # operand (module docstring); None -> dense-f32 fallback. Verified against
-    # the traced program by checks/semantic.py rule S002.
+    # structured payload model: (grads, pack=1) -> [(shape, dtype), ...] per
+    # collective operand (module docstring); None -> dense-f32 fallback.
+    # Verified against the traced program by checks/semantic.py rule S002.
     wire_shapes: Callable | None = None
     # the payload dtype this engine quantizes its wire to (numpy dtype);
     # audited by checks/semantic.py rule S004 on the traced aggregation path

@@ -35,21 +35,30 @@ def make_dsgd(precision_bits="32", **_unused) -> Engine:
     def init(grads):
         return {}
 
-    def wire_bytes(grads) -> int:
-        # dSGD ships every gradient leaf whole, cast to the payload dtype
+    def wire_bytes(grads, pack: int = 1) -> int:
+        # dSGD ships every gradient leaf whole, cast to the payload dtype.
+        # Pack-INVARIANT: under site packing the K virtual sites' weighted
+        # payloads reduce in-register before the wire (two_level_psum), so
+        # the device ships one dense partial regardless of K.
         return dense_wire_bytes(grads, itemsize)
 
-    def wire_shapes(grads):
+    def wire_shapes(grads, pack: int = 1):
         # one psum per leaf; the operand is quantized to the payload dtype
-        # before the f32-accumulating collective (parallel/collectives.py)
+        # before the f32-accumulating collective (parallel/collectives.py).
+        # Same shapes at every pack factor (see wire_bytes).
         return dense_wire_shapes(grads, pdtype)
 
     def aggregate(grads, state, weight, axis_name, live=None):
         # dead/quarantined sites: payload zeroed, weight zeroed — the
-        # weighted mean renormalizes over live weight only (robustness/)
+        # weighted mean renormalizes over live weight only (robustness/).
+        # Packed axes (leaves carrying the leading [K] virtual-site axis):
+        # the local weighted partial is reduced over the pack axis and
+        # re-quantized to the payload dtype before the single cross-device
+        # psum — the two-level reduction; the per-site payload cast below
+        # keeps the reference's per-site quantization semantics either way.
         grads, weight = mask_dead_site(grads, weight, live)
         payload = payload_cast(grads, precision_bits)
-        agg = site_weighted_mean(payload, weight, axis_name)
+        agg = site_weighted_mean(payload, weight, axis_name, wire_dtype=pdtype)
         return payload_uncast(agg, grads), state
 
     return Engine("dSGD", init, aggregate, wire_bytes=wire_bytes,

@@ -41,20 +41,24 @@ def lowrank_rank_groups(grads, rank: int) -> tuple:
     return sorted(groups.items()), dense
 
 
-def lowrank_wire_bytes(grads, rank: int, itemsize: int) -> int:
-    """Modeled per-round per-site collective payload of a low-rank factor
+def lowrank_wire_bytes(grads, rank: int, itemsize: int, pack: int = 1) -> int:
+    """Modeled per-round per-DEVICE collective payload of a low-rank factor
     exchange (the shared ``Engine.wire_bytes`` body for rankDAD and
     powerSGD, telemetry/metrics.py): each compressible leaf ships two
     factors ``[m, r]`` + ``[n, r]`` at ``itemsize`` bytes per element with
     the effective rank ``min(rank, m, n)``; 1-D leaves ride the dense f32
-    psum path. Pure shape arithmetic on THIS module's compressibility
-    criterion — safe on tracers, and a criterion change here changes the
-    payload model with it."""
+    psum path. ``pack`` is the site-packing factor K: a GATHERED factor
+    exchange (rankDAD) ships every one of the device's K virtual sites'
+    factors, so the factor half scales ×K, while the dense psum half reduces
+    locally first and stays K-invariant (powerSGD's psum'd factors are
+    likewise K-invariant — it passes ``pack=1``). Pure shape arithmetic on
+    THIS module's compressibility criterion — safe on tracers, and a
+    criterion change here changes the payload model with it."""
     total = 0
     for g in jax.tree.leaves(grads):
         if is_compressible(g):
             m, n = _matrix_shape(g)
-            total += min(rank, m, n) * (m + n) * itemsize
+            total += min(rank, m, n) * (m + n) * itemsize * pack
         else:
             size = 1
             for d in g.shape:

@@ -21,7 +21,14 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..parallel.collectives import payload_dtype, site_weight_scale, wire_compress
+from ..parallel.collectives import (
+    PackedAxis,
+    payload_dtype,
+    site_weight_scale,
+    two_level_psum,
+    weighted_site_sum,
+    wire_compress,
+)
 from .base import Engine, mask_dead_site, register_engine
 from .lowrank import (
     from_matrix,
@@ -66,20 +73,24 @@ def make_powersgd(
             "e": jax.tree.unflatten(treedef, es),
         }
 
-    def wire_bytes(grads) -> int:
+    def wire_bytes(grads, pack: int = 1) -> int:
         # two psum'd factors per compressible leaf — P [m,r] and Q' [n,r] —
         # wire-compressed to the payload dtype; shared low-rank payload
-        # model (engines/lowrank.py lowrank_wire_bytes)
+        # model (engines/lowrank.py lowrank_wire_bytes). Pack-INVARIANT:
+        # both factor psums and the dense 1-D psums reduce over the packed
+        # virtual-site axis in-register before the wire (two_level_psum), so
+        # the device ships one partial per factor regardless of K.
         import numpy as np
 
         return lowrank_wire_bytes(
             grads, dad_reduction_rank, np.dtype(pdtype).itemsize
         )
 
-    def wire_shapes(grads):
+    def wire_shapes(grads, pack: int = 1):
         # per compressible leaf TWO psum'd factors — P [m, r] then Q' [n, r],
         # wire-compressed to the payload dtype — plus a dense f32 psum per
-        # 1-D leaf. Must sum to wire_bytes (verified by S002).
+        # 1-D leaf. Same shapes at every pack factor (see wire_bytes). Must
+        # sum to wire_bytes (verified by S002).
         import numpy as np
 
         groups, dense = lowrank_rank_groups(grads, dad_reduction_rank)
@@ -99,6 +110,7 @@ def make_powersgd(
         # error feedback resumes where it left off when the site returns.
         grads, weight = mask_dead_site(grads, weight, live)
         scale = site_weight_scale(weight, axis_name)
+        packed = isinstance(axis_name, PackedAxis)
 
         # Per leaf, NOT lockstep (unlike rankDAD): powerSGD's error-feedback
         # matrix M is a full fp32 gradient copy, and a cross-leaf
@@ -108,10 +120,44 @@ def make_powersgd(
         # Cholesky), so the per-leaf loop costs no LAPACK launches anyway.
         def agg_leaf(g, q, e):
             if q is None:
+                if packed:
+                    # dense 1-D leaf: two-level weighted psum (K-invariant)
+                    return (
+                        weighted_site_sum(g, scale, axis_name).astype(g.dtype),
+                        None,
+                        None,
+                    )
                 return (
                     jax.lax.psum(g.astype(jnp.float32) * scale, axis_name).astype(g.dtype),
                     None,
                     None,
+                )
+            if packed:
+                # g [K, …], q [K, n, r], e [K, m, n] — the local halves are
+                # batched MXU contractions over the device's K virtual
+                # sites; each factor reduces over the pack axis in-register,
+                # the PARTIAL is wire-compressed, and ONE psum per factor
+                # crosses the mesh (two_level_psum) — per-device wire bytes
+                # identical to the unpacked engine's.
+                sc = scale[:, None, None]
+                M = jax.vmap(to_matrix)(g).astype(jnp.float32) + e
+                P = two_level_psum(
+                    lp_matmul(M, q, mm_dtype) * sc, axis_name, pdtype
+                )
+                P = orthonormalize(P)
+                q_new = two_level_psum(
+                    lp_matmul(jnp.swapaxes(M, 1, 2), P, mm_dtype) * sc,
+                    axis_name, pdtype,
+                )
+                G_hat = P @ q_new.T  # the global aggregate, replicated
+                e_new = M - G_hat[None]
+                like = jax.ShapeDtypeStruct(g.shape[1:], g.dtype)
+                # every site stores the identical psum'd q' (exactly the
+                # unpacked semantics, where each member's q_new IS the psum)
+                return (
+                    from_matrix(G_hat, like),
+                    jnp.broadcast_to(q_new, q.shape),
+                    e_new,
                 )
             M = to_matrix(g).astype(jnp.float32) + e
             # wire-compress to the payload dtype, then accumulate in fp32

@@ -41,9 +41,11 @@ import jax
 import jax.numpy as jnp
 
 from ..parallel.collectives import (
+    PackedAxis,
     payload_dtype,
     site_all_gather_packed,
     site_weight_scale,
+    weighted_site_sum,
 )
 from .base import Engine, mask_dead_site, register_engine
 from .lowrank import (
@@ -72,7 +74,11 @@ def make_rankdad(
     mm_dtype = jnp.bfloat16 if pdtype == jnp.bfloat16 else None
 
     def _effective_rank(g) -> int:
-        m, n = to_matrix(g).shape
+        # shape arithmetic only (g may be a ShapeDtypeStruct row template on
+        # the packed path)
+        from .lowrank import _matrix_shape
+
+        m, n = _matrix_shape(g)
         return min(dad_reduction_rank, m, n)
 
     def init(grads):
@@ -89,26 +95,30 @@ def make_rankdad(
         ]
         return {"omega": jax.tree.unflatten(treedef, oms)}
 
-    def wire_bytes(grads) -> int:
+    def wire_bytes(grads, pack: int = 1) -> int:
         # factor exchange per compressible leaf: P + Q in the payload dtype
         # (one packed gather per rank class — same bytes); shared low-rank
-        # payload model (engines/lowrank.py lowrank_wire_bytes)
+        # payload model (engines/lowrank.py lowrank_wire_bytes). The gather
+        # half scales with the site-packing factor K (every virtual site's
+        # factors genuinely cross the wire); the dense 1-D psum half reduces
+        # locally over the pack axis first and is K-invariant.
         import numpy as np
 
         return lowrank_wire_bytes(
-            grads, dad_reduction_rank, np.dtype(pdtype).itemsize
+            grads, dad_reduction_rank, np.dtype(pdtype).itemsize, pack=pack
         )
 
-    def wire_shapes(grads):
-        # what `aggregate` actually launches per round per site: ONE packed
-        # all_gather per rank class — P_i/Q_i factors concatenated on axis 0,
-        # [Σ(m_i+n_i), r] at the payload dtype — plus a dense f32 psum per
-        # 1-D leaf. Must sum to wire_bytes (verified by S002).
+    def wire_shapes(grads, pack: int = 1):
+        # what `aggregate` actually launches per round per device: ONE packed
+        # all_gather per rank class — the device's [pack, Σ(m_i+n_i), r]
+        # virtual-site factor block at the payload dtype — plus a dense f32
+        # psum per 1-D leaf (pack-invariant: two-level reduced). Must sum to
+        # wire_bytes (verified by S002) at every pack factor.
         import numpy as np
 
         groups, dense = lowrank_rank_groups(grads, dad_reduction_rank)
         shapes = [
-            ((sum(m + n for m, n in mns), r), np.dtype(pdtype))
+            ((pack, sum(m + n for m, n in mns), r), np.dtype(pdtype))
             for r, mns in groups
         ]
         return shapes + [(s, np.dtype(np.float32)) for s in dense]
@@ -119,8 +129,15 @@ def make_rankdad(
         # payload is 0, so the gathered reconstruction is the live sites'
         # weighted mean. Its warm-start Ω is frozen by the trainer for the
         # round (trainer/steps.py), keeping the subspace for its return.
+        #
+        # Packed axes (leaves carrying a leading [K] virtual-site axis): the
+        # factorization vmaps over the pack axis, the device's whole [K, …]
+        # factor block ships in one gather (the genuinely K-scaling half of
+        # the wire), and the dense 1-D leaves take the two-level psum (local
+        # pack reduce first — K-invariant wire).
         grads, weight = mask_dead_site(grads, weight, live)
         scale = site_weight_scale(weight, axis_name)
+        packed = isinstance(axis_name, PackedAxis)
         leaves, treedef = jax.tree.flatten(grads)
         omegas = (
             treedef.flatten_up_to(state["omega"])
@@ -134,22 +151,46 @@ def make_rankdad(
         # serialize against each other.
         groups: dict[int, list[int]] = {}
         for i, g in enumerate(leaves):
-            if is_compressible(g):
-                groups.setdefault(_effective_rank(g), []).append(i)
+            # compressibility is a property of ONE site's leaf — classify on
+            # the row shape, not the [K]-batched array (a packed 1-D bias
+            # must not read as a compressible [K, n] matrix)
+            row = jax.ShapeDtypeStruct(g.shape[1:], g.dtype) if packed else g
+            if is_compressible(row):
+                groups.setdefault(_effective_rank(row), []).append(i)
+            elif packed:
+                # dense dSGD path for 1-D leaves: two-level weighted psum
+                out[i] = weighted_site_sum(g, scale, axis_name).astype(g.dtype)
             else:
-                # dense dSGD path for 1-D leaves (biases, BN affines)
                 out[i] = jax.lax.psum(
                     g.astype(jnp.float32) * scale, axis_name
                 ).astype(g.dtype)
         order = sorted(groups.items())
-        results = subspace_iteration_grouped(
-            [
-                ([to_matrix(leaves[i]) for i in idxs], r,
+        if packed and order:
+            # one vmap over the pack axis around the SAME grouped while_loop;
+            # rank classes stay static (closed over), matrices/Ω are batched
+            rs = [r for r, _ in order]
+            arg = [
+                ([jax.vmap(to_matrix)(leaves[i]) for i in idxs],
                  [omegas[i] for i in idxs])
-                for r, idxs in order
-            ],
-            dad_num_pow_iters, dad_tol, matmul_dtype=mm_dtype,
-        )
+                for _, idxs in order
+            ]
+
+            def factorize(groups_in):
+                return subspace_iteration_grouped(
+                    [(ms, r, oms) for r, (ms, oms) in zip(rs, groups_in)],
+                    dad_num_pow_iters, dad_tol, matmul_dtype=mm_dtype,
+                )
+
+            results = jax.vmap(factorize)(arg)
+        else:
+            results = subspace_iteration_grouped(
+                [
+                    ([to_matrix(leaves[i]) for i in idxs], r,
+                     [omegas[i] for i in idxs])
+                    for r, idxs in order
+                ],
+                dad_num_pow_iters, dad_tol, matmul_dtype=mm_dtype,
+            )
         for (r, idxs), pqs in zip(order, results):
             # weight one factor so the gathered reconstruction sums to the
             # weighted mean; cast payloads like the reference's
@@ -157,8 +198,9 @@ def make_rankdad(
             # gather (P_0, Q_0, P_1, Q_1, ... interleaved)
             parts = []
             for P, Q in pqs:
+                qs = Q * (scale[:, None, None] if packed else scale)
                 parts.append(P.astype(pdtype))
-                parts.append((Q * scale).astype(pdtype))
+                parts.append(qs.astype(pdtype))
             gathered = site_all_gather_packed(parts, axis_name)
             for k, (i, (P, Q)) in enumerate(zip(idxs, pqs)):
                 G_hat = jnp.einsum(
@@ -166,14 +208,20 @@ def make_rankdad(
                     gathered[2 * k].astype(jnp.float32),      # [S, m, r]
                     gathered[2 * k + 1].astype(jnp.float32),  # [S, n, r]
                 )
-                out[i] = from_matrix(G_hat, leaves[i])
+                like = (
+                    jax.ShapeDtypeStruct(leaves[i].shape[1:], leaves[i].dtype)
+                    if packed else leaves[i]
+                )
+                out[i] = from_matrix(G_hat, like)
                 if dad_warm_start:
                     # next round's subspace guess: this round's (per-site,
                     # unweighted) right factor Q = GᵀP. Y₀ = G@Q ≈ G(GᵀP) —
                     # one power refinement for free at init. A zero gradient
                     # leaves Q=0; the CholeskyQR zero-column fallback then
                     # re-seeds from canonical basis vectors, so the subspace
-                    # recovers the round the gradient returns.
+                    # recovers the round the gradient returns. (Packed: Q is
+                    # the [K, n, r] batched factor — matches the [K]-leading
+                    # engine-state layout.)
                     new_oms[i] = Q
         new_state = (
             {"omega": jax.tree.unflatten(treedef, new_oms)}

@@ -3,11 +3,14 @@ from .mesh import (
     SITE_AXIS,
     host_mesh,
     make_site_mesh,
+    pack_factor,
+    packed_site_mesh,
     replicated,
     site_sharding,
 )
 from .distributed import distributed_init, distributed_shutdown, multihost_site_mesh
 from .collectives import (
+    PackedAxis,
     payload_cast,
     payload_dtype,
     payload_uncast,
@@ -18,4 +21,6 @@ from .collectives import (
     site_mean,
     site_sum,
     site_weighted_mean,
+    two_level_psum,
+    weighted_site_sum,
 )

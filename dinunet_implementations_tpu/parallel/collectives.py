@@ -11,15 +11,52 @@ transport (SURVEY.md §3.1); these primitives delete that cost class.
 
 All functions are designed for use *inside* ``shard_map``/``pjit`` with a bound
 axis name.
+
+Axis forms (r12 — site packing). ``axis_name`` may be:
+
+- a ``str`` mesh/vmap axis name — the classic one-site-per-collective-member
+  form (one site per device, or all sites vmapped onto one device);
+- a ``(mesh_axis, vmap_axis)`` tuple — the legacy folded form, kept for
+  compatibility: collectives resolve the vmapped half through jax's batching
+  rules, which ships the whole ``[K, ...]`` batched block over the mesh axis
+  (K× wire inflation — the reason PackedAxis exists);
+- a :class:`PackedAxis` — the packed two-level form: every payload leaf
+  carries a LEADING ``[K]`` virtual-site axis, reductions run **local
+  in-register sum over the packed axis first**, the partial is (optionally)
+  quantized to the wire dtype, and ONE cross-device collective ships the
+  unbatched partial over the mesh axis. Per-device wire bytes are then
+  independent of K for every psum-shaped exchange; only genuine per-site
+  payloads (the low-rank factor all-gather) scale with K.
 """
 
 from __future__ import annotations
+
+import dataclasses
 
 import jax
 import jax.numpy as jnp
 
 from ..core.jaxcompat import axis_size
 from .mesh import SITE_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class PackedAxis:
+    """The packed (K-sites-per-device) site axis: payload pytree leaves carry
+    a leading ``[pack]`` virtual-site axis; reductions are two-level (local
+    sum over that axis, then one cross-device collective over ``name``).
+    ``name=None`` means no mesh half (every virtual site on one device — the
+    cross-device collective degenerates to the identity); trace-time static,
+    safe to close over in jitted code."""
+
+    name: str | None  # the mesh axis (from parallel/mesh.py constants)
+    pack: int  # K — virtual sites per device (the leading payload axis)
+
+
+def _bcast(scale, like):
+    """Reshape a per-virtual-site ``[K]`` vector to broadcast against a
+    ``[K, ...]``-leading payload leaf."""
+    return scale.reshape(scale.shape + (1,) * (like.ndim - scale.ndim))
 
 # precision_bits payload casting (compspec.json:161-176). On TPU, "16" means
 # bfloat16 (the native 16-bit type; same byte count on the wire, wider
@@ -37,11 +74,18 @@ def payload_dtype(precision_bits="32"):
     return _PAYLOAD_DTYPES[precision_bits]
 
 
-def site_weight_scale(weight, axis_name: str = SITE_AXIS):
+def site_weight_scale(weight, axis_name=SITE_AXIS):
     """Per-site normalized weight ``w_s / Σ w`` with a zero-total guard (an
-    all-masked round yields scale 0, keeping updates finite)."""
+    all-masked round yields scale 0, keeping updates finite). Packed form:
+    ``weight`` is the ``[K]`` virtual-site vector and the total spans the
+    local pack AND the mesh axis; the returned scale is ``[K]``."""
     w = jnp.asarray(weight, jnp.float32)
-    total = jax.lax.psum(w, axis_name)
+    if isinstance(axis_name, PackedAxis):
+        total = jnp.sum(w)
+        if axis_name.name is not None:
+            total = jax.lax.psum(total, axis_name.name)
+    else:
+        total = jax.lax.psum(w, axis_name)
     return jnp.where(total > 0, w / jnp.maximum(total, 1e-12), 0.0)
 
 
@@ -57,28 +101,68 @@ def payload_uncast(tree, like):
     return jax.tree.map(lambda g, l: g.astype(l.dtype), tree, like)
 
 
-def site_sum(tree, axis_name: str = SITE_AXIS):
+def two_level_psum(x, axes: PackedAxis, wire_dtype=None):
+    """The packed reduction primitive: in-register sum over the leading
+    ``[K]`` virtual-site axis, the partial optionally quantized to
+    ``wire_dtype`` (what the device actually ships — f32 accumulation resumes
+    after the collective, policy above), then ONE cross-device psum of the
+    UNBATCHED partial. The wire cost is K-independent by construction."""
+    part = jnp.sum(x, axis=0)
+    if wire_dtype is not None:
+        part = wire_compress(part, wire_dtype)
+    if axes.name is None:
+        return part
+    return jax.lax.psum(part, axes.name)
+
+
+def weighted_site_sum(g, scale, axis_name, wire_dtype=None):
+    """One dense payload leaf of a weighted exchange: ``Σ_s scale_s · g_s``
+    accumulated in f32. Classic axes psum the per-site scaled value; a
+    :class:`PackedAxis` takes the two-level route (``scale`` is then the
+    ``[K]`` vector and ``g`` carries the leading pack axis). ``wire_dtype``
+    quantizes the packed partial only — on the classic path the per-member
+    payload is whatever the caller already cast it to."""
+    gf = g.astype(jnp.float32)
+    if isinstance(axis_name, PackedAxis):
+        return two_level_psum(gf * _bcast(scale, gf), axis_name, wire_dtype)
+    return jax.lax.psum(gf * scale, axis_name)
+
+
+def site_sum(tree, axis_name=SITE_AXIS):
     """Sum a pytree across sites (the remote's reduce)."""
+    if isinstance(axis_name, PackedAxis):
+        return jax.tree.map(lambda g: two_level_psum(g, axis_name), tree)
     return jax.tree.map(lambda g: jax.lax.psum(g, axis_name), tree)
 
 
-def site_mean(tree, axis_name: str = SITE_AXIS):
+def site_mean(tree, axis_name=SITE_AXIS):
     """Unweighted mean across sites."""
+    if isinstance(axis_name, PackedAxis):
+        n = axis_name.pack * (
+            1 if axis_name.name is None else axis_size(axis_name.name)
+        )
+        return jax.tree.map(
+            lambda g: two_level_psum(g, axis_name) / n, tree
+        )
     return jax.tree.map(lambda g: jax.lax.pmean(g, axis_name), tree)
 
 
-def site_weighted_mean(tree, weight, axis_name: str = SITE_AXIS):
+def site_weighted_mean(tree, weight, axis_name=SITE_AXIS, wire_dtype=None):
     """Example-count-weighted mean across sites.
 
     dSGD semantics: each site contributes its gradient weighted by how many
     examples produced it (sites hold 73–120 subjects in the FS fixture —
     heterogeneous), so the aggregate equals the pooled-data gradient. ``weight``
-    is a scalar per site (e.g. this round's example count).
+    is a scalar per site (e.g. this round's example count) — the ``[K]``
+    vector under a :class:`PackedAxis`, where the local weighted partial is
+    reduced in-register and quantized to ``wire_dtype`` before the single
+    cross-device psum (the two-level form; per-device wire bytes do not scale
+    with K).
     """
     scale = site_weight_scale(weight, axis_name)
     # Accumulate in fp32 even for bf16 payloads; cast back only after the psum.
     return jax.tree.map(
-        lambda g: jax.lax.psum(g.astype(jnp.float32) * scale, axis_name).astype(g.dtype),
+        lambda g: weighted_site_sum(g, scale, axis_name, wire_dtype).astype(g.dtype),
         tree,
     )
 
@@ -92,9 +176,20 @@ def site_all_gather(x, axis_name=SITE_AXIS, axis: int = 0, tiled: bool = False):
     ``jax.lax.all_gather`` rejects mixed mesh/vmap axis tuples (unlike
     ``psum``), so gather each axis in turn, innermost first, and flatten: the
     leading dim comes out in global site order (outer*fold_size + inner),
-    matching ``jax.lax.axis_index(axes)``."""
+    matching ``jax.lax.axis_index(axes)``.
+
+    A :class:`PackedAxis` gathers the device's whole ``[K, ...]`` virtual-site
+    block in ONE collective and flattens to the same global (device-major)
+    site order — this is the one exchange whose wire bytes genuinely scale
+    with K (every virtual site's factors must reach every device)."""
     if isinstance(axis_name, str):
         return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+    if isinstance(axis_name, PackedAxis):
+        assert axis == 0 and not tiled, "packed gather stacks the leading dim only"
+        if axis_name.name is None:
+            return x  # every virtual site already local: [S, ...] as-is
+        out = jax.lax.all_gather(x, axis_name.name, axis=0)
+        return out.reshape((-1,) + x.shape[1:])
     assert axis == 0 and not tiled, "tuple-axis gather supports leading-dim stacking only"
     out = x
     for ax in reversed(tuple(axis_name)):
@@ -111,11 +206,19 @@ def site_all_gather_packed(parts, axis_name=SITE_AXIS):
     (P and Q); packing turns a whole rank group's factor exchange into a
     single collective launch — comm volume unchanged (``r·Σ(m_i+n_i)`` per
     site), launch count divided by ``2·|group|`` (the flagship ICA-LSTM's
-    r=10 group goes from 12 gathers per round to 1)."""
+    r=10 group goes from 12 gathers per round to 1).
+
+    Under a :class:`PackedAxis` the parts carry a leading ``[K]`` virtual-site
+    axis (``[K, k_i, ...]``); they concatenate on axis 1, the device's whole
+    ``[K, Σk_i, ...]`` block ships in one gather, and the splits come back in
+    the same global-site-order ``[S, k_i, ...]`` views as the classic form —
+    downstream reconstruction code is identical either way."""
+    packed = isinstance(axis_name, PackedAxis)
+    cat_axis = 1 if packed else 0
     if len(parts) == 1:
         return [site_all_gather(parts[0], axis_name)]
-    sizes = [p.shape[0] for p in parts]
-    gathered = site_all_gather(jnp.concatenate(parts, axis=0), axis_name)
+    sizes = [p.shape[cat_axis] for p in parts]
+    gathered = site_all_gather(jnp.concatenate(parts, axis=cat_axis), axis_name)
     outs, off = [], 0
     for k in sizes:
         outs.append(gathered[:, off:off + k])
@@ -131,9 +234,17 @@ def wire_compress(x, pdtype):
     return x.astype(pdtype).astype(jnp.float32)
 
 
-def site_index(axis_name: str = SITE_AXIS):
+def site_index(axis_name=SITE_AXIS):
+    if isinstance(axis_name, PackedAxis):
+        # per-device block start: virtual site d*K + j lives at row j of the
+        # packed leaf on mesh member d (device-major global order)
+        base = 0 if axis_name.name is None else jax.lax.axis_index(axis_name.name)
+        return base * axis_name.pack
     return jax.lax.axis_index(axis_name)
 
 
-def site_count(axis_name: str = SITE_AXIS):
+def site_count(axis_name=SITE_AXIS):
+    if isinstance(axis_name, PackedAxis):
+        n = 1 if axis_name.name is None else axis_size(axis_name.name)
+        return n * axis_name.pack
     return axis_size(axis_name)

@@ -11,6 +11,17 @@ Axes:
   - ``site``  — one federated site per mesh index (or per core-group).
   - ``model`` — optional inner axis for tensor/sequence sharding within a site
                 (a TPU-build extension; the reference is single-device per site).
+
+Site packing (r12): the mesh's ``site`` axis is the PHYSICAL half of a
+virtual site axis. ``S`` virtual sites pack ``K = sites_per_device`` per mesh
+member (:func:`packed_site_mesh`): every ``[S, …]`` per-site array shards
+``P(site)`` into contiguous ``[K, …]`` device blocks, so virtual site
+``d·K + j`` lives at row ``j`` on mesh member ``d`` (device-major global
+order — the same order ``axis_index((site, fold))`` linearizes to inside the
+epoch). Aggregation is then two-level (parallel/collectives.py PackedAxis):
+a local in-register reduce over the packed rows followed by one cross-device
+collective over ``site`` — which is how an 8-device mesh runs 512+ sites in
+one compiled SPMD program without site count ever touching device count.
 """
 
 from __future__ import annotations
@@ -51,6 +62,50 @@ def make_site_mesh(
         )
     arr = np.array(devices[:need]).reshape(num_sites, model_axis_size)
     return Mesh(arr, (SITE_AXIS, MODEL_AXIS))
+
+
+def packed_site_mesh(
+    num_sites: int,
+    sites_per_device: int = 1,
+    devices: list | None = None,
+    model_axis_size: int = 1,
+) -> Mesh:
+    """A ``(site, model)`` mesh for ``num_sites`` VIRTUAL sites packed
+    ``sites_per_device`` per mesh member.
+
+    The mesh's site axis has ``num_sites // sites_per_device`` entries; the
+    trainer's ``P(site)`` sharding then hands each device a contiguous
+    ``[sites_per_device, …]`` block of every per-site array (the packed
+    layout above). ``sites_per_device=1`` is exactly :func:`make_site_mesh`.
+    Raises when the pack factor doesn't divide the site count or the mesh
+    doesn't fit the device set.
+    """
+    if sites_per_device < 1:
+        raise ValueError(f"sites_per_device must be >= 1, got {sites_per_device}")
+    if num_sites % sites_per_device:
+        raise ValueError(
+            f"sites_per_device={sites_per_device} must divide the virtual "
+            f"site count ({num_sites})"
+        )
+    return make_site_mesh(
+        num_sites // sites_per_device, devices, model_axis_size
+    )
+
+
+def pack_factor(mesh: Mesh | None, num_sites: int) -> int:
+    """The site-packing factor K a ``[num_sites, …]`` per-site array gets on
+    ``mesh``: virtual sites per device along the mesh's site axis.
+    ``mesh=None`` (the vmap-folded single-device topology) packs everything
+    onto one device — K = num_sites."""
+    if mesh is None:
+        return num_sites
+    mesh_sites = dict(mesh.shape)[SITE_AXIS]
+    if num_sites % mesh_sites:
+        raise ValueError(
+            f"{num_sites} virtual sites do not divide over the mesh's "
+            f"{mesh_sites} site-axis members"
+        )
+    return num_sites // mesh_sites
 
 
 def site_sharding(mesh: Mesh, *trailing_axes) -> NamedSharding:

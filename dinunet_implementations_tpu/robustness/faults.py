@@ -20,7 +20,11 @@ run should experience:
   passes this value (the deterministic arm of the SIGTERM handler).
 
 Masks are plain numpy arrays fed to the compiled epoch as traced inputs:
-changing the plan never recompiles the program.
+changing the plan never recompiles the program. ``site`` indices are always
+VIRTUAL site ids: under site packing (r12) the ``[S, rounds]`` masks shard
+``P(site)`` into per-device ``[K, rounds]`` blocks, so a plan that drops or
+poisons site 137 of 512 affects exactly that packed row
+(tests/test_packing.py pins packed == unpacked chaos).
 """
 
 from __future__ import annotations

@@ -3,7 +3,11 @@ jitted epoch scan.
 
 Three int32 counters per site, stored in ``TrainState.health`` with a leading
 ``[num_sites]`` axis and sharded over the site mesh axis exactly like engine
-state (trainer/steps.py ``_state_specs``):
+state (trainer/steps.py ``_state_specs``). ``num_sites`` counts VIRTUAL
+sites: under site packing (r12) each device carries the ``[K]`` block of its
+packed sites' counters and the per-round gates are ``[K]`` vector ops — a
+quarantine decision lands on the virtual row that blew up, never on the
+whole device:
 
 - ``streak`` — consecutive rounds with a non-finite site gradient; resets to
   0 the round the gradient comes back finite;

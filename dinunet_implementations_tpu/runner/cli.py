@@ -62,7 +62,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sequence parallelism: shard the model's sequence "
                         "axis over this many devices per site")
     p.add_argument("--sites-per-device", type=int, default=None,
-                   help="fold several simulated sites onto one device")
+                   help="site packing: K virtual sites per mesh device with "
+                        "two-level aggregation (512+ sites on an 8-device "
+                        "mesh; see docs/ARCHITECTURE.md Site virtualization)")
     p.add_argument("--out-dir", default=None,
                    help="output root (default <data-path>/output)")
     p.add_argument("--site", type=int, default=None,

@@ -20,7 +20,7 @@ import re
 from ..core.config import TrainConfig, resolve_site_configs
 from ..data.api import build_site_dataset
 from ..data.splits import resolve_splits
-from ..parallel.mesh import host_mesh, make_site_mesh
+from ..parallel.mesh import host_mesh, packed_site_mesh
 from ..trainer.loop import FederatedTrainer
 from .registry import get_task, task_cache
 
@@ -124,7 +124,7 @@ class FedRunner:
                 raise ValueError(
                     f"sites_per_device={k} must divide the site count ({n})"
                 )
-            n_mesh = n // k  # mesh site-axis size; k sites fold per device
+            n_mesh = n // k  # mesh site-axis size; k sites pack per device
             devs = jax.devices()
             cpus = [d for d in devs if d.platform == "cpu"]
             if jax.process_count() > 1:
@@ -142,7 +142,9 @@ class FedRunner:
                     model_axis_size=m,
                 )
             elif len(devs) >= n_mesh * m:
-                mesh = make_site_mesh(n_mesh, devs, model_axis_size=m)
+                # the packed topology (parallel/mesh.py): k virtual sites
+                # per mesh member, two-level aggregation in the epoch
+                mesh = packed_site_mesh(n, k, devs, model_axis_size=m)
             elif len(cpus) >= n_mesh * m:
                 mesh = host_mesh(n_mesh, model_axis_size=m)
             elif m > 1:

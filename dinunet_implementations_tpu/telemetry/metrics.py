@@ -16,8 +16,11 @@ under a bespoke harness:
   compression error; for dSGD it measures cross-site gradient disagreement;
 - ``update_sq_last`` / ``update_sq_sum`` — squared norm of the applied
   optimizer update (replicated per site: the update is global);
-- ``payload_bytes`` — modeled collective wire bytes shipped per round
-  (:func:`payload_bytes_of`, from the engine's ``wire_bytes`` model);
+- ``payload_bytes`` — modeled collective wire bytes shipped per round PER
+  PHYSICAL DEVICE (:func:`payload_bytes_of`, from the engine's
+  ``wire_bytes`` model at the run's pack factor: under site packing the
+  in-register pack-axis reduce is free, so the same figure lands in every
+  virtual site's row and reads as "what my device ships each round");
 - ``rounds`` — rounds counted into the accumulators.
 
 All leaves carry a leading ``[num_sites]`` axis and ride ``TrainState
@@ -31,9 +34,24 @@ pre-telemetry one (tests/test_telemetry.py).
 
 from __future__ import annotations
 
+import inspect
 import math
 
 import numpy as np
+
+
+def _accepts_pack(fn) -> bool:
+    """True when a wire-model hook takes the r12 ``pack=`` kwarg. Resolved
+    from the signature — NOT by calling under ``except TypeError``, which
+    would misread a genuine TypeError raised inside a pack-aware model as
+    "pack-unaware" and silently fall back to K-invariant bytes."""
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):  # builtins/C callables: assume legacy
+        return False
+    return "pack" in params or any(
+        p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
+    )
 
 #: metric keys of the TrainState.telemetry pytree (trace-stable; keep sorted)
 TELEMETRY_KEYS = (
@@ -77,17 +95,28 @@ def tree_sq_sum(tree):
     return s
 
 
-def payload_bytes_of(engine, grads_template) -> float:
-    """Modeled per-round collective payload bytes for one site.
+def payload_bytes_of(engine, grads_template, pack: int = 1) -> float:
+    """Modeled per-round collective payload bytes for one PHYSICAL DEVICE.
 
     Uses the engine's own ``wire_bytes`` model (engines/base.py) when it has
-    one; otherwise the dense-f32 fallback (every leaf shipped whole). A
-    static Python float — computed once at trace time from the gradient
-    pytree's shapes, never a traced value. Since r11 this figure is VERIFIED,
-    not just modeled: checks/semantic.py rule S002 cross-checks it against
-    the traced epoch program's actual collective operand shapes/dtypes."""
+    one; otherwise the dense-f32 fallback (every leaf shipped whole).
+    ``pack`` is the site-packing factor K (parallel/collectives.py
+    PackedAxis): under packing the local pack-axis reduce is free, so
+    psum-shaped exchanges stay K-invariant and only a gathered per-site
+    payload (rankDAD's factor exchange) scales with K; ``pack=1`` is the
+    classic one-site-per-member figure (also used for the vmap-folded
+    single-device topology, where there is no physical wire and the figure
+    models the notional per-site exchange, as it always has). A static
+    Python float — computed once at trace time from the gradient pytree's
+    shapes, never a traced value. Since r11 this figure is VERIFIED, not
+    just modeled: checks/semantic.py rule S002 cross-checks it against the
+    traced epoch program's actual collective operand shapes/dtypes — since
+    r12 at the cell's real pack factor. Engines with a pack-unaware model
+    (external/test fixtures) are treated as pack-invariant."""
     wb = getattr(engine, "wire_bytes", None)
     if wb is not None:
+        if _accepts_pack(wb):
+            return float(wb(grads_template, pack=pack))
         return float(wb(grads_template))
     import jax
 
@@ -96,17 +125,22 @@ def payload_bytes_of(engine, grads_template) -> float:
     ))
 
 
-def modeled_wire_shapes(engine, grads_template) -> list:
+def modeled_wire_shapes(engine, grads_template, pack: int = 1) -> list:
     """The structured payload model behind :func:`payload_bytes_of`:
     ``[(shape, numpy dtype), ...]`` — one entry per collective payload
-    operand the engine ships per round per site (``Engine.wire_shapes``,
-    engines/base.py), falling back to one dense-f32 operand per leaf for
-    engines without the hook. checks/semantic.py matches every entry against
-    a traced collective operand and requires the byte sum to equal
+    operand the engine ships per round per device (``Engine.wire_shapes``,
+    engines/base.py) at pack factor ``pack``, falling back to one dense-f32
+    operand per leaf for engines without the hook (pack-unaware hooks are
+    treated as pack-invariant). checks/semantic.py matches every entry
+    against a traced collective operand and requires the byte sum to equal
     ``wire_bytes`` exactly."""
     ws = getattr(engine, "wire_shapes", None)
     if ws is not None:
-        return [(tuple(s), np.dtype(d)) for s, d in ws(grads_template)]
+        shapes = (
+            ws(grads_template, pack=pack) if _accepts_pack(ws)
+            else ws(grads_template)
+        )
+        return [(tuple(s), np.dtype(d)) for s, d in shapes]
     import jax
 
     return [

@@ -8,6 +8,13 @@ batch_stats + optimizer state + engine state + per-site health counters + RNG
 covers the reference's ``pretrain`` largest-site warm start
 (``compspec.json:120-127``).
 
+Pack-factor-agnostic by construction (r12): every per-site array in the
+payload is keyed by VIRTUAL site (``[S, …]`` — engine state, health,
+telemetry); the site-packing factor K lives only in the mesh, so a fit
+checkpointed at K=4 resumes bit-exactly at K=8 or K=1
+(tests/test_packing.py). Never serialize a device-blocked ``[D, K, …]``
+view here — that would marry the checkpoint to a topology.
+
 Durability (robustness, PR 2): every file is framed with a CRC32 payload
 checksum (magic ``DNTCK1``), written via temp-file + ``os.replace``, and —
 with ``rotate=True`` — the previous generation survives as ``<path>.prev``.

@@ -71,8 +71,11 @@ class FederatedTrainer:
     def __init__(self, cfg: TrainConfig, model, mesh=None, out_dir: str | None = None,
                  fault_plan=None):
         """``mesh=None`` folds all sites onto the local device via vmap (one
-        chip simulating N sites); a mesh with a ``site`` axis runs one site
-        per device slice (see trainer/steps.py). ``fault_plan`` is an
+        chip simulating N sites); a mesh with a ``site`` axis runs the sites
+        across its members — one per device slice, or PACKED ``K = S /
+        mesh_sites`` per device with two-level aggregation when there are
+        more sites than mesh members (parallel/mesh.py packed_site_mesh,
+        trainer/steps.py). ``fault_plan`` is an
         optional :class:`~..robustness.faults.FaultPlan` — deterministic
         chaos injection (site drops / NaN poisoning / kill-at-round) threaded
         through the data layer and epoch inputs; masks are traced arrays, so
@@ -439,6 +442,14 @@ class FederatedTrainer:
         cfg = self.cfg
         t_start = time.time()
         self._num_sites = len(train_sites)
+        if self.mesh is not None:
+            from ..parallel.mesh import pack_factor
+
+            # the packed site layout (parallel/mesh.py): S virtual sites
+            # shard P(site) into [K, ...] device blocks — fail here with a
+            # clear message (not an XLA sharding error) when S doesn't
+            # divide over the mesh's site axis
+            pack_factor(self.mesh, self._num_sites)
         # Fail fast on splits that are empty at EVERY site; per-site emptiness
         # and too-small sites are handled below (warning / batch-size clamp).
         sizes = [

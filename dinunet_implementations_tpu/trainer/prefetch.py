@@ -8,6 +8,12 @@ that work off the critical path: a single background thread builds epoch
 dispatch runs — the Podracer split of host-side orchestration from
 device-side compute (PAPERS.md).
 
+Plans are keyed by VIRTUAL site throughout: the ``[S, steps, B]`` grid is
+indexed by global site id regardless of the mesh's pack factor
+(parallel/mesh.py site packing) — ``P(site)`` placement hands each device
+its contiguous ``[K, steps, B]`` block, so a pack-factor change never
+touches the planner.
+
 Design constraints honored here:
 
 - plans are pure functions of ``(epoch, global round window)`` — the builder

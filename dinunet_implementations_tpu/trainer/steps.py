@@ -34,7 +34,12 @@ from dinunet_implementations_tpu.core.jaxcompat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..engines.base import Engine
-from ..parallel.collectives import site_weight_scale
+from ..parallel.collectives import (
+    PackedAxis,
+    site_weight_scale,
+    two_level_psum,
+    weighted_site_sum,
+)
 from ..parallel.mesh import FOLD_AXIS, MODEL_AXIS, SITE_AXIS
 from ..robustness.health import default_health
 from ..telemetry.metrics import (
@@ -269,10 +274,16 @@ def make_train_epoch_fn(
     carries ``state.telemetry=None``: the exact pre-telemetry program, same
     pattern as ``quarantine_rounds=-1``.
 
-    Site-axis realization (both run the *same* per-site program):
+    Site-axis realization (all forms run the *same* per-site program):
 
-    - ``mesh`` given → ``shard_map`` over the mesh's ``site`` axis: one site
-      per device (slice), collectives ride ICI. The multi-chip path.
+    - ``mesh`` given → ``shard_map`` over the mesh's ``site`` axis, with
+      ``K = S / mesh_sites`` virtual sites PACKED per device (K=1 is the
+      classic one-site-per-slice case): the per-site phase runs under an
+      inner vmap over the device's ``[K, …]`` block and aggregation is the
+      two-level packed reduction (parallel/collectives.py PackedAxis) —
+      local in-register reduce over the packed axis, ONE cross-device
+      collective of the partial over ICI. The multi-chip path; how an
+      8-device mesh trains 512+ sites in one compiled program (r12).
     - ``mesh=None`` → ``jax.vmap(axis_name="site")``: all S sites fold onto
       the local device as a batched dimension; ``psum``/``all_gather`` resolve
       over the vmapped axis. This is how one TPU chip simulates 32 federated
@@ -314,19 +325,40 @@ def make_train_epoch_fn(
         is ever materialized, so peak HBM holds the inventory, not the dense
         epoch tensor.
 
-        Only the per-site work (grads, engine aggregation, stat sync) runs
-        under the inner vmap; the optimizer update applies ONCE per round on
-        the (replicated) aggregate. The scan carry therefore holds a single
-        copy of params/opt_state — vmapping the whole round used to replicate
-        them per site, costing ~k× the params+Adam-state in HBM writes every
-        round (measured ~half the epoch time at 32 folded sites).
+        Only the per-site work (grads, engine factorization, stat
+        accumulation) runs under the inner vmap; the optimizer update applies
+        ONCE per round on the (replicated) aggregate. The scan carry
+        therefore holds a single copy of params/opt_state — vmapping the
+        whole round used to replicate them per site, costing ~k× the
+        params+Adam-state in HBM writes every round (measured ~half the
+        epoch time at 32 folded sites).
 
-        ``site_axes`` is the bound axis (or (mesh, vmap-fold) pair) that
-        cross-site collectives reduce over; ``inner_axis`` is the vmap axis
-        name for the in-device block. axis_index over ``site_axes``
-        linearizes to the same global site order as the data layout.
+        ``site_axes`` is the bound axis (or (mesh, vmap-fold) pair) that the
+        per-site phase's ``axis_index`` linearizes over (the same global,
+        device-major site order as the data layout); ``inner_axis`` is the
+        vmap axis name for the in-device block.
+
+        Site packing (r12): on a mesh (``site_axes`` a tuple), aggregation
+        is a TWO-LEVEL reduction. The per-site gradient phase stays under
+        the inner vmap, but everything that communicates — the engine's
+        ``aggregate``, sync-BN, the round loss — runs OUTSIDE it on the
+        device's ``[k, …]`` virtual-site block with a
+        :class:`~..parallel.collectives.PackedAxis`: payloads reduce over
+        the packed axis in-register and ONE cross-device collective ships
+        the unbatched partial. The legacy form (collectives inside the vmap,
+        resolved through jax's batching rules) shipped the whole ``[k, …]``
+        block over the mesh — k× the wire bytes per round; at the 512-site
+        pack factors that is the difference between aggregation costing one
+        model's worth of traffic per device and 64 of them. The folded-vmap
+        topology (``mesh=None``) is unchanged — its "collectives" are local
+        register reductions with no wire either way.
         """
         k, steps = x.shape[0], x.shape[1]
+        # trace-time static: mesh topologies carry the (mesh, fold) pair and
+        # take the packed two-level aggregation path; the vmap-folded
+        # single-device topology keeps the classic in-vmap form
+        packed = isinstance(site_axes, tuple)
+        pax = PackedAxis(SITE_AXIS, k) if packed else None
         rounds = steps // local_iterations
         L = rounds * local_iterations
 
@@ -364,22 +396,27 @@ def make_train_epoch_fn(
         # built with telemetry=True (_ensure_aux normalizes the state), so a
         # telemetry-off program carries zero telemetry ops
         telem = state.telemetry is not None
-        # modeled per-round per-site collective payload — pure shape
-        # arithmetic over the gradient pytree, folded in as a constant
-        wire_b = payload_bytes_of(engine, state.params) if telem else 0.0
+        # modeled per-round PER-DEVICE collective payload — pure shape
+        # arithmetic over the gradient pytree, folded in as a constant. On a
+        # packed mesh the pack factor k is what makes the figure honest:
+        # psum-shaped exchanges reduce over the packed axis before the wire
+        # (k-invariant), only the factor gather scales with k — the model is
+        # verified against the traced program by checks/semantic.py S002.
+        wire_b = (
+            payload_bytes_of(engine, state.params, pack=k if packed else 1)
+            if telem else 0.0
+        )
 
-        def _ts_round(ts, site_grad, agg):
-            """One site's accumulator update for this round. ``grad_sq_last``
+        def _ts_round(ts, gsq, rsq):
+            """Per-site accumulator update for this round from the (already
+            reduced) squared grad/residual norms — scalars in the classic
+            in-vmap form, ``[k]`` vectors in the packed form. ``grad_sq_last``
             keeps the raw value (NaN = "this site blew up", the signal);
             the sums/max take finite rounds only, or one bad round would
             poison them for the rest of the fit. The update-norm slots are
             filled after the (global) optimizer step in ``one_round``."""
             if ts is None:
                 return None
-            gsq = tree_sq_sum(site_grad)
-            rsq = tree_sq_sum(
-                jax.tree.map(lambda g, a: g - a, site_grad, agg)
-            )
             gsq_f = jnp.where(jnp.isfinite(gsq), gsq, 0.0)
             return {
                 "grad_sq_last": gsq,
@@ -433,7 +470,12 @@ def make_train_epoch_fn(
                     xb, yb, wb = jax.vmap(_gather_batch)(inv_x, inv_y, ib, pz)
             rng, sub = jax.random.split(rng)
 
-            def site_part(es, hs, ts, ls, xs, ys, ws):
+            def site_micro(xs, ys, ws):
+                """One site's micro-batch gradient phase — shared by the
+                packed and classic forms (always under the inner vmap;
+                ``axis_index`` linearizes to the global, device-major site id
+                for the dropout-RNG fold, so packed and unpacked runs draw
+                identical keys)."""
                 site_ix = jax.lax.axis_index(site_axes)
 
                 def micro(acc, mb):
@@ -459,6 +501,190 @@ def make_train_epoch_fn(
                 site_grad = jax.tree.map(
                     lambda g: g / jnp.maximum(n_sum, 1.0), g_sum
                 )
+                return site_grad, n_sum, new_stats, loss_sums.sum()
+
+            def _ts_round_site(ts, site_grad, agg):
+                """Classic (in-vmap) accumulator update: scalar norms per
+                site, reduced in tree order (telemetry.metrics.tree_sq_sum —
+                the host-recompute tests depend on that order)."""
+                if ts is None:
+                    return None
+                return _ts_round(
+                    ts,
+                    tree_sq_sum(site_grad),
+                    tree_sq_sum(
+                        jax.tree.map(lambda g, a: g - a, site_grad, agg)
+                    ),
+                )
+
+            def _rows_sq_sum(tree):
+                """Per-virtual-site Σx² over a [k, …]-leading pytree — the
+                batched twin of tree_sq_sum, same f32 leaf-order
+                accumulation, one [k] vector out."""
+                s = jnp.zeros((k,), jnp.float32)
+                for leaf in jax.tree.leaves(tree):
+                    s = s + jnp.sum(
+                        jnp.square(leaf.astype(jnp.float32)).reshape(k, -1),
+                        axis=1,
+                    )
+                return s
+
+            def _per_site(vec, like):
+                """Broadcast a [k] per-virtual-site gate against a [k, …]
+                leaf."""
+                return vec.reshape((k,) + (1,) * (like.ndim - 1))
+
+            # -- fault-pipeline pieces shared by the packed ([k]-vector) and
+            # classic (in-vmap scalar) round forms. ONE definition of the
+            # liveness/quarantine/loss semantics — only the collective
+            # placement (two_level_psum outside the vmap vs lax.psum inside
+            # it) stays in the two callers below.
+
+            def _liveness_gate(ls, site_grad, hs, rows=None):
+                """scheduled-live AND finite AND not quarantined. ``rows``
+                None = scalar per site (under the inner vmap); ``rows=k`` =
+                one [k] vector over the device's virtual-site block."""
+                if rows is None:
+                    finite = jnp.array(True)
+                    for leaf in jax.tree.leaves(site_grad):
+                        finite &= jnp.isfinite(leaf).all()
+                else:
+                    finite = jnp.ones((rows,), bool)
+                    for leaf in jax.tree.leaves(site_grad):
+                        finite &= jnp.isfinite(leaf).reshape(rows, -1).all(axis=1)
+                contribute = (
+                    ls * finite.astype(jnp.float32)
+                    * (1.0 - (hs["quarantined"] > 0).astype(jnp.float32))
+                )
+                return finite, contribute
+
+            def _freeze_dead(new_tree, old_tree, gate):
+                """Hold a dead site's state for the round: its error-feedback
+                residual / warm-start subspace must resume where it left off
+                when the site returns, not absorb a round it never
+                participated in. ``gate(leaf)`` broadcasts the contribute
+                mask against a leaf (identity for scalars-in-vmap,
+                ``_per_site`` for [k, …] blocks)."""
+                return jax.tree.map(
+                    lambda new, old: jnp.where(gate(new), new, old),
+                    new_tree, old_tree,
+                )
+
+            def _round_loss(loss_sum, contribute, total_live, psum):
+                """Round-weighted global loss over LIVE sites (for logs);
+                NaN-safe: a dead site's loss sum is where-excluded. An
+                all-dead round has no training loss — report NaN, not a
+                spurious 0.0 that would drag the epoch mean down (the
+                trainer nan-means per-round losses into the epoch figure)."""
+                return jnp.where(
+                    total_live > 0,
+                    psum(jnp.where(contribute > 0, loss_sum, 0.0))
+                    / jnp.maximum(total_live, 1.0),
+                    jnp.nan,
+                )
+
+            def _health_round(hs, finite, contribute):
+                """Health counters: streak of consecutive non-finite rounds,
+                sticky quarantine once it reaches the threshold, lifetime
+                skip count — elementwise, so the same code serves the scalar
+                and [k]-vector forms."""
+                streak = jnp.where(finite, 0, hs["streak"] + 1)
+                quarantined = hs["quarantined"]
+                if quarantine_rounds > 0:
+                    quarantined = jnp.maximum(
+                        quarantined,
+                        (streak >= quarantine_rounds).astype(jnp.int32),
+                    )
+                return {
+                    "streak": streak,
+                    "skips": hs["skips"] + (contribute <= 0).astype(jnp.int32),
+                    "quarantined": quarantined,
+                }
+
+            def packed_round(hs, ts, ls, es):
+                """The two-level round: per-site grads under the inner vmap,
+                everything that communicates outside it on the [k]-batched
+                block with PackedAxis collectives — one cross-device
+                collective per payload, k-invariant psum wire."""
+                site_grad, n_sum, stats_k, loss_site = jax.vmap(
+                    site_micro, axis_name=inner_axis
+                )(xb, yb, wb)
+                gsq = _rows_sq_sum(site_grad) if ts is not None else None
+                if not guard:
+                    agg, es_new = engine.aggregate(
+                        site_grad, es, n_sum, pax, live=None
+                    )
+                    if task.has_batch_stats:
+                        scale = site_weight_scale(n_sum, pax)
+                        stats_out = jax.tree.map(
+                            lambda s: weighted_site_sum(s, scale, pax).astype(
+                                s.dtype
+                            ),
+                            stats_k,
+                        )
+                    else:
+                        stats_out = batch_stats
+                    loss_round = two_level_psum(loss_site, pax) / jnp.maximum(
+                        two_level_psum(n_sum, pax), 1.0
+                    )
+                    ts_new = (
+                        None if ts is None
+                        else _ts_round(
+                            ts, gsq,
+                            _rows_sq_sum(jax.tree.map(
+                                lambda g, a: g - a[None], site_grad, agg
+                            )),
+                        )
+                    )
+                    return agg, es_new, hs, ts_new, stats_out, loss_round, None
+                finite, contribute = _liveness_gate(ls, site_grad, hs, rows=k)
+                n_eff = n_sum * contribute
+                agg, es_new = engine.aggregate(
+                    site_grad, es, n_sum, pax, live=contribute
+                )
+                es_new = _freeze_dead(
+                    es_new, es, lambda leaf: _per_site(contribute > 0, leaf)
+                )
+                total_live = two_level_psum(n_eff, pax)
+                if task.has_batch_stats:
+                    scale = site_weight_scale(n_eff, pax)
+                    zeroed = jax.tree.map(
+                        lambda s: jnp.where(
+                            _per_site(contribute > 0, s), s, jnp.zeros_like(s)
+                        ),
+                        stats_k,
+                    )
+                    syn = jax.tree.map(
+                        lambda s: weighted_site_sum(s, scale, pax).astype(
+                            s.dtype
+                        ),
+                        zeroed,
+                    )
+                    stats_out = jax.tree.map(
+                        lambda sn, old: jnp.where(total_live > 0, sn, old),
+                        syn, batch_stats,
+                    )
+                else:
+                    stats_out = batch_stats
+                loss_round = _round_loss(
+                    loss_site, contribute, total_live,
+                    lambda v: two_level_psum(v, pax),
+                )
+                hs_new = _health_round(hs, finite, contribute)
+                ts_new = (
+                    None if ts is None
+                    else _ts_round(
+                        ts, gsq,
+                        _rows_sq_sum(jax.tree.map(
+                            lambda g, a: g - a[None], site_grad, agg
+                        )),
+                    )
+                )
+                return (agg, es_new, hs_new, ts_new, stats_out, loss_round,
+                        total_live)
+
+            def site_part(es, hs, ts, ls, xs, ys, ws):
+                site_grad, n_sum, new_stats, loss_sum = site_micro(xs, ys, ws)
                 if not guard:
                     # fault machinery statically compiled out: the exact
                     # legacy round (no finite check, no selects, no counters)
@@ -472,34 +698,21 @@ def make_train_epoch_fn(
                             new_stats,
                         )
                     loss_round = jax.lax.psum(
-                        loss_sums.sum(), site_axes
+                        loss_sum, site_axes
                     ) / jnp.maximum(jax.lax.psum(n_sum, site_axes), 1.0)
-                    return (agg, es_new, hs, _ts_round(ts, site_grad, agg),
+                    return (agg, es_new, hs, _ts_round_site(ts, site_grad, agg),
                             new_stats, loss_round, None)
-                # -- liveness: scheduled-live AND finite AND not quarantined.
-                # A poisoned batch (data corruption, overflow, fault
-                # injection) yields a non-finite site gradient; that site is
-                # skipped this round and its streak counter advances toward
-                # quarantine. All jnp.where / traced — no recompilation.
-                finite = jnp.array(True)
-                for leaf in jax.tree.leaves(site_grad):
-                    finite &= jnp.isfinite(leaf).all()
-                contribute = (
-                    ls * finite.astype(jnp.float32)
-                    * (1.0 - (hs["quarantined"] > 0).astype(jnp.float32))
-                )
+                # -- liveness: a poisoned batch (data corruption, overflow,
+                # fault injection) yields a non-finite site gradient; that
+                # site is skipped this round and its streak counter advances
+                # toward quarantine. All jnp.where / traced — no
+                # recompilation.
+                finite, contribute = _liveness_gate(ls, site_grad, hs)
                 n_eff = n_sum * contribute
                 agg, es_new = engine.aggregate(
                     site_grad, es, n_sum, site_axes, live=contribute
                 )
-                # freeze a dead site's engine state for the round: its
-                # error-feedback residual / warm-start subspace must resume
-                # where it left off when the site returns, not absorb a
-                # round it never participated in
-                es_new = jax.tree.map(
-                    lambda new, old: jnp.where(contribute > 0, new, old),
-                    es_new, es,
-                )
+                es_new = _freeze_dead(es_new, es, lambda _: contribute > 0)
                 total_live = jax.lax.psum(n_eff, site_axes)
                 # sync-BN: example-weighted average of LIVE sites' running
                 # stats (dead sites' stats may be NaN → where-zeroed, and
@@ -518,50 +731,37 @@ def make_train_epoch_fn(
                         lambda syn, old: jnp.where(total_live > 0, syn, old),
                         new_stats, batch_stats,
                     )
-                # round-weighted global loss over LIVE sites (for logs);
-                # NaN-safe: a dead site's loss sum is excluded via where. An
-                # all-dead round has no training loss — report NaN, not a
-                # spurious 0.0 that would drag the epoch mean down (the
-                # trainer nan-means per-round losses into the epoch figure)
-                loss_round = jnp.where(
-                    total_live > 0,
-                    jax.lax.psum(
-                        jnp.where(contribute > 0, loss_sums.sum(), 0.0),
-                        site_axes,
-                    ) / jnp.maximum(total_live, 1.0),
-                    jnp.nan,
+                loss_round = _round_loss(
+                    loss_sum, contribute, total_live,
+                    lambda v: jax.lax.psum(v, site_axes),
                 )
-                # -- health counters: streak of consecutive non-finite
-                # rounds; sticky quarantine once it reaches the threshold
-                streak = jnp.where(finite, 0, hs["streak"] + 1)
-                quarantined = hs["quarantined"]
-                if quarantine_rounds > 0:
-                    quarantined = jnp.maximum(
-                        quarantined, (streak >= quarantine_rounds).astype(jnp.int32)
-                    )
-                hs_new = {
-                    "streak": streak,
-                    "skips": hs["skips"] + (contribute <= 0).astype(jnp.int32),
-                    "quarantined": quarantined,
-                }
-                return (agg, es_new, hs_new, _ts_round(ts, site_grad, agg),
+                hs_new = _health_round(hs, finite, contribute)
+                return (agg, es_new, hs_new, _ts_round_site(ts, site_grad, agg),
                         new_stats, loss_round, total_live)
 
-            agg, engine_state, health, telem_k, stats_k, loss_k, tl_k = jax.vmap(
-                site_part, in_axes=(0, 0, 0, 0, 0, 0, 0),
-                out_axes=(0, 0, 0, 0, 0, 0, 0), axis_name=inner_axis,
-            )(engine_state, health, telem_st, lb, xb, yb, wb)
-            # agg/stats/loss are psum'd over site_axes → identical across the
-            # k in-device rows; collapse to one copy and update once
-            agg = jax.tree.map(lambda a: a[0], agg)
-            batch_stats = jax.tree.map(lambda a: a[0], stats_k)
+            if packed:
+                # mesh topologies: the two-level form — engine/BN/loss
+                # collectives run ONCE per device on the [k]-batched block
+                # (agg/stats/loss come back unbatched and replicated)
+                (agg, engine_state, health, telem_k, batch_stats, loss_round,
+                 total_live) = packed_round(health, telem_st, lb, engine_state)
+            else:
+                agg, engine_state, health, telem_k, stats_k, loss_k, tl_k = jax.vmap(
+                    site_part, in_axes=(0, 0, 0, 0, 0, 0, 0),
+                    out_axes=(0, 0, 0, 0, 0, 0, 0), axis_name=inner_axis,
+                )(engine_state, health, telem_st, lb, xb, yb, wb)
+                # agg/stats/loss are psum'd over site_axes → identical across
+                # the k in-device rows; collapse to one copy and update once
+                agg = jax.tree.map(lambda a: a[0], agg)
+                batch_stats = jax.tree.map(lambda a: a[0], stats_k)
+                loss_round = loss_k[0]
+                total_live = tl_k[0] if guard else None
             updates, new_opt_state = optimizer.update(agg, opt_state, params)
             new_params = optax.apply_updates(params, updates)
             if guard:
                 # a round with zero live weight advances nothing: params AND
                 # optimizer state hold (Adam's moment decay on a zero
                 # gradient would otherwise drift the update direction)
-                total_live = tl_k[0]
                 params = jax.tree.map(
                     lambda new, old: jnp.where(total_live > 0, new, old),
                     new_params, params,
@@ -578,7 +778,7 @@ def make_train_epoch_fn(
                 # zero-live round applied nothing, so it records 0
                 usq = tree_sq_sum(updates)
                 if guard:
-                    usq = jnp.where(tl_k[0] > 0, usq, 0.0)
+                    usq = jnp.where(total_live > 0, usq, 0.0)
                 telem_k = {
                     **telem_k,
                     "update_sq_last": jnp.zeros_like(
@@ -589,7 +789,7 @@ def make_train_epoch_fn(
             return (
                 params, batch_stats, opt_state, engine_state, health,
                 telem_k, rng, rnd + 1,
-            ), loss_k[0]
+            ), loss_round
 
         carry0 = (
             state.params,

@@ -139,6 +139,35 @@ def test_ica_converges_at_hard_snr(engine, tmp_path):
 
 
 @pytest.mark.golden
+@pytest.mark.parametrize("engine", ["dSGD", "rankDAD"])
+def test_ica_hard_snr_floor_holds_under_packing(engine, tmp_path):
+    """r12 acceptance: the ICA golden floors hold at pack factor K>1 — the
+    same hard-SNR tree and floor as the unpacked run above, but with all 3
+    virtual sites PACKED onto a 1-member site mesh
+    (cfg.sites_per_device=3), i.e. the two-level packed aggregation path in
+    trainer/steps.py rather than the vmap fold. A floor regression here
+    means packing changed the training math."""
+    from dinunet_implementations_tpu.runner import FedRunner as _FR
+
+    _make_hard_ica_tree(tmp_path)
+    cfg = TrainConfig(
+        task_id="ICA-Classification", agg_engine=engine, epochs=60,
+        patience=20, batch_size=8, split_ratio=(0.7, 0.15, 0.15), seed=0,
+        sites_per_device=3,
+    )
+    runner = _FR(cfg, data_path=str(tmp_path), out_dir=str(tmp_path / "out"))
+    assert dict(runner.mesh.shape)["site"] == 1  # genuinely packed (K=3)
+    res = runner.run(verbose=False)[0]
+    loss, auc = res["test_metrics"][0]
+    floor = HARD_SNR_FLOOR[engine]
+    assert auc >= floor, (
+        f"packed (K=3) ICA {engine}: test AUC {auc:.4f} under the {floor} "
+        f"golden floor (best_val_epoch={res['best_val_epoch']})"
+    )
+    assert math.isfinite(loss)
+
+
+@pytest.mark.golden
 @pytest.mark.slow
 @pytest.mark.parametrize("seed", [0, 1, 2])
 def test_ica_rankdad_warm_start_clears_seed_swept_floor(seed, tmp_path):

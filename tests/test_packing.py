@@ -1,0 +1,348 @@
+"""Site packing (r12): K-sites-per-chip virtualization with two-level
+aggregation.
+
+The packed site axis (parallel/mesh.py packed_site_mesh, trainer/steps.py
+packed path, parallel/collectives.py PackedAxis) must be invisible to
+results: packed(K) == unpacked trajectories per engine and pipeline, chaos
+masks address VIRTUAL sites, checkpoints are pack-factor-agnostic (save at
+K=4, resume at K=8, bit-exact state round-trip), one compiled program per
+fit, and 512 virtual sites train on the 8-device CPU mesh — the fan-out cap
+this round exists to break. test_folding.py keeps the deeper (slow)
+equivalence runs; these are the tier-1 packing gates.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dinunet_implementations_tpu.engines import make_engine
+from dinunet_implementations_tpu.models import MSANNet
+from dinunet_implementations_tpu.parallel.mesh import (
+    host_mesh,
+    pack_factor,
+    packed_site_mesh,
+)
+from dinunet_implementations_tpu.trainer import (
+    FederatedTask,
+    init_train_state,
+    make_optimizer,
+    make_train_epoch_fn,
+)
+
+ENGINE_KW = {
+    "dSGD": {},
+    "rankDAD": dict(dad_reduction_rank=2, dad_num_pow_iters=2, dad_tol=1e-3),
+    "powerSGD": dict(dad_reduction_rank=2),
+}
+
+
+def _data(S=4, steps=2, B=4, F=6, seed=0):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.normal(size=(S, steps, B, F)).astype(np.float32))
+    y = jnp.asarray((rng.random((S, steps, B)) > 0.5).astype(np.int32))
+    w = jnp.ones((S, steps, B), jnp.float32)
+    return x, y, w
+
+
+def _build(engine_name, mesh, S, F=6, pipeline="host", seed_model=0,
+           **epoch_kw):
+    model = MSANNet(in_size=F, hidden_sizes=(8,), out_size=2)
+    task = FederatedTask(model)
+    engine = make_engine(engine_name, **ENGINE_KW[engine_name])
+    opt = make_optimizer("sgd", 1e-2)
+    state = init_train_state(
+        task, engine, opt, jax.random.PRNGKey(seed_model),
+        jnp.ones((4, F), jnp.float32), num_sites=S,
+    )
+    fn = make_train_epoch_fn(
+        task, engine, opt, mesh, local_iterations=1, pipeline=pipeline,
+        **epoch_kw,
+    )
+    return fn, state
+
+
+def _run_epochs(fn, state, data, epochs=2, live=None):
+    x, y, w = data
+    losses = []
+    for _ in range(epochs):
+        if live is None:
+            state, ls = fn(state, x, y, w)
+        else:
+            state, ls = fn(state, x, y, w, live)
+        losses.extend(np.asarray(ls).tolist())
+    return jax.tree.map(np.asarray, state), losses
+
+
+def _assert_close(a, b, atol=1e-6):
+    jax.tree.map(
+        lambda u, v: np.testing.assert_allclose(u, v, atol=atol),
+        a, b,
+    )
+
+
+# ---------------------------------------------------------------------------
+# packed(K) == unpacked equivalence, per engine × pipeline
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("engine", ["dSGD", "rankDAD", "powerSGD"])
+def test_packed_matches_unpacked(engine):
+    """S=4 virtual sites: K=2 on a 2-device mesh must train identically to
+    K=1 on a 4-device mesh (the S ≤ D acceptance gate) AND to the vmap fold
+    — the two-level reduction changes the wire, never the math."""
+    data = _data(seed=3)
+    atol = 1e-6 if engine == "dSGD" else 1e-5
+    fn_p, st_p = _build(engine, host_mesh(2), 4)
+    fn_u, st_u = _build(engine, host_mesh(4), 4)
+    fn_v, st_v = _build(engine, None, 4)
+    s_p, l_p = _run_epochs(fn_p, st_p, data)
+    s_u, l_u = _run_epochs(fn_u, st_u, data)
+    s_v, l_v = _run_epochs(fn_v, st_v, data)
+    np.testing.assert_allclose(l_p, l_u, atol=atol)
+    np.testing.assert_allclose(l_p, l_v, atol=atol)
+    _assert_close(s_p.params, s_u.params, atol)
+    _assert_close(s_p.params, s_v.params, atol)
+    # per-VIRTUAL-site engine state survives packing site-for-site
+    _assert_close(s_p.engine_state, s_u.engine_state, atol)
+
+
+@pytest.mark.parametrize("engine", ["dSGD", "rankDAD", "powerSGD"])
+def test_packed_device_pipeline_matches_host(engine):
+    """The device-resident pipeline under packing: on-device gather from the
+    [K, N, ...] inventory block + two-level aggregation must be bit-exact
+    with the packed host pipeline (one plan, two realizations)."""
+    S, N, B, steps, F = 4, 8, 4, 2, 6
+    rng = np.random.default_rng(1)
+    inv_x = jnp.asarray(rng.normal(size=(S, N, F)).astype(np.float32))
+    inv_y = jnp.asarray((rng.random((S, N)) > 0.5).astype(np.int32))
+    idx = jnp.asarray(
+        rng.integers(0, N, size=(S, steps, B)).astype(np.int32)
+    )
+    # host realization of the same plan
+    flat = np.asarray(idx).reshape(S, -1)
+    x = jnp.asarray(
+        np.take_along_axis(np.asarray(inv_x), flat[..., None], axis=1)
+    ).reshape(S, steps, B, F)
+    y = jnp.asarray(
+        np.take_along_axis(np.asarray(inv_y), flat, axis=1)
+    ).reshape(S, steps, B)
+    w = jnp.ones((S, steps, B), jnp.float32)
+
+    mesh = host_mesh(2)  # K=2
+    fn_d, st = _build(engine, mesh, S, pipeline="device")
+    fn_h, _ = _build(engine, mesh, S, pipeline="host")
+    s_d, l_d = _run_epochs(fn_d, st, (inv_x, inv_y, idx))
+    s_h, l_h = _run_epochs(fn_h, st, (x, y, w))
+    np.testing.assert_array_equal(l_d, l_h)
+    jax.tree.map(
+        lambda u, v: np.testing.assert_array_equal(u, v),
+        s_d.params, s_h.params,
+    )
+
+
+# ---------------------------------------------------------------------------
+# chaos: dead VIRTUAL site under packing
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("engine", ["dSGD", "rankDAD", "powerSGD"])
+def test_dead_virtual_site_masks_at_virtual_granularity(engine):
+    """A liveness mask addressing ONE virtual site inside a packed device
+    block must have exactly the unpacked effect: packed(K=2) masked run ==
+    unpacked (1/device) masked run, and the dead site's health counters land
+    on the right VIRTUAL row."""
+    S, steps = 4, 2
+    data = _data(S=S, steps=steps, seed=5)
+    live = np.ones((S, steps), np.float32)
+    live[1, :] = 0.0  # virtual site 1 — the second row of device 0's block
+    live = jnp.asarray(live)
+    atol = 1e-6 if engine == "dSGD" else 1e-5
+    fn_p, st_p = _build(engine, host_mesh(2), S)
+    fn_u, st_u = _build(engine, host_mesh(4), S)
+    s_p, l_p = _run_epochs(fn_p, st_p, data, epochs=1, live=live)
+    s_u, l_u = _run_epochs(fn_u, st_u, data, epochs=1, live=live)
+    np.testing.assert_allclose(l_p, l_u, atol=atol)
+    _assert_close(s_p.params, s_u.params, atol)
+    # the skip landed on virtual row 1 only, in both topologies
+    np.testing.assert_array_equal(s_p.health["skips"], s_u.health["skips"])
+    assert s_p.health["skips"][1] == steps
+    assert s_p.health["skips"][0] == 0
+
+
+def test_faultplan_chaos_packed_matches_unpacked():
+    """FaultPlan-style scheduled drops + NaN poisoning through the DEVICE
+    pipeline on a packed mesh: the poison gate rides the plan at [S] virtual
+    granularity and the quarantine counters stay per-virtual-site."""
+    S, N, B, steps, F = 4, 8, 4, 2, 6
+    rng = np.random.default_rng(2)
+    inv_x = jnp.asarray(rng.normal(size=(S, N, F)).astype(np.float32))
+    inv_y = jnp.asarray((rng.random((S, N)) > 0.5).astype(np.int32))
+    idx = jnp.asarray(rng.integers(0, N, size=(S, steps, B)).astype(np.int32))
+    poison = np.zeros((S, steps), np.float32)
+    poison[2, 0] = 1.0  # NaN-poison virtual site 2, round 0
+    poison = jnp.asarray(poison)
+    live = jnp.ones((S, steps), jnp.float32)
+
+    def run(mesh):
+        fn, st = _build("dSGD", mesh, S, pipeline="device")
+        st, ls = fn(st, inv_x, inv_y, idx, live, poison)
+        return jax.tree.map(np.asarray, st), np.asarray(ls)
+
+    s_p, l_p = run(host_mesh(2))
+    s_u, l_u = run(host_mesh(4))
+    np.testing.assert_array_equal(l_p, l_u)
+    np.testing.assert_array_equal(
+        s_p.health["streak"], s_u.health["streak"]
+    )
+    # the poisoned round skipped exactly virtual site 2
+    assert s_p.health["skips"][2] == 1
+    assert int(np.asarray(s_p.health["skips"]).sum()) == 1
+
+
+# ---------------------------------------------------------------------------
+# checkpoint: pack-factor-agnostic state
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_saved_at_k4_resumes_at_k8_bit_exact(tmp_path):
+    """The checkpoint payload is keyed by VIRTUAL site ([S, ...] arrays) —
+    a fit checkpointed at K=4 must restore bit-exactly into a K=8 (and K=2)
+    topology, and the resumed trajectories must agree."""
+    from dinunet_implementations_tpu.trainer.checkpoint import (
+        load_checkpoint,
+        save_checkpoint,
+    )
+
+    S = 8
+    data = _data(S=S, seed=9)
+    fn4, st = _build("powerSGD", host_mesh(2), S)  # K=4
+    s4, _ = _run_epochs(fn4, st, data, epochs=1)
+    path = str(tmp_path / "ckpt.msgpack")
+    save_checkpoint(path, s4)
+
+    for mesh_sites, k in ((1, 8), (4, 2)):
+        fn_k, st_k = _build("powerSGD", host_mesh(mesh_sites), S)
+        restored = load_checkpoint(path, st_k)
+        # bit-exact round-trip: every leaf, including the per-virtual-site
+        # engine state / health rows, at a DIFFERENT pack factor
+        jax.tree.map(
+            lambda u, v: np.testing.assert_array_equal(
+                np.asarray(u), np.asarray(v)
+            ),
+            jax.tree.map(np.asarray, s4),
+            jax.tree.map(np.asarray, restored),
+        )
+        # and the continued trajectory matches the K=4 continuation
+        s_cont_k, l_k = _run_epochs(fn_k, restored, data, epochs=1)
+        s_cont_4, l_4 = _run_epochs(fn4, s4, data, epochs=1)
+        np.testing.assert_allclose(l_k, l_4, atol=1e-5)
+        _assert_close(s_cont_k.params, s_cont_4.params, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# one compiled program + the 512-site acceptance smoke
+# ---------------------------------------------------------------------------
+
+
+def test_one_compile_under_packing():
+    """CompileGuard: a packed fit is ONE compiled SPMD program — chained
+    epochs and changing fault masks never recompile."""
+    from jax.sharding import NamedSharding
+
+    from dinunet_implementations_tpu.checks.sanitize import jit_cache_size
+    from dinunet_implementations_tpu.trainer.steps import _state_specs
+
+    S = 8
+    mesh = host_mesh(2)
+    data = _data(S=S, seed=4)
+    fn, st = _build("dSGD", mesh, S)
+    # commit the fresh state to its steady-state sharding first — the
+    # trainer's _place_state move (an uncommitted init state costs one
+    # warmup recompile by design; that is not what this test gates)
+    st = jax.tree.map(
+        lambda a, spec: jax.device_put(a, NamedSharding(mesh, spec)),
+        st, _state_specs(st),
+    )
+    live0 = jnp.ones((S, 2), jnp.float32)
+    live1 = live0.at[3, :].set(0.0)
+    x, y, w = data
+    for lv in (live0, live0, live1):  # chained device states, changing mask
+        st, _ = fn(st, x, y, w, lv)
+    jax.tree.map(np.asarray, st)
+    assert jit_cache_size(fn) == 1
+
+
+def test_512_sites_train_on_8_device_mesh():
+    """The acceptance smoke: 512 virtual sites packed 64/device on the
+    8-device CPU mesh train as one compiled program with finite losses and
+    per-virtual-site state."""
+    from dinunet_implementations_tpu.checks.sanitize import jit_cache_size
+
+    S = 512
+    mesh = packed_site_mesh(S, 64)
+    assert dict(mesh.shape)["site"] == 8
+    assert pack_factor(mesh, S) == 64
+    data = _data(S=S, steps=1, B=2, seed=11)
+    fn, st = _build("dSGD", mesh, S)
+    st, losses = _run_epochs(fn, st, data, epochs=1)
+    assert np.isfinite(losses).all()
+    assert st.health["skips"].shape == (S,)
+    assert jit_cache_size(fn) == 1
+
+
+# ---------------------------------------------------------------------------
+# topology helpers + wire-model semantics
+# ---------------------------------------------------------------------------
+
+
+def test_packed_mesh_helpers_validate():
+    with pytest.raises(ValueError, match="divide"):
+        packed_site_mesh(6, 4)
+    with pytest.raises(ValueError, match=">= 1"):
+        packed_site_mesh(8, 0)
+    mesh = packed_site_mesh(8, 4)
+    assert dict(mesh.shape)["site"] == 2
+    assert pack_factor(mesh, 8) == 4
+    assert pack_factor(None, 8) == 8
+    with pytest.raises(ValueError, match="divide"):
+        pack_factor(mesh, 7)
+
+
+def test_wire_models_pack_semantics():
+    """Per-device wire accounting (the r12 satellite): psum-shaped
+    exchanges (dSGD, powerSGD) are pack-invariant — the local packed-axis
+    reduce is free — while rankDAD's factor gather genuinely ships every
+    virtual site's factors (×K); its dense 1-D leaves stay K-invariant."""
+    from dinunet_implementations_tpu.telemetry.metrics import (
+        payload_bytes_of,
+    )
+
+    model = MSANNet(in_size=6, hidden_sizes=(8,), out_size=2)
+    task = FederatedTask(model)
+    params, _ = task.init_variables(
+        jax.random.PRNGKey(0), jnp.ones((4, 6), jnp.float32)
+    )
+    for name in ("dSGD", "powerSGD"):
+        e = make_engine(name, **ENGINE_KW[name])
+        assert payload_bytes_of(e, params, pack=64) == payload_bytes_of(
+            e, params, pack=1
+        )
+    rd = make_engine("rankDAD", **ENGINE_KW["rankDAD"])
+    b1 = payload_bytes_of(rd, params, pack=1)
+    b4 = payload_bytes_of(rd, params, pack=4)
+    # dense (1-D bias) bytes are the pack-invariant part
+    dense = sum(
+        int(np.prod(g.shape)) * 4
+        for g in jax.tree.leaves(params) if g.ndim < 2
+    )
+    assert b4 - dense == 4 * (b1 - dense)
+    # and the structured model sums to the scalar model at every pack
+    from dinunet_implementations_tpu.telemetry.metrics import (
+        modeled_wire_shapes,
+    )
+
+    for pack in (1, 4, 64):
+        shapes = modeled_wire_shapes(rd, params, pack=pack)
+        total = sum(int(np.prod(s)) * d.itemsize for s, d in shapes)
+        assert total == int(payload_bytes_of(rd, params, pack=pack))

@@ -155,6 +155,38 @@ def test_s002_wire_bytes_verified_for_every_engine(name, kw, dense):
     assert fs == [], "\n".join(f.format() for f in fs)
 
 
+def test_s002_pack_unaware_model_flagged_on_packed_cell():
+    """The r12 wire-accounting proof: on a packed cell (4 virtual sites per
+    device) a wire model that keeps PER-SITE accounting — ignoring that the
+    factor gather ships every virtual site's block while psums reduce
+    locally first — must be flagged; the real pack-aware engine is clean on
+    the same traced program."""
+    kw = (("dad_num_pow_iters", 2), ("dad_reduction_rank", 2))
+    prog = _trace("rankDAD", kw, topology="fold4")
+    assert prog.block == 4
+    # the real engine's model matches the traced packed program exactly
+    assert sem.check_wire_bytes(
+        prog.audit.collectives, prog.engine, prog.state.params, prog.block,
+        prog.path,
+    ) == []
+    # a per-site (pack-unaware) model on the same program: the traced
+    # [4, Σ(m+n), r] gather block is unmodeled, its own [1, ...] entry never
+    # ships — both coverage directions trip
+    base = prog.engine
+    naive = dataclasses.replace(
+        base,
+        wire_shapes=lambda g: base.wire_shapes(g, pack=1),
+        wire_bytes=lambda g: base.wire_bytes(g, pack=1),
+    )
+    fs = sem.check_wire_bytes(
+        prog.audit.collectives, naive, prog.state.params, prog.block,
+        prog.path,
+    )
+    snippets = {f.snippet for f in fs}
+    assert any(s.startswith("missing") for s in snippets), snippets
+    assert any(s.startswith("unmodeled") for s in snippets), snippets
+
+
 def test_s002_inconsistent_model_flagged():
     bad = dataclasses.replace(
         make_engine("dSGD"), wire_bytes=lambda g: 1, wire_shapes=None
@@ -170,11 +202,15 @@ def test_s002_inconsistent_model_flagged():
 def test_s002_unmodeled_collective_flagged():
     """An aggregate that ships something the wire model doesn't count —
     the undercounting direction."""
+    from dinunet_implementations_tpu.parallel.collectives import site_sum
+
     base = make_engine("dSGD")
 
     def agg(grads, state, weight, axis_name, live=None):
         out, st = base.aggregate(grads, state, weight, axis_name, live=live)
-        jax.lax.psum(jnp.zeros((7, 7), jnp.float32), axis_name)
+        # a stray unmodeled payload; site_sum resolves the packed/classic
+        # axis form like a real engine would (leading [K] axis when packed)
+        site_sum(jnp.zeros((1, 7, 7), jnp.float32), axis_name)
         return out, st
 
     bad = dataclasses.replace(base, aggregate=agg)
@@ -350,7 +386,7 @@ def test_s002_match_prefers_exact_dtype_for_same_shape_payloads():
         (shape, np.dtype(np.float32)),
         (shape, np.dtype(jnp.bfloat16)),
     ]
-    matches, missing, leftovers = sem._match_payload(sites, expected, block=1)
+    matches, missing, leftovers = sem._match_payload(sites, expected)
     assert missing == [] and leftovers == []
     assert {(d.itemsize, traced) for _, d, traced, _ in matches} == {
         (4, 4), (2, 2),
