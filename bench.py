@@ -597,7 +597,8 @@ def _ensure_host_devices(want: int) -> None:
 
 def _setup_packed_epoch(S: int, K: int, engine_name: str = "dSGD",
                         engine_kw: dict | None = None,
-                        dims: dict | None = None):
+                        dims: dict | None = None, fault_plan=None,
+                        staleness_bound: int = 0):
     """One sites-scaling arm: S virtual sites packed K per device on a real
     ``(site,)`` mesh — the full federated round as ONE compiled SPMD program
     with two-level aggregation (trainer/steps.py packed path). Epoch inputs
@@ -607,7 +608,14 @@ def _setup_packed_epoch(S: int, K: int, engine_name: str = "dSGD",
 
     Returns ``(run_chain, samples_per_epoch, info)``; ``info`` records the
     mesh size and the per-device modeled wire bytes (the figure S002
-    verifies against the traced program)."""
+    verifies against the traced program).
+
+    ``fault_plan`` threads a liveness mask (drops / flaky / delay_at
+    stragglers, robustness/faults.py) through the packed round — the churn
+    smoke's arm; ``staleness_bound > 0`` additionally measures the
+    staleness-bounded buffered-async round (trainer/steps.py, r13), where a
+    straggling virtual site's buffered update keeps contributing at decayed
+    weight."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -633,8 +641,13 @@ def _setup_packed_epoch(S: int, K: int, engine_name: str = "dSGD",
     )
     y, w = jnp.asarray(np_y), jnp.asarray(np_w)
     state0 = init_train_state(
-        task, engine, opt, jax.random.PRNGKey(0), x[0, 0], num_sites=S
+        task, engine, opt, jax.random.PRNGKey(0), x[0, 0], num_sites=S,
+        staleness_bound=staleness_bound,
     )
+    live = None
+    if fault_plan is not None and fault_plan.injects_faults():
+        # rounds == steps at local_iterations=1; the first epoch's window
+        live = jnp.asarray(fault_plan.liveness(S, 0, d["steps"]))
     info = {
         "mesh_devices": int(mesh.devices.size),
         "wire_bytes_per_device_round": int(
@@ -646,12 +659,15 @@ def _setup_packed_epoch(S: int, K: int, engine_name: str = "dSGD",
     # trainer's _place_state move — avoids a warmup recompile)
     site_sh = NamedSharding(mesh, P(SITE_AXIS))
     x, y, w = (jax.device_put(a, site_sh) for a in (x, y, w))
+    if live is not None:
+        live = jax.device_put(live, site_sh)
     state0 = jax.tree.map(
         lambda a, spec: jax.device_put(a, NamedSharding(mesh, spec)),
         state0, _state_specs(state0),
     )
     epoch_fn = make_train_epoch_fn(
-        task, engine, opt, mesh=mesh, local_iterations=1
+        task, engine, opt, mesh=mesh, local_iterations=1,
+        staleness_bound=staleness_bound,
     )
 
     from dinunet_implementations_tpu.checks.sanitize import (
@@ -665,7 +681,7 @@ def _setup_packed_epoch(S: int, K: int, engine_name: str = "dSGD",
     )
 
     def run_chain(k: int) -> float:
-        t = chain_epochs(epoch_fn, state0, x, y, w, k)
+        t = chain_epochs(epoch_fn, state0, x, y, w, k, live=live)
         if guard is not None:
             guard.check(context=f"sites={S}, pack={K}, chain={k} epochs")
         return t
@@ -676,7 +692,8 @@ def _setup_packed_epoch(S: int, K: int, engine_name: str = "dSGD",
 def measure_sites_scaling(sites_list, packs=None, obs: int = 3,
                           n: int = TIMED_EPOCHS, dims: dict | None = None,
                           engine_name: str = "dSGD",
-                          engine_kw: dict | None = None) -> list[dict]:
+                          engine_kw: dict | None = None, fault_plan=None,
+                          staleness_bound: int = 0) -> list[dict]:
     """The sites-scaling sweep (``--sites``): for each virtual site count S,
     run the packed federated round on the available device mesh and emit one
     JSON record with ``sites`` / ``sites_per_chip`` / ``pack_factor`` — the
@@ -698,7 +715,8 @@ def measure_sites_scaling(sites_list, packs=None, obs: int = 3,
     for i, S in enumerate(sites_list):
         K = packs[i] if packs is not None else auto_pack(S, n_dev)
         run_chain, samples, info = _setup_packed_epoch(
-            S, K, engine_name=engine_name, engine_kw=engine_kw, dims=dims
+            S, K, engine_name=engine_name, engine_kw=engine_kw, dims=dims,
+            fault_plan=fault_plan, staleness_bound=staleness_bound,
         )
         run_chain(1)  # compile + warm up outside the timing
         pairs = [
@@ -724,6 +742,14 @@ def measure_sites_scaling(sites_list, packs=None, obs: int = 3,
             rec["engine_kw"] = engine_kw
         if dims:
             rec["dims"] = {**dims, "sites": S}
+        if fault_plan is not None:
+            rec["faults"] = fault_plan.to_json()
+            steps = (dims or {}).get("steps", STEPS_PER_EPOCH)
+            rec["dead_site_rounds"] = int(
+                (fault_plan.liveness(S, 0, steps) == 0).sum()
+            )
+        if staleness_bound:
+            rec["staleness_bound"] = staleness_bound
         records.append(rec)
     return records
 
@@ -796,9 +822,21 @@ def main():
         dims = SMALL_DIMS if "--small" in sys.argv else None
         engine_name = (sys.argv[sys.argv.index("--engine") + 1]
                        if "--engine" in sys.argv else "dSGD")
+        # churn smoke composition (r13): `--faults` threads a liveness mask
+        # (drops / delay_at stragglers) through the PACKED round, and
+        # `--staleness N` switches it to the buffered-async aggregation —
+        # one compiled program either way (asserted under --sanitize)
+        plan = None
+        if "--faults" in sys.argv:
+            from dinunet_implementations_tpu.robustness import parse_fault_plan
+
+            plan = parse_fault_plan(sys.argv[sys.argv.index("--faults") + 1])
+        staleness = (int(sys.argv[sys.argv.index("--staleness") + 1])
+                     if "--staleness" in sys.argv else 0)
         for rec in measure_sites_scaling(
             sites_list, packs=packs, obs=obs, n=n, dims=dims,
-            engine_name=engine_name,
+            engine_name=engine_name, fault_plan=plan,
+            staleness_bound=staleness,
         ):
             print(json.dumps(rec), flush=True)
         return

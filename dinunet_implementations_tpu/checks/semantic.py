@@ -658,6 +658,11 @@ class TraceCell:
     donate: bool = False
     dense_model: bool = False  # non-compressible fallback workload
     engine_kw: tuple = ()  # sorted (key, value) engine kwargs
+    # staleness_bound for the buffered-async aggregation mode (r13); 0 =
+    # the bulk-sync program. Async cells verify that the buffered round's
+    # collectives still carry exactly the modeled per-device wire (S002) —
+    # buffering happens in registers/HBM, never on the wire.
+    staleness: int = 0
 
     @property
     def label(self) -> str:
@@ -668,6 +673,8 @@ class TraceCell:
             name += f"@{self.precision_bits}"
         if self.donate:
             name += "+donate"
+        if self.staleness:
+            name += f"+async{self.staleness}"
         return f"{name}/{self.topology}/{self.pipeline}"
 
 
@@ -726,6 +733,7 @@ def build_cell_inputs(cell: TraceCell, engine=None) -> tuple:
     state = init_train_state(
         task, engine, opt, jax.random.PRNGKey(0),
         jnp.ones((B, D), jnp.float32), num_sites=S,
+        staleness_bound=cell.staleness,
     )
     rng = np.random.default_rng(0)
     if cell.pipeline == "device":
@@ -754,7 +762,7 @@ def trace_cell(cell: TraceCell, engine=None) -> CellProgram:
     task, engine, opt, state, args, mesh = build_cell_inputs(cell, engine)
     fn = make_train_epoch_fn(
         task, engine, opt, mesh=mesh, pipeline=cell.pipeline,
-        donate_state=cell.donate,
+        donate_state=cell.donate, staleness_bound=cell.staleness,
     )
     closed, _, comp = epoch_program_artifacts(fn, *args, compiled=cell.donate)
     S = args[1].shape[0]
@@ -805,6 +813,22 @@ def default_matrix() -> list:
         TraceCell("dSGD", "fold4", "device"),
         TraceCell("dSGD", "fold4", "host", precision_bits="16"),
     ]
+    # buffered-async cells (r13): every engine corner under the staleness
+    # mode on a real mesh — S001 (buffer selects stay inside the scan, no
+    # stray collectives) and S002 (the buffered round's wire is EXACTLY the
+    # bulk-sync wire: buffering spends HBM, never bytes) — plus a packed
+    # async corner (per-device buffers on the [K] block) and an async
+    # donation proof (the buffer leaves must alias like every other carried
+    # state, or async mode silently doubles a params-sized residency)
+    cells += [
+        TraceCell(name, "mesh", "host", engine_kw=kw, dense_model=dense,
+                  staleness=2)
+        for name, kw, dense in _ENGINE_CORNERS
+    ]
+    cells += [
+        TraceCell("dSGD", "fold", "device", staleness=2),
+        TraceCell("dSGD", "vmap", "device", donate=True, staleness=2),
+    ]
     # donation proof: compiled executables for the trainer's real default
     # (device pipeline + donated state) on both topologies
     cells += [
@@ -831,6 +855,11 @@ IDENTITY_CASES = {
     "sanitize-leaks": (None, True),
     "faults-opt-out": (dict(quarantine_rounds=-1), False),
     "telemetry-on": (dict(telemetry=True), False),
+    # elastic rounds (r13): staleness_bound=0 must compile the EXACT
+    # bulk-sync program (the async machinery statically out), and a positive
+    # bound must genuinely add the buffered round
+    "async-off": (dict(staleness_bound=0), True),
+    "async-on": (dict(staleness_bound=2), False),
 }
 
 

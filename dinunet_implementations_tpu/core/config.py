@@ -302,6 +302,17 @@ class TrainConfig:
     xprof_dir: str = ""
     # (first, last) epochs of the xprof capture window, 1-based inclusive
     xprof_window: tuple = (1, 1)
+    # buffered-async aggregation (r13 elastic rounds, trainer/steps.py): a
+    # positive bound switches every engine to staleness-bounded buffered
+    # aggregation — each virtual site's LAST deposited update keeps
+    # contributing, weighted by staleness_decay^age, until its age exceeds
+    # the bound (then masked exactly like a dead site). 0 (default) is the
+    # bulk-synchronous path, statically compiled to the exact legacy program
+    # (lowering-identical; checks/semantic.py S005 "async-off").
+    staleness_bound: int = 0
+    # per-round-of-age weight multiplier for buffered contributions; 1.0
+    # keeps stale updates at full weight until the bound cuts them off
+    staleness_decay: float = 0.5
     # fault tolerance (robustness/): a site whose round gradient is
     # non-finite for this many CONSECUTIVE rounds is quarantined — zero
     # weight for the rest of the fit, params advance on the live sites'

@@ -55,12 +55,26 @@ class SiteInventory:
         return self.inputs.nbytes + self.labels.nbytes
 
 
-def stack_site_inventory(sites: list["SiteArrays"]) -> SiteInventory:
+def stack_site_inventory(
+    sites: list["SiteArrays"], rows: int | None = None
+) -> SiteInventory:
     """Pad heterogeneous sites (73–120 subjects in the FS fixture) onto one
     dense ``[S, N_max, ...]`` grid. Host-side and cheap: one copy of the
-    dataset, paid once per fit instead of once per epoch."""
+    dataset, paid once per fit instead of once per epoch.
+
+    ``rows`` PINS ``N_max`` (elastic rounds, r13): the daemon-mode runner
+    re-stacks the inventory on every membership change, and a joining site
+    larger than any predecessor would otherwise grow the resident grid's
+    traced shape and retrace the epoch. Must cover the largest site (the
+    daemon enforces this at admission)."""
     n_max = max((len(s) for s in sites), default=0)
     assert n_max > 0, "all sites empty"
+    if rows is not None:
+        assert rows >= n_max, (
+            f"pinned inventory rows ({rows}) below the largest site "
+            f"({n_max} samples)"
+        )
+        n_max = rows
     feat_shape = next(s.inputs.shape[1:] for s in sites if len(s))
     S = len(sites)
     inputs = np.zeros((S, n_max) + feat_shape, np.float32)

@@ -114,6 +114,7 @@ def plan_epoch_positions(
     shuffle: bool = True,
     drop_last: bool = True,
     pad_mode: str = "wrap",
+    steps: int | None = None,
 ) -> EpochPlan:
     """Build the compact ``[S, steps, B]`` epoch plan (see module docstring).
 
@@ -121,8 +122,19 @@ def plan_epoch_positions(
     draw exactly the permutations the epoch needs, concatenate their
     batch-aligned prefixes, and reshape — no per-batch list concatenation
     (the RNG draw sequence is identical to the historical loop, so plans are
-    bit-stable across the refactor)."""
+    bit-stable across the refactor).
+
+    ``steps`` PINS the step-grid height instead of deriving it from the site
+    set (elastic rounds, r13): the daemon-mode runner's membership churns
+    between epochs, and a joining site with more batches than anyone before
+    it would otherwise grow the plan's traced shape and force a retrace. A
+    taller target recycles every site's shuffled order (wrap semantics); a
+    shorter one truncates the epoch's tail batches. The RNG draw sequence
+    for the natural prefix is unchanged, so ``steps=None`` callers are
+    byte-identical to before."""
     assert pad_mode in ("wrap", "mask")
+    target_steps = steps
+    assert target_steps is None or target_steps > 0, target_steps
     S = len(sites)
     feat_shape = None
     for s in sites:
@@ -182,6 +194,17 @@ def plan_epoch_positions(
             _site_batches(site, batch_size, order, drop_last)
         ):
             positions[si, bi, : len(ix)] = ix
+    if target_steps is not None and target_steps != steps:
+        if target_steps < steps:
+            # pinned grid shorter than natural: drop the tail batches
+            positions = positions[:, :target_steps]
+        else:
+            # pinned grid taller: recycle the epoch's batch sequence
+            # cyclically (wrap semantics at plan granularity; an all-padding
+            # mask row stays all padding). Deterministic — a pure function
+            # of (sites, seed, target), so prefetch/resume stay bit-exact.
+            reps = -(-target_steps // steps)
+            positions = np.tile(positions, (1, reps, 1))[:, :target_steps]
     return EpochPlan(positions)
 
 
@@ -215,13 +238,14 @@ def plan_epoch(
     shuffle: bool = True,
     drop_last: bool = True,
     pad_mode: str = "wrap",
+    steps: int | None = None,
 ) -> FedBatches:
     """Build the dense [S, steps, B, ...] epoch plan (see module docstring)."""
     return materialize_plan(
         sites,
         plan_epoch_positions(
             sites, batch_size, seed=seed, shuffle=shuffle,
-            drop_last=drop_last, pad_mode=pad_mode,
+            drop_last=drop_last, pad_mode=pad_mode, steps=steps,
         ),
     )
 

@@ -46,9 +46,12 @@ def _load():
 # fail transiently under load; retry the whole batch read briefly before
 # falling back to the Python reader. Malformed-file failures are deterministic
 # and burn two short sleeps — an accepted cost for not classifying the native
-# error string.
+# error string. The deadline/timeout pair (r13) turns a HUNG read — a dead
+# NFS mount blocks in the kernel, it does not error — into a fast fallback to
+# the Python reader instead of a wedged epoch.
 @with_retry(attempts=3, base_delay=0.05, max_delay=0.5,
-            retry_on=(NativeReadError,), describe="native aseg batch read")
+            retry_on=(NativeReadError,), describe="native aseg batch read",
+            deadline_s=30.0, timeout_s=10.0)
 def _read_batch_native(lib, paths: list[str], n_feats: int) -> np.ndarray:
     enc = [p.encode() for p in paths]
     arr = (ctypes.c_char_p * len(enc))(*enc)
@@ -71,12 +74,17 @@ def read_aseg_batch(paths: list[str], n_feats: int) -> np.ndarray | None:
     lib = _load()
     if lib is None or not paths or n_feats <= 0:
         return None
+    from ..robustness.retry import RetryTimeout
+
     try:
         return _read_batch_native(lib, paths, n_feats)
-    except NativeReadError as e:
+    # RetryTimeout: the read HUNG (dead NFS mount blocking in the kernel)
+    # and with_retry abandoned it — same fallback as a native parse error,
+    # which is the whole point of the r13 timeout
+    except (NativeReadError, RetryTimeout) as e:
         import logging
 
         logging.getLogger(__name__).warning(
-            "native aseg parse failed (%s); falling back to the Python reader", e
+            "native aseg read failed (%s); falling back to the Python reader", e
         )
         return None

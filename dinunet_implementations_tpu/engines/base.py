@@ -21,6 +21,20 @@ Engines must be shape/dtype-preserving on the gradient pytree and jit-safe
 (static control flow only; the liveness mask is a traced value, so a changing
 fault pattern never recompiles).
 
+Buffered-async rounds (r13 — the fourth aggregation semantics,
+``TrainConfig.staleness_bound > 0``): the trainer no longer hands the engine
+this round's fresh gradients but each slot's last DEPOSITED update from the
+per-slot staleness buffer riding ``TrainState.buffers``
+(:func:`default_async_buffers`), with the example weight already scaled by
+the staleness decay (:func:`staleness_weights`: ``decay^age``, hard-zeroed
+past ``staleness_bound`` — a too-stale contribution is masked exactly like a
+dead site). The engine math is UNCHANGED: ``aggregate`` still sees
+``(grads, state, weight, axis_name, live)`` with a 0/1 ``live`` gate and a
+float weight; the weighted mean renormalizing over live weight is precisely
+what makes the staleness decay a first-class aggregation weight. With
+``staleness_bound=0`` none of this exists — the epoch compiles the exact
+bulk-sync program (S005-gated, checks/semantic.py).
+
 Telemetry (telemetry/metrics.py): an engine may also carry ``wire_bytes``, a
 STATIC model ``(grads_template, pack=1) -> bytes`` of its per-round
 PER-PHYSICAL-DEVICE collective payload (what one collective member actually
@@ -80,6 +94,44 @@ def mask_dead_site(grads, weight, live):
         grads,
     )
     return grads, weight * alive.astype(jnp.float32)
+
+
+#: ``age`` value marking a slot whose buffer has never been deposited into
+#: (fresh join / fresh init): astronomically stale, so both the staleness
+#: bound and the zero deposited weight exclude it. Far below int32 overflow
+#: even after one increment per round for the longest conceivable fit.
+ASYNC_NEVER_AGE = 1 << 20
+
+
+def default_async_buffers(num_sites: int, params) -> dict:
+    """Fresh per-slot staleness buffers with the per-site leading axis:
+    ``grads`` (the slot's last deposited update, zeros until one arrives),
+    ``weight`` (its example weight at deposit time, 0 = never deposited) and
+    ``age`` (rounds since deposit, :data:`ASYNC_NEVER_AGE` = never). Rides
+    ``TrainState.buffers`` sharded ``P(site)`` like engine state; distinct
+    arrays so state donation never aliases a buffer twice."""
+    import jax.numpy as jnp
+
+    return {
+        "grads": jax.tree.map(
+            lambda p: jnp.zeros((num_sites,) + p.shape, p.dtype), params
+        ),
+        "weight": jnp.zeros((num_sites,), jnp.float32),
+        "age": jnp.full((num_sites,), ASYNC_NEVER_AGE, jnp.int32),
+    }
+
+
+def staleness_weights(age, staleness_bound: int, staleness_decay: float):
+    """The buffered-async aggregation weight multiplier per slot:
+    ``decay^age`` while ``age <= staleness_bound``, hard 0 past it (a
+    contribution older than the bound is masked exactly like a dead site).
+    ``age == 0`` (deposited THIS round) yields exactly 1.0, which is what
+    makes the all-arrivals async round bit-identical to the bulk-sync path.
+    ``staleness_bound``/``staleness_decay`` are trace-time statics; ``age``
+    is traced, so churn/straggle patterns never recompile."""
+    af = age.astype(jnp.float32)
+    fresh = (age <= staleness_bound).astype(jnp.float32)
+    return fresh * jnp.power(jnp.float32(staleness_decay), af)
 
 
 @dataclass(frozen=True)

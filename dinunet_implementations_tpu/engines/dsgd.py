@@ -51,6 +51,11 @@ def make_dsgd(precision_bits="32", **_unused) -> Engine:
     def aggregate(grads, state, weight, axis_name, live=None):
         # dead/quarantined sites: payload zeroed, weight zeroed — the
         # weighted mean renormalizes over live weight only (robustness/).
+        # Buffered-async rounds (engines/base.py, r13): `grads` is each
+        # slot's last DEPOSITED update and `weight` already carries the
+        # staleness decay — the renormalizing weighted mean below is what
+        # turns that decay into a first-class aggregation weight; no
+        # engine-side change.
         # Packed axes (leaves carrying the leading [K] virtual-site axis):
         # the local weighted partial is reduced over the pack axis and
         # re-quantized to the payload dtype before the single cross-device

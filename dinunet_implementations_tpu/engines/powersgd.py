@@ -108,6 +108,11 @@ def make_powersgd(
         # and the global Ĝ is the live sites' weighted mean. The trainer
         # freezes a dead site's q/e across the round (trainer/steps.py), so
         # error feedback resumes where it left off when the site returns.
+        # Buffered-async rounds (engines/base.py, r13): G is each slot's
+        # last DEPOSITED update, `weight` carries the staleness decay, and a
+        # stale-in-bound slot's error feedback keeps compressing its
+        # buffered gradient — the decayed scale flows through P/Q' exactly
+        # like a fractional liveness weight; no engine-side change.
         grads, weight = mask_dead_site(grads, weight, live)
         scale = site_weight_scale(weight, axis_name)
         packed = isinstance(axis_name, PackedAxis)

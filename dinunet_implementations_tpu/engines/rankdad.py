@@ -130,6 +130,12 @@ def make_rankdad(
         # weighted mean. Its warm-start Ω is frozen by the trainer for the
         # round (trainer/steps.py), keeping the subspace for its return.
         #
+        # Buffered-async rounds (engines/base.py, r13): the inputs are each
+        # slot's last DEPOSITED update with staleness-decayed weight; a
+        # stale-but-in-bound slot re-factorizes its buffered gradient each
+        # round (same program), its Q·scale payload shrinking with age —
+        # the decay rides the exact same weighted-factor path as liveness.
+        #
         # Packed axes (leaves carrying a leading [K] virtual-site axis): the
         # factorization vmaps over the pack axis, the device's whole [K, …]
         # factor block ships in one gather (the genuinely K-scaling half of

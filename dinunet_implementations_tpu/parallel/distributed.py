@@ -33,11 +33,22 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 _initialized = False
 
+#: wall-clock budget for the whole coordinator join (retries included): a
+#: coordinator that never comes up fails the worker in ~2 minutes instead of
+#: retrying forever — preemptible fleets must recycle the slot, not camp on it
+JOIN_DEADLINE_S = 120.0
+#: per-attempt cap: one hung initialize (half-open TCP, wedged coordinator)
+#: is abandoned to its worker thread and retried, instead of blocking the
+#: process indefinitely (robustness/retry.py timeout_s semantics)
+JOIN_ATTEMPT_TIMEOUT_S = 45.0
+
 
 def distributed_init(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
+    join_deadline_s: float | None = JOIN_DEADLINE_S,
+    join_timeout_s: float | None = JOIN_ATTEMPT_TIMEOUT_S,
     **kwargs,
 ) -> bool:
     """Join (or skip joining) the multi-host runtime.
@@ -54,7 +65,12 @@ def distributed_init(
 
     A worker that comes up before its coordinator (pod rollout races, spot
     restarts) retries the join under jittered exponential backoff
-    (robustness/retry.py) instead of dying on the first refused connection.
+    (robustness/retry.py) instead of dying on the first refused connection —
+    but fail-FAST, not forever: ``join_deadline_s`` bounds the whole join
+    wall-clock and ``join_timeout_s`` abandons a single hung attempt (a
+    wedged coordinator that accepts the TCP connect and then never
+    completes the handshake used to hang the worker indefinitely). Pass
+    ``None`` for either to restore the unbounded behavior.
     """
     global _initialized
     if coordinator_address is None and num_processes in (None, 1):
@@ -104,6 +120,13 @@ def distributed_init(
         base_delay=0.5,
         retry_on=(RuntimeError, OSError, ConnectionError),
         describe="jax.distributed.initialize",
+        deadline_s=join_deadline_s,
+        timeout_s=join_timeout_s,
+        # a TIMED-OUT join is fatal, not retryable: the abandoned attempt's
+        # thread may still be mutating jax's global distributed state, and a
+        # concurrent re-initialize would race it — fast failures (refused
+        # connect) still retry through retry_on
+        retry_on_timeout=False,
     )()
     _initialized = True
     return True

@@ -16,7 +16,13 @@ that contract:
 - :mod:`preemption` — SIGTERM/SIGINT save-and-exit for preemptible workers
   (:class:`PreemptionGuard`, :class:`Preempted`);
 - :mod:`retry` — jittered exponential backoff for transient failures
-  (``distributed_init``, native IO reads).
+  (``distributed_init``, native IO reads), with wall-clock deadlines and
+  per-attempt timeouts so a HUNG remote fails fast instead of retrying
+  forever;
+- :mod:`membership` — the elastic-rounds membership table (r13): logical
+  sites mapped onto a fixed padded virtual-site axis, join/leave/rejoin as
+  pure state transitions with generation counters and host-side slot-state
+  resets — churn never retraces the epoch program.
 
 The liveness-mask/quarantine math itself lives *inside* the compiled epoch
 (trainer/steps.py + the engines' ``live`` argument): masks are traced array
@@ -25,17 +31,30 @@ inputs, so a different fault pattern never recompiles the program.
 
 from .faults import FaultPlan, fault_window, parse_fault_plan, poison_inputs
 from .health import default_health, health_summary
+from .membership import (
+    MembershipError,
+    MembershipTable,
+    membership_rollup,
+    move_slot_state,
+    reset_slot_state,
+)
 from .preemption import Preempted, PreemptionGuard
-from .retry import with_retry
+from .retry import RetryTimeout, with_retry
 
 __all__ = [
     "FaultPlan",
     "fault_window",
+    "MembershipError",
+    "MembershipTable",
+    "membership_rollup",
+    "move_slot_state",
     "Preempted",
     "PreemptionGuard",
     "default_health",
     "health_summary",
     "parse_fault_plan",
     "poison_inputs",
+    "reset_slot_state",
+    "RetryTimeout",
     "with_retry",
 ]

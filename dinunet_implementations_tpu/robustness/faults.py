@@ -17,7 +17,16 @@ run should experience:
   shortcut in the test;
 - ``kill_at_round``: simulated preemption — the trainer saves a checkpoint
   and raises :class:`~.preemption.Preempted` once the global round counter
-  passes this value (the deterministic arm of the SIGTERM handler).
+  passes this value (the deterministic arm of the SIGTERM handler);
+- ``delay_at``: deterministic STRAGGLERS — ``(site, round, delay)`` triples:
+  the site's fresh update for rounds ``[round, round + delay)`` never
+  arrives (it is "in flight" for ``delay`` rounds). In the bulk-sync
+  engines this is indistinguishable from a drop — an update that misses its
+  round is lost. Under the buffered-async mode
+  (``TrainConfig.staleness_bound > 0``, trainer/steps.py) the site's LAST
+  deposited update keeps contributing with staleness-decayed weight until
+  the bound masks it — exactly the semantics the staleness buffer exists
+  for, exercisable from this same chaos harness.
 
 Masks are plain numpy arrays fed to the compiled epoch as traced inputs:
 changing the plan never recompiles the program. ``site`` indices are always
@@ -58,10 +67,12 @@ class FaultPlan:
     flaky_seed: int = 0
     nan_at: tuple = ()  # (round, site) pairs
     kill_at_round: int | None = None
+    delay_at: tuple = ()  # (site, round, delay) straggler triples
 
     def __post_init__(self):
         object.__setattr__(self, "drop", _tuplize(self.drop, 3, "drop"))
         object.__setattr__(self, "nan_at", _tuplize(self.nan_at, 2, "nan_at"))
+        object.__setattr__(self, "delay_at", _tuplize(self.delay_at, 3, "delay_at"))
         if not 0.0 <= float(self.flaky_prob) <= 1.0:
             raise ValueError(
                 f"FaultPlan.flaky_prob must be in [0, 1], got {self.flaky_prob}"
@@ -72,6 +83,12 @@ class FaultPlan:
         for rnd, site in self.nan_at:
             if rnd < 0 or site < 0:
                 raise ValueError(f"bad FaultPlan.nan_at entry {(rnd, site)}")
+        for site, rnd, delay in self.delay_at:
+            if site < 0 or rnd < 0 or delay < 1:
+                raise ValueError(
+                    f"bad FaultPlan.delay_at entry {(site, rnd, delay)} "
+                    "(need site >= 0, round >= 0, delay >= 1)"
+                )
 
     # -- round-window mask generation ------------------------------------
 
@@ -109,6 +126,17 @@ class FaultPlan:
             hi = num_rounds if last == -1 else min(last + 1 - round_start, num_rounds)
             if lo < hi:
                 live[site, lo:hi] = 0.0
+        for site, rnd, delay in self.delay_at:
+            # a straggling update is a missing ARRIVAL for its in-flight
+            # window: zero liveness for [round, round + delay) — the async
+            # buffer (trainer/steps.py) then serves the site's previous
+            # deposit, decayed; the sync engines see a plain drop
+            if site >= num_sites:
+                continue
+            lo = max(rnd - round_start, 0)
+            hi = min(rnd + delay - round_start, num_rounds)
+            if lo < hi:
+                live[site, lo:hi] = 0.0
         if self.flaky_prob > 0.0:
             draws = self._flaky_uniform(num_sites, round_start, num_rounds)
             live[draws < self.flaky_prob] = 0.0
@@ -125,9 +153,12 @@ class FaultPlan:
         return mask
 
     def injects_faults(self) -> bool:
-        """True when the plan perturbs training rounds (drops / flaky / NaN) —
-        a kill-only plan needs no per-round masks."""
-        return bool(self.drop) or self.flaky_prob > 0.0 or bool(self.nan_at)
+        """True when the plan perturbs training rounds (drops / flaky / NaN /
+        stragglers) — a kill-only plan needs no per-round masks."""
+        return (
+            bool(self.drop) or self.flaky_prob > 0.0 or bool(self.nan_at)
+            or bool(self.delay_at)
+        )
 
     # -- JSON round-trip (CLI / bench surface) ---------------------------
 
@@ -138,6 +169,7 @@ class FaultPlan:
             "flaky_seed": self.flaky_seed,
             "nan_at": [list(t) for t in self.nan_at],
             "kill_at_round": self.kill_at_round,
+            "delay_at": [list(t) for t in self.delay_at],
         }
 
     @classmethod

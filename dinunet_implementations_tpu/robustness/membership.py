@@ -1,0 +1,320 @@
+"""Membership table — logical sites floating over a fixed virtual-site axis.
+
+The elastic-rounds layer (r13) separates WHO is training from WHERE they
+compute. The compiled epoch program's site axis is a fixed ``[capacity]``
+padded virtual-site axis (the ``S_max`` every per-site array — inventory,
+index plans, engine/health/telemetry state, staleness buffers — is shaped
+to); logical sites (``"hospital-7"``) map onto slots of that axis through a
+:class:`MembershipTable`. Join, leave and rejoin are PURE STATE TRANSITIONS
+on the table plus a host-side slot-state reset — never a retrace: the slot
+count, and with it every traced shape, is pinned for the life of the
+service, and an unoccupied slot is simply a site whose update never arrives
+(the PR 2 liveness mask generalized from "dead" to "not here (yet)"). The
+daemon-mode FedRunner (runner/fed_runner.py FedDaemon) drives this table
+from a filesystem ingest spool.
+
+Key invariants:
+
+- **Slot assignment is dense-first**: a join takes the LOWEST free slot, so
+  occupancy stays packed toward the front of the axis and — under site
+  packing (r12) — spreads evenly across the per-device ``[K]`` blocks as
+  the table fills. :meth:`rebalance` computes explicit moves when churn has
+  fragmented occupancy across device blocks.
+- **Generation counters**: every (re)join of a logical site increments its
+  generation. A rejoining site can therefore never resurrect stale slot
+  state — the daemon resets the slot's engine/health/telemetry/buffer rows
+  (:func:`reset_slot_state`) at every assignment, and the generation is the
+  auditable record that incarnation N+1 started fresh.
+- **Membership epochs**: every transition bumps ``epoch``; the daemon
+  checkpoints on membership-epoch boundaries with the table serialized into
+  the checkpoint meta, so a resumed service restores the exact slot map.
+
+The table is an immutable dataclass (transitions return new tables) and
+holds NO jax state — it is host-side bookkeeping the compiled program never
+sees except through the occupancy mask (a traced input).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+import numpy as np
+
+
+class MembershipError(ValueError):
+    """An invalid membership transition (duplicate join, unknown leave,
+    table full)."""
+
+
+@dataclass(frozen=True)
+class MembershipTable:
+    """Immutable logical-site → virtual-slot map (see module docstring)."""
+
+    capacity: int  # S_max — the padded virtual-site axis width
+    slots: tuple = ()  # [capacity] of site id | None (free)
+    generations: tuple = ()  # [capacity] int — current occupant's generation
+    known: tuple = ()  # sorted (site_id, last_generation) join history
+    epoch: int = 0  # membership epoch; bumps on every transition
+
+    def __post_init__(self):
+        if self.capacity < 1:
+            raise MembershipError(
+                f"capacity must be >= 1, got {self.capacity}"
+            )
+        if not self.slots:
+            object.__setattr__(self, "slots", (None,) * self.capacity)
+            object.__setattr__(self, "generations", (0,) * self.capacity)
+        if len(self.slots) != self.capacity or len(self.generations) != self.capacity:
+            raise MembershipError(
+                f"slots/generations length must equal capacity "
+                f"({self.capacity}), got {len(self.slots)}/"
+                f"{len(self.generations)}"
+            )
+
+    # -- queries ---------------------------------------------------------
+
+    def slot_of(self, site_id: str) -> int | None:
+        try:
+            return self.slots.index(site_id)
+        except ValueError:
+            return None
+
+    def members(self) -> dict:
+        """``{site_id: slot}`` for every occupied slot."""
+        return {s: i for i, s in enumerate(self.slots) if s is not None}
+
+    @property
+    def occupied(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    def occupancy(self) -> np.ndarray:
+        """``[capacity]`` float32 mask: 1 = occupied. Multiplied into the
+        per-round liveness mask (a traced input), this is the ONLY way
+        membership reaches the compiled program — no shape ever changes."""
+        return np.array(
+            [0.0 if s is None else 1.0 for s in self.slots], np.float32
+        )
+
+    def generation_of(self, site_id: str) -> int:
+        """Current (or, for a departed site, last) generation; 0 = never
+        joined."""
+        slot = self.slot_of(site_id)
+        if slot is not None:
+            return self.generations[slot]
+        return dict(self.known).get(site_id, 0)
+
+    # -- transitions (pure; each returns a NEW table) --------------------
+
+    def join(self, site_id: str) -> tuple:
+        """Admit ``site_id`` into the lowest free slot. Returns ``(table,
+        slot, generation)``; a REJOIN (a site seen before) gets generation
+        ``last + 1`` — the daemon resets the slot's state rows at every
+        assignment, and the bumped generation is the record that stale
+        engine state from a previous incarnation cannot resurrect."""
+        if site_id is None or not str(site_id):
+            raise MembershipError("site id must be a non-empty string")
+        if self.slot_of(site_id) is not None:
+            raise MembershipError(f"site {site_id!r} is already a member")
+        try:
+            slot = self.slots.index(None)
+        except ValueError:
+            raise MembershipError(
+                f"membership table full ({self.capacity} slots); "
+                f"cannot admit {site_id!r}"
+            ) from None
+        gen = dict(self.known).get(site_id, 0) + 1
+        slots = list(self.slots)
+        gens = list(self.generations)
+        slots[slot] = site_id
+        gens[slot] = gen
+        known = dict(self.known)
+        known[site_id] = gen
+        table = dataclasses.replace(
+            self, slots=tuple(slots), generations=tuple(gens),
+            known=tuple(sorted(known.items())), epoch=self.epoch + 1,
+        )
+        return table, slot, gen
+
+    def leave(self, site_id: str) -> tuple:
+        """Release ``site_id``'s slot. Returns ``(table, freed_slot)``."""
+        slot = self.slot_of(site_id)
+        if slot is None:
+            raise MembershipError(f"site {site_id!r} is not a member")
+        slots = list(self.slots)
+        gens = list(self.generations)
+        slots[slot] = None
+        gens[slot] = 0
+        table = dataclasses.replace(
+            self, slots=tuple(slots), generations=tuple(gens),
+            epoch=self.epoch + 1,
+        )
+        return table, slot
+
+    def rebalance(self, num_blocks: int) -> tuple:
+        """Even out occupancy across ``num_blocks`` contiguous slot blocks
+        (the per-device ``[K]`` packing granules, r12). Returns ``(table,
+        moves)`` with ``moves`` a list of ``(site_id, src_slot, dst_slot)``
+        the caller must mirror onto the carried state rows
+        (:func:`move_slot_state`) — data follows automatically because the
+        inventory is rebuilt from the slot map. Generations do NOT bump (the
+        same incarnation keeps its warm state); the membership epoch bumps
+        once when any move happens."""
+        if num_blocks < 1 or self.capacity % num_blocks:
+            raise MembershipError(
+                f"num_blocks={num_blocks} must divide capacity "
+                f"({self.capacity})"
+            )
+        k = self.capacity // num_blocks
+        slots = list(self.slots)
+        gens = list(self.generations)
+        moves = []
+        while True:
+            counts = [
+                sum(1 for s in slots[b * k:(b + 1) * k] if s is not None)
+                for b in range(num_blocks)
+            ]
+            hi, lo = max(counts), min(counts)
+            if hi - lo <= 1:
+                break
+            src_b = counts.index(hi)
+            dst_b = counts.index(lo)
+            src = next(
+                i for i in range(src_b * k, (src_b + 1) * k)
+                if slots[i] is not None
+            )
+            dst = next(
+                i for i in range(dst_b * k, (dst_b + 1) * k)
+                if slots[i] is None
+            )
+            moves.append((slots[src], src, dst))
+            slots[dst], gens[dst] = slots[src], gens[src]
+            slots[src], gens[src] = None, 0
+        if not moves:
+            return self, []
+        table = dataclasses.replace(
+            self, slots=tuple(slots), generations=tuple(gens),
+            epoch=self.epoch + 1,
+        )
+        return table, moves
+
+    # -- (de)serialization — the daemon checkpoints the table in meta ----
+
+    def to_json(self) -> dict:
+        return {
+            "capacity": self.capacity,
+            "slots": list(self.slots),
+            "generations": list(self.generations),
+            "known": [list(kv) for kv in self.known],
+            "epoch": self.epoch,
+        }
+
+    @classmethod
+    def from_json(cls, spec: dict) -> "MembershipTable":
+        return cls(
+            capacity=int(spec["capacity"]),
+            slots=tuple(spec["slots"]),
+            generations=tuple(int(g) for g in spec["generations"]),
+            known=tuple((k, int(g)) for k, g in spec.get("known", [])),
+            epoch=int(spec.get("epoch", 0)),
+        )
+
+
+# ---------------------------------------------------------------------------
+# slot-state surgery (host-side, between epochs — never inside the compiled
+# epoch, so CompileGuard's one-epoch-program assertion is untouched)
+# ---------------------------------------------------------------------------
+
+
+def _set_row(leaf, slot: int, row):
+    import jax.numpy as jnp
+
+    return leaf.at[slot].set(jnp.asarray(row, leaf.dtype))
+
+
+def reset_slot_state(state, slot: int, engine=None):
+    """Fresh per-site state rows for ``slot``: engine state re-initialized
+    (``engine.init`` on the current params — None keeps existing rows, for
+    engines with empty state), health counters zeroed, telemetry
+    accumulators zeroed, staleness buffer emptied (zero weight,
+    never-deposited age). Called at every slot ASSIGNMENT, so a rejoining
+    site starts its new generation clean — stale engine/health state from a
+    previous incarnation cannot resurrect. ``state`` is any TrainState-like
+    flax struct; returns the updated state."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..engines.base import ASYNC_NEVER_AGE
+
+    if engine is not None and state.engine_state is not None:
+        fresh = engine.init(state.params)
+        state = state.replace(engine_state=jax.tree.map(
+            lambda leaf, row: _set_row(leaf, slot, row),
+            state.engine_state, fresh,
+        ))
+    if state.health is not None:
+        state = state.replace(health=jax.tree.map(
+            lambda leaf: _set_row(leaf, slot, jnp.zeros((), leaf.dtype)),
+            state.health,
+        ))
+    if state.telemetry is not None:
+        state = state.replace(telemetry=jax.tree.map(
+            lambda leaf: _set_row(leaf, slot, jnp.zeros((), leaf.dtype)),
+            state.telemetry,
+        ))
+    if state.buffers is not None:
+        bufs = dict(state.buffers)
+        bufs["grads"] = jax.tree.map(
+            lambda leaf: _set_row(leaf, slot, jnp.zeros(leaf.shape[1:])),
+            bufs["grads"],
+        )
+        bufs["weight"] = _set_row(bufs["weight"], slot, 0.0)
+        bufs["age"] = _set_row(bufs["age"], slot, ASYNC_NEVER_AGE)
+        state = state.replace(buffers=bufs)
+    return state
+
+
+def move_slot_state(state, src: int, dst: int, engine=None):
+    """Copy every per-site state row from slot ``src`` to ``dst`` (a
+    rebalance move: the SAME incarnation keeps its warm engine state /
+    health / buffers at its new slot), then reset ``src``."""
+    import jax
+
+    def mv(tree):
+        return jax.tree.map(lambda leaf: leaf.at[dst].set(leaf[src]), tree)
+
+    if state.engine_state is not None:
+        state = state.replace(engine_state=mv(state.engine_state))
+    if state.health is not None:
+        state = state.replace(health=mv(state.health))
+    if state.telemetry is not None:
+        state = state.replace(telemetry=mv(state.telemetry))
+    if state.buffers is not None:
+        state = state.replace(buffers=mv(state.buffers))
+    return reset_slot_state(state, src, engine=engine)
+
+
+def membership_rollup(
+    table: MembershipTable, state=None, held_rounds: int = 0,
+) -> dict:
+    """Host-side summary for the telemetry sink / ``telemetry.report``:
+    slots occupied, mean staleness of the occupied slots' buffers (None when
+    the run is bulk-sync or nothing has deposited yet), and how many rounds
+    the quorum floor held back."""
+    from ..engines.base import ASYNC_NEVER_AGE
+
+    mean_staleness = None
+    buffers = getattr(state, "buffers", None) if state is not None else None
+    if buffers is not None:
+        ages = np.asarray(buffers["age"])
+        occ = table.occupancy() > 0
+        deposited = occ & (ages < ASYNC_NEVER_AGE)
+        if deposited.any():
+            mean_staleness = float(ages[deposited].mean())
+    return {
+        "slots_occupied": int(table.occupied),
+        "capacity": int(table.capacity),
+        "membership_epoch": int(table.epoch),
+        "mean_staleness": mean_staleness,
+        "held_rounds": int(held_rounds),
+    }

@@ -1,2 +1,9 @@
-from .fed_runner import FedRunner, SiteRunner, discover_site_dirs, load_site_splits
+from .fed_runner import (
+    FedDaemon,
+    FedRunner,
+    SiteRunner,
+    auto_site_mesh,
+    discover_site_dirs,
+    load_site_splits,
+)
 from .registry import TASKS, TaskSpec, get_task, register_task, task_cache

@@ -70,6 +70,34 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--site", type=int, default=None,
                    help="single-site mode: run only this site index "
                         "(SiteRunner parity)")
+    p.add_argument("--serve", action="store_true",
+                   help="daemon mode (elastic rounds, r13): a persistent "
+                        "service over one compiled epoch program with a "
+                        "fixed virtual-site axis; sites join/leave/rejoin "
+                        "via JSON events in the ingest spool "
+                        "(runner/fed_runner.py FedDaemon). The tree's "
+                        "local* sites pre-join; combine with --set "
+                        "staleness_bound=N for buffered-async aggregation")
+    p.add_argument("--serve-spool", default=None, metavar="DIR",
+                   help="ingest spool directory (default "
+                        "<data-path>/spool): join/leave/shutdown events as "
+                        "*.json files, processed in sorted order")
+    p.add_argument("--serve-capacity", type=int, default=None,
+                   help="virtual-site slots (S_max) — fixes every traced "
+                        "shape for the life of the service; default: the "
+                        "discovered site count")
+    p.add_argument("--serve-quorum", type=int, default=1,
+                   help="minimum occupied slots; below it rounds HOLD "
+                        "rather than aggregate (default 1)")
+    p.add_argument("--serve-epochs", type=int, default=None,
+                   help="stop after this many trained epochs (default: "
+                        "serve until a shutdown event or SIGTERM)")
+    p.add_argument("--serve-poll", type=float, default=0.5,
+                   help="idle spool poll interval in seconds (default 0.5)")
+    p.add_argument("--serve-rows", type=int, default=None,
+                   help="pinned inventory rows per slot (headroom for "
+                        "bigger sites joining later; default: the first "
+                        "admitted site's size)")
     p.add_argument("--folds", type=int, nargs="*", default=None,
                    help="run only these fold indices")
     p.add_argument("--resume", action="store_true",
@@ -190,6 +218,44 @@ def main(argv: list[str] | None = None) -> int:
             fault_plan = parse_fault_plan(args.faults)
         except (ValueError, OSError, TypeError) as e:
             raise SystemExit(f"--faults: {e}")
+
+    if args.serve:
+        if args.site is not None or args.folds is not None:
+            raise SystemExit(
+                "--serve is the daemon mode; --site/--folds are batch-mode "
+                "options"
+            )
+        from ..checks.sanitize import SanitizerViolation
+        from .fed_runner import FedDaemon, discover_site_dirs
+
+        capacity = args.serve_capacity or len(discover_site_dirs(args.data_path))
+        daemon = FedDaemon(
+            cfg,
+            capacity=capacity,
+            spool_dir=args.serve_spool,
+            out_dir=args.out_dir,
+            data_path=args.data_path,
+            quorum=args.serve_quorum,
+            poll_s=args.serve_poll,
+            fault_plan=fault_plan,
+            inventory_rows=args.serve_rows,
+            resume=args.resume,
+            verbose=verbose,
+        )
+        try:
+            # DINUNET_SANITIZE / --sanitize: the one-epoch-compile guard
+            # wraps the WHOLE service — any churn-induced retrace trips it
+            from ..checks.sanitize import sanitized_fit
+
+            with sanitized_fit(daemon.trainer, label="serve"):
+                summary = daemon.serve(max_epochs=args.serve_epochs)
+        except SanitizerViolation as v:
+            print(json.dumps({"sanitizer_violation": str(v)}), file=sys.stderr)
+            return 70
+        from ..telemetry.sink import _finite
+
+        print(json.dumps(_finite(summary), default=str))
+        return 0
 
     if args.site is not None:
         if args.folds is not None or args.resume:

@@ -9,18 +9,30 @@
   fixture convention), builds per-site datasets/splits, and trains them as one
   SPMD program on a site mesh (or folded onto one chip with ``mesh=None``).
   Supports split-ratio and k-fold drivers.
+- :class:`FedDaemon` — the long-running SERVICE form (elastic rounds, r13):
+  a persistent loop over one compiled epoch program with a fixed
+  ``[capacity]`` virtual-site axis, absorbing site joins / leaves / rejoins
+  from a filesystem ingest spool (``robustness/membership.py``
+  MembershipTable), holding rounds below a quorum floor, checkpointing on
+  membership epochs, and — with ``TrainConfig.staleness_bound > 0`` —
+  aggregating under the staleness-bounded buffered-async semantics so
+  stragglers fade instead of stalling. CLI: ``dinunet-tpu --serve``.
 """
 
 from __future__ import annotations
 
 import glob
+import json
 import os
 import re
+import time
+
+import numpy as np
 
 from ..core.config import TrainConfig, resolve_site_configs
-from ..data.api import build_site_dataset
+from ..data.api import SiteArrays, build_site_dataset
 from ..data.splits import resolve_splits
-from ..parallel.mesh import host_mesh, packed_site_mesh
+from ..parallel.mesh import SITE_AXIS, host_mesh, packed_site_mesh
 from ..trainer.loop import FederatedTrainer
 from .registry import get_task, task_cache
 
@@ -48,6 +60,55 @@ def discover_site_dirs(dataset_dir: str) -> list[str]:
     pattern = os.path.join(dataset_dir, "input", "local*", "simulatorRun")
     dirs = sorted(glob.glob(pattern), key=_site_dir_key)
     return dirs or [dataset_dir]
+
+
+def auto_site_mesh(cfg: TrainConfig, num_sites: int):
+    """Resolve the ``mesh="auto"`` topology for ``num_sites`` virtual sites:
+    multi-host hybrid mesh when a distributed runtime is up, the packed
+    ``(site, model)`` mesh when the devices fit (k = cfg.sites_per_device
+    virtual sites per member, r12), CPU host devices as the simulator
+    fallback, and ``None`` (fold every site onto one device via vmap)
+    otherwise. Shared by the batch :class:`FedRunner` and the daemon-mode
+    :class:`FedDaemon`, so both resolve churn-capacity and fold topologies
+    identically."""
+    import jax
+
+    m = max(cfg.model_axis_size, 1)
+    k = max(cfg.sites_per_device, 1)
+    if num_sites % k:
+        raise ValueError(
+            f"sites_per_device={k} must divide the site count ({num_sites})"
+        )
+    n_mesh = num_sites // k  # mesh site-axis size; k sites pack per device
+    devs = jax.devices()
+    cpus = [d for d in devs if d.platform == "cpu"]
+    if jax.process_count() > 1:
+        # multi-host runtime (distributed_init): hybrid mesh — the model
+        # axis stays on each host's ICI, sites span DCN
+        from ..parallel.distributed import multihost_site_mesh
+
+        if n_mesh % jax.process_count():
+            raise ValueError(
+                f"{n_mesh} mesh sites must divide evenly over "
+                f"{jax.process_count()} processes"
+            )
+        return multihost_site_mesh(
+            sites_per_process=n_mesh // jax.process_count(),
+            model_axis_size=m,
+        )
+    if len(devs) >= n_mesh * m:
+        # the packed topology (parallel/mesh.py): k virtual sites per mesh
+        # member, two-level aggregation in the epoch
+        return packed_site_mesh(num_sites, k, devs, model_axis_size=m)
+    if len(cpus) >= n_mesh * m:
+        return host_mesh(n_mesh, model_axis_size=m)
+    if m > 1:
+        raise ValueError(
+            f"model_axis_size={m} with {n_mesh} mesh sites needs "
+            f"{n_mesh * m} devices (have {len(devs)}); sequence "
+            "parallelism cannot fold onto one device"
+        )
+    return None  # fold all sites onto the local device via vmap
 
 
 def load_site_splits(
@@ -115,46 +176,7 @@ class FedRunner:
         self.cfg = self.site_cfgs[0].replace(num_sites=len(self.site_dirs))
         self.out_dir = out_dir or os.path.join(data_path, "output")
         if mesh == "auto":
-            import jax
-
-            n = len(self.site_dirs)
-            m = max(self.cfg.model_axis_size, 1)
-            k = max(self.cfg.sites_per_device, 1)
-            if n % k:
-                raise ValueError(
-                    f"sites_per_device={k} must divide the site count ({n})"
-                )
-            n_mesh = n // k  # mesh site-axis size; k sites pack per device
-            devs = jax.devices()
-            cpus = [d for d in devs if d.platform == "cpu"]
-            if jax.process_count() > 1:
-                # multi-host runtime (distributed_init): hybrid mesh — the
-                # model axis stays on each host's ICI, sites span DCN
-                from ..parallel.distributed import multihost_site_mesh
-
-                if n_mesh % jax.process_count():
-                    raise ValueError(
-                        f"{n_mesh} mesh sites must divide evenly over "
-                        f"{jax.process_count()} processes"
-                    )
-                mesh = multihost_site_mesh(
-                    sites_per_process=n_mesh // jax.process_count(),
-                    model_axis_size=m,
-                )
-            elif len(devs) >= n_mesh * m:
-                # the packed topology (parallel/mesh.py): k virtual sites
-                # per mesh member, two-level aggregation in the epoch
-                mesh = packed_site_mesh(n, k, devs, model_axis_size=m)
-            elif len(cpus) >= n_mesh * m:
-                mesh = host_mesh(n_mesh, model_axis_size=m)
-            elif m > 1:
-                raise ValueError(
-                    f"model_axis_size={m} with {n_mesh} mesh sites needs "
-                    f"{n_mesh * m} devices (have {len(devs)}); sequence "
-                    "parallelism cannot fold onto one device"
-                )
-            else:
-                mesh = None  # fold all sites onto the local device via vmap
+            mesh = auto_site_mesh(self.cfg, len(self.site_dirs))
         self.mesh = mesh
 
     def run(self, folds=None, verbose: bool = True, resume: bool = False) -> list[dict]:
@@ -276,3 +298,669 @@ class SiteRunner:
                     report.note_result(res)
             results.append(res)
         return results
+
+
+# ---------------------------------------------------------------------------
+# daemon mode — elastic rounds (r13)
+# ---------------------------------------------------------------------------
+
+#: spool event files are JSON objects with an "event" key:
+#:   {"event": "join", "site": "<id>", "data_dir": "<path>"}
+#:   {"event": "leave", "site": "<id>"}
+#:   {"event": "shutdown"}
+#: plus an optional "after_epoch": N — the event is held in the spool until
+#: the daemon has trained N epochs (deterministic churn scheduling for tests
+#: and the CI smoke). Files are processed in sorted-filename order and
+#: removed once applied.
+SPOOL_EVENTS = ("join", "leave", "shutdown")
+
+
+class FedDaemon:
+    """Daemon-mode federated training: a persistent service over ONE
+    compiled epoch program.
+
+    The virtual-site axis is pinned at ``capacity`` slots for the life of
+    the service; logical sites float over it through a
+    :class:`~..robustness.membership.MembershipTable`. Membership events
+    arrive as JSON files in ``spool_dir`` (see :data:`SPOOL_EVENTS`);
+    admission (dataset load) is deadline-bounded via
+    :func:`~..robustness.retry.with_retry` so a half-written site directory
+    fails fast instead of wedging the service. Every traced shape — the
+    ``[capacity, N, ...]`` inventory grid, the ``[capacity, steps, B]``
+    index plan, the liveness mask — is pinned at service start, so churn
+    NEVER retraces (CompileGuard-assertable: one epoch compile across any
+    join → straggle → leave → rejoin sequence).
+
+    Degradation: below ``quorum`` occupied slots the service HOLDS — rounds
+    are counted but not aggregated — rather than training on a sliver of
+    the federation. Checkpoints rotate every epoch and on every membership
+    epoch, with the table (and each member's data dir) embedded in the
+    atomically-paired meta, so ``resume=True`` restores the exact slot map
+    and re-admits the members' data.
+    """
+
+    def __init__(
+        self,
+        cfg: TrainConfig | None = None,
+        capacity: int = 8,
+        spool_dir: str | None = None,
+        out_dir: str | None = None,
+        data_path: str | None = None,
+        quorum: int = 1,
+        poll_s: float = 0.5,
+        mesh="auto",
+        fault_plan=None,
+        admission_deadline_s: float = 10.0,
+        inventory_rows: int | None = None,
+        steps: int | None = None,
+        resume: bool = False,
+        verbose: bool = True,
+        **overrides,
+    ):
+        from ..robustness.membership import MembershipTable
+
+        cfg = (cfg or TrainConfig()).with_overrides(overrides)
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if not 1 <= quorum <= capacity:
+            raise ValueError(
+                f"quorum must be in [1, capacity={capacity}], got {quorum}"
+            )
+        self.cfg = cfg.replace(num_sites=capacity)
+        self.capacity = capacity
+        self.quorum = quorum
+        self.poll_s = poll_s
+        self.fault_plan = fault_plan
+        self.admission_deadline_s = admission_deadline_s
+        self.verbose = verbose
+        self.spool_dir = spool_dir or (
+            os.path.join(data_path, "spool") if data_path else "spool"
+        )
+        self.out_dir = out_dir or (
+            os.path.join(data_path, "output") if data_path else "output"
+        )
+        os.makedirs(self.spool_dir, exist_ok=True)
+        if mesh == "auto":
+            mesh = auto_site_mesh(self.cfg, capacity)
+        self.mesh = mesh
+        self.trainer = FederatedTrainer(
+            self.cfg, get_task(self.cfg.task_id).build_model(self.cfg),
+            mesh, out_dir=self.out_dir, fault_plan=fault_plan,
+        )
+        self.trainer._num_sites = capacity
+        self.table = MembershipTable(capacity)
+        self.state = None  # built lazily at first admission (needs shapes)
+        self.epochs_run = 0
+        self.held_rounds = 0
+        self._stop = False
+        self._preempted = False
+        self._idle = False  # held-state latch (serve loop + ingest release)
+        self._data: dict = {}  # site id -> SiteArrays
+        self._dirs: dict = {}  # site id -> data dir (for resume re-admission)
+        # site id -> flat config-override dict (a join event's "config" key /
+        # the tree's inputspec entry): JSON-able, checkpointed in meta so
+        # resume re-admits each member under its own labels/data columns
+        self._overrides: dict = {}
+        # ONE cached zero-row placeholder for free slots: _ensure_inventory's
+        # content fingerprint is id()-keyed, and fresh placeholders per epoch
+        # would silently re-stack + re-upload the whole inventory grid every
+        # epoch whenever any slot is free
+        self._empty_site = None
+        self._feat = None  # feature shape, fixed at first admission
+        self._rows = inventory_rows  # pinned inventory grid height
+        self._steps = steps  # pinned per-epoch step-grid height
+        self._compiles0 = None
+        self._sink = None
+        ckpt_dir = os.path.join(self.out_dir, "serve")
+        self.ckpt_path = os.path.join(ckpt_dir, "checkpoint_latest.msgpack")
+        if self.cfg.telemetry == "on":
+            from ..telemetry.sink import FitTelemetry
+
+            self._sink = FitTelemetry.open(
+                os.path.join(
+                    self.cfg.telemetry_dir
+                    or os.path.join(self.out_dir, "telemetry"),
+                    "serve",
+                ),
+                self.cfg, mesh=self.mesh, fold=0, tracer=self.trainer.tracer,
+            )
+        resumed = self._resume() if resume else False
+        if not resumed and data_path:
+            # pre-join the tree's existing local* sites (the batch runner's
+            # discovery + per-site inputspec overrides), so `--serve` on a
+            # simulator tree starts training immediately and the spool only
+            # carries the churn
+            from ..core.config import load_inputspec
+
+            spec_path = os.path.join(data_path, "inputspec.json")
+            per_site = (
+                load_inputspec(spec_path) if os.path.exists(spec_path)
+                else [{}]
+            )
+            for i, d in enumerate(discover_site_dirs(data_path)):
+                self.apply_event({
+                    "event": "join", "site": f"local{i}", "data_dir": d,
+                    "config": per_site[i % len(per_site)],
+                })
+            if self.table.occupied:
+                self._on_membership_change()
+
+    # -- logging / telemetry helpers -------------------------------------
+
+    def _log(self, msg: str) -> None:
+        if self.verbose:
+            from ..trainer.logs import log_info
+
+            log_info(msg)
+
+    def _event(self, name: str, **attrs) -> None:
+        if self._sink is not None:
+            # API-boundary forward: NAME is a literal at every call site
+            self._sink.event(name, **attrs)  # jaxlint: disable=R007
+
+    # -- admission --------------------------------------------------------
+
+    def _load_site(self, data_dir: str, overrides: dict | None = None):
+        """Deadline-bounded dataset load for one joining site: a spool event
+        can point at a directory still being rsynced — retry briefly, then
+        reject the join instead of wedging the service (with_retry
+        deadline_s semantics, robustness/retry.py). ``overrides`` is the
+        site's flat config-override dict (its inputspec entry / the join
+        event's "config") — per-site labels files and data columns resolve
+        exactly as in the batch runner."""
+        from ..robustness.retry import with_retry
+
+        scfg = self.cfg.with_overrides(overrides or {})
+        spec = get_task(scfg.task_id)
+
+        def load():
+            ds = build_site_dataset(
+                spec.dataset_cls, spec.handle_cls, task_cache(scfg),
+                {"baseDirectory": data_dir}, mode=scfg.mode,
+            )
+            return ds.as_arrays()
+
+        return with_retry(
+            load, attempts=3, base_delay=0.2,
+            retry_on=(OSError, ValueError, KeyError, RuntimeError),
+            deadline_s=self.admission_deadline_s,
+            # per-attempt cap too: a read that HANGS (dead mount) never
+            # errors, so the deadline alone would never fire — the abandoned
+            # attempt runs on a daemon thread and the serve loop moves on
+            timeout_s=self.admission_deadline_s,
+            describe=f"site admission {data_dir}",
+        )()
+
+    def _admit(self, site: str, data_dir: str, overrides: dict | None = None):
+        """Load + shape-gate one joining site's data; returns SiteArrays or
+        None (rejected, with the reason logged + a telemetry event)."""
+        from ..trainer.logs import log_warning
+
+        try:
+            arrays = self._load_site(data_dir, overrides)
+        except (OSError, ValueError, KeyError, RuntimeError, TimeoutError) as e:
+            log_warning(
+                f"[serve] join rejected for {site!r}: admission failed "
+                f"within deadline_s={self.admission_deadline_s} ({e})"
+            )
+            self._event("join-rejected", site=site, reason=str(e))
+            return None
+        if not len(arrays):
+            log_warning(f"[serve] join rejected for {site!r}: empty dataset")
+            self._event("join-rejected", site=site, reason="empty dataset")
+            return None
+        feat = arrays.inputs.shape[1:]
+        if self._feat is None:
+            self._feat = feat
+        elif feat != self._feat:
+            log_warning(
+                f"[serve] join rejected for {site!r}: feature shape {feat} "
+                f"!= the service's {self._feat}"
+            )
+            self._event("join-rejected", site=site, reason="shape mismatch")
+            return None
+        if self._rows is None:
+            # pin the inventory grid at the first site's size (headroom is
+            # the operator's call via inventory_rows) — every traced shape
+            # is fixed from here on
+            self._rows = max(len(arrays), self.cfg.batch_size)
+        if len(arrays) > self._rows:
+            log_warning(
+                f"[serve] site {site!r} has {len(arrays)} samples; the "
+                f"service's inventory grid is pinned at {self._rows} rows — "
+                f"truncating (start the daemon with a larger inventory_rows "
+                "for headroom)"
+            )
+            arrays = arrays.take(np.arange(self._rows))
+        if len(arrays) < self.cfg.batch_size:
+            log_warning(
+                f"[serve] site {site!r} has {len(arrays)} samples < "
+                f"batch_size={self.cfg.batch_size}: with drop_last batching "
+                "it will yield no batches and contribute nothing"
+            )
+        return arrays
+
+    # -- membership transitions -------------------------------------------
+
+    def apply_event(self, ev: dict) -> bool:
+        """Apply one spool event; returns True when membership changed.
+        Invalid events are logged and skipped — a malformed spool file must
+        not take the service down."""
+        from ..robustness.membership import MembershipError
+        from ..trainer.logs import log_warning
+
+        kind = ev.get("event")
+        if kind == "shutdown":
+            self._stop = True
+            self._log("[serve] shutdown event received")
+            return False
+        try:
+            if kind == "join":
+                site = str(ev["site"])
+                data_dir = str(ev.get("data_dir", ""))
+                overrides = ev.get("config") or {}
+                arrays = self._admit(site, data_dir, overrides)
+                if arrays is None:
+                    return False
+                self.table, slot, gen = self.table.join(site)
+                self._data[site] = arrays
+                self._dirs[site] = data_dir
+                self._overrides[site] = overrides
+                self._ensure_state()
+                self._reset_slot(slot, site=site, generation=gen)
+                self._log(
+                    f"[serve] join {site!r} → slot {slot} (generation {gen})"
+                )
+                self._event("membership-join", site=site, slot=slot,
+                            generation=gen)
+                return True
+            if kind == "leave":
+                site = str(ev["site"])
+                self.table, slot = self.table.leave(site)
+                self._data.pop(site, None)
+                self._dirs.pop(site, None)
+                self._overrides.pop(site, None)
+                self._log(f"[serve] leave {site!r} (slot {slot} freed)")
+                self._event("membership-leave", site=site, slot=slot)
+                return True
+        except (MembershipError, KeyError) as e:
+            log_warning(f"[serve] bad membership event {ev!r}: {e}")
+            self._event("membership-error", reason=str(e))
+            return False
+        log_warning(f"[serve] unknown spool event {ev!r} — ignored")
+        return False
+
+    def _reset_slot(self, slot: int, site: str = "", generation: int = 0):
+        """Fresh state rows for a newly-assigned slot (generation semantics:
+        a rejoining site can never resurrect its previous incarnation's
+        engine/health/buffer state). Emits quarantine-lift when the slot's
+        previous occupant left it quarantined."""
+        from ..robustness.membership import reset_slot_state
+
+        if self.state is None:
+            return
+        if self.state.health is not None:
+            quarantined = int(
+                np.asarray(self.state.health["quarantined"])[slot]
+            )
+            if quarantined:
+                self._log(
+                    f"[serve] slot {slot} was quarantined — lifted for "
+                    f"{site!r} generation {generation}"
+                )
+                self._event("quarantine-lift", site=site, slot=slot)
+        self.state = self.trainer._place_state(
+            reset_slot_state(self.state, slot, engine=self.trainer.engine)
+        )
+
+    def _ensure_state(self):
+        if self.state is not None or self._feat is None:
+            return
+        import jax.numpy as jnp
+
+        self.state = self.trainer.init_state(
+            jnp.ones((self.cfg.batch_size,) + self._feat, jnp.float32),
+            num_sites=self.capacity,
+        )
+        if getattr(self, "_pending_ckpt_load", False):
+            # empty-membership resume (see _resume): the first join shaped
+            # the template — restore the checkpointed params/state now
+            from ..trainer.checkpoint import load_checkpoint
+
+            self._pending_ckpt_load = False
+            self.state = self.trainer._place_state(
+                load_checkpoint(self.ckpt_path, self.state)
+            )
+        from ..checks.sanitize import jit_cache_size
+
+        self._compiles0 = jit_cache_size(self.trainer.epoch_fn) or 0
+
+    def _on_membership_change(self):
+        """Post-transition housekeeping: rebalance packed slot assignment,
+        refresh the occupancy mask, and checkpoint the membership epoch."""
+        from ..robustness.membership import move_slot_state
+
+        num_blocks = (
+            dict(self.mesh.shape)[SITE_AXIS] if self.mesh is not None else 1
+        )
+        self.table, moves = self.table.rebalance(num_blocks)
+        for site, src, dst in moves:
+            self._log(
+                f"[serve] rebalance: {site!r} slot {src} → {dst} (packed "
+                "block occupancy)"
+            )
+            if self.state is not None:
+                self.state = self.trainer._place_state(move_slot_state(
+                    self.state, src, dst, engine=self.trainer.engine
+                ))
+            self._event("membership-rebalance", site=site, src=src, dst=dst)
+        self.trainer.membership_mask = self.table.occupancy()
+        self._event("membership-epoch", epoch=self.table.epoch,
+                    occupied=self.table.occupied)
+        self.checkpoint()
+
+    # -- the ingest spool --------------------------------------------------
+
+    def ingest(self) -> bool:
+        """Drain applicable spool events (sorted-filename order); an event
+        with ``after_epoch`` > epochs trained stays queued. Returns True
+        when membership changed."""
+        from ..trainer.logs import log_warning
+
+        changed = False
+        # while HELD, release scheduled events (epochs_run is frozen; see
+        # below) — but only until the first applied transition: that may be
+        # the join that lifts the hold, and later-scheduled events (e.g. a
+        # shutdown) must then wait for their trained-epoch mark again
+        release = self._idle
+        for name in sorted(os.listdir(self.spool_dir)):
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(self.spool_dir, name)
+            try:
+                with open(path) as fh:
+                    ev = json.load(fh)
+            except (OSError, json.JSONDecodeError) as e:
+                log_warning(f"[serve] unreadable spool file {path}: {e}")
+                try:
+                    os.replace(path, path + ".rejected")
+                except OSError:
+                    pass
+                continue
+            if not isinstance(ev, dict):
+                log_warning(f"[serve] spool file {path} is not an object")
+                os.remove(path)
+                continue
+            try:
+                after = int(ev.get("after_epoch", 0) or 0)
+            except (TypeError, ValueError):
+                log_warning(
+                    f"[serve] spool file {path}: bad after_epoch "
+                    f"{ev.get('after_epoch')!r}"
+                )
+                try:
+                    os.replace(path, path + ".rejected")
+                except OSError:
+                    pass
+                continue
+            # scheduled events wait for N TRAINED epochs — except while the
+            # service is HELD (below quorum / nothing trainable): epochs_run
+            # is frozen then, and the scheduled join/shutdown may be exactly
+            # what lifts or ends the hold
+            if after > self.epochs_run and not release:
+                continue  # scheduled for later — leave it queued
+            os.remove(path)
+            applied = self.apply_event(ev)
+            changed |= applied
+            if applied:
+                release = False  # the hold may have lifted — back to strict
+            if self._stop:
+                break
+        return changed
+
+    # -- training ----------------------------------------------------------
+
+    def _slot_sites(self) -> list:
+        """The padded per-slot site list the epoch trains on: occupants'
+        arrays at their slots, the shared empty placeholder (zero samples —
+        the plan masks them, the occupancy mask zeroes their liveness)
+        elsewhere."""
+        if self._empty_site is None:
+            self._empty_site = SiteArrays(
+                np.zeros((0,) + self._feat, np.float32),
+                np.zeros((0,), np.int32), np.zeros((0,), np.int32),
+            )
+        return [
+            self._data[s] if s is not None else self._empty_site
+            for s in self.table.slots
+        ]
+
+    def train_epoch(self):
+        """One training epoch over the current membership; returns the epoch
+        loss, or None when the service HELD: below the quorum floor, no
+        state yet, or no member large enough to yield a batch. Each hold
+        counts one epoch's worth of rounds into ``held_rounds`` (the serve
+        loop then idles until membership changes, so the figure counts
+        declined epochs, not poll-loop iterations)."""
+        rounds = max(
+            (self._steps or 1) // max(self.cfg.local_iterations, 1), 1
+        )
+        if self.table.occupied < self.quorum or self.state is None:
+            self.held_rounds += rounds
+            self._event("round-hold", occupied=self.table.occupied,
+                        quorum=self.quorum)
+            return None
+        if not any(
+            len(self._data[s]) >= self.cfg.batch_size
+            for s in self.table.members()
+        ):
+            # every member is smaller than the batch: drop_last batching
+            # yields zero batches and the plan builder would (rightly)
+            # refuse — hold rather than crash the service
+            self.held_rounds += rounds
+            self._event("round-hold", occupied=self.table.occupied,
+                        quorum=self.quorum, reason="no trainable batch")
+            return None
+        if self._steps is None:
+            # pin the step grid on first contact with data (membership can
+            # only change it downward-wrapping/truncating from here)
+            from ..data.batching import epoch_steps
+
+            self._steps = epoch_steps(
+                [s for s in self._slot_sites() if len(s)],
+                self.cfg.batch_size,
+            )
+            self.trainer.fixed_steps = self._steps
+        self.trainer.fixed_steps = self._steps
+        self.trainer.fixed_inventory_rows = self._rows
+        self.epochs_run += 1
+        t0 = time.time()
+        with self.trainer.tracer.span("epoch", epoch=self.epochs_run):
+            self.state, losses = self.trainer.run_epoch(
+                self.state, self._slot_sites(), self.epochs_run,
+                batch_size=self.cfg.batch_size,
+            )
+        lived = losses[np.isfinite(losses)]
+        loss = float(lived.mean()) if lived.size else float("nan")
+        if self._sink is not None:
+            self.trainer._fit_tel = self._sink
+            self.trainer._epoch_row(0, self.epochs_run, loss, t0, self.state)
+        self._log(
+            f"[serve] epoch {self.epochs_run}: train_loss={loss:.4f} "
+            f"({self.table.occupied}/{self.capacity} slots)"
+        )
+        return loss
+
+    def checkpoint(self):
+        """Rotating checkpoint with the membership table (and member data
+        dirs) embedded in the atomically-paired meta."""
+        from ..trainer.checkpoint import save_checkpoint
+
+        if self.state is None or not self.trainer._coordinator():
+            return
+        with self.trainer.tracer.span("checkpoint"):
+            save_checkpoint(
+                self.ckpt_path, self.state,
+                meta={
+                    "epoch": self.epochs_run,
+                    "held_rounds": self.held_rounds,
+                    "steps": self._steps,
+                    "rows": self._rows,
+                    "membership": self.table.to_json(),
+                    "data_dirs": dict(self._dirs),
+                    "site_overrides": dict(self._overrides),
+                },
+                rotate=True,
+            )
+
+    def _resume(self) -> bool:
+        """Restore the service from its last checkpoint: membership table +
+        member data (re-admitted from the recorded dirs) + train state —
+        surviving sites' trajectories continue bit-exact. Returns False when
+        there is nothing to resume from (the caller then falls back to the
+        fresh-start path, pre-joining the tree's sites)."""
+        from ..robustness.membership import MembershipTable
+        from ..trainer.checkpoint import load_checkpoint, load_meta
+
+        if not (
+            os.path.exists(self.ckpt_path)
+            or os.path.exists(self.ckpt_path + ".prev")
+        ):
+            self._log("[serve] resume requested but no checkpoint — "
+                      "starting fresh")
+            return False
+        meta = load_meta(self.ckpt_path)
+        self.table = MembershipTable.from_json(meta["membership"])
+        if self.table.capacity != self.capacity:
+            raise ValueError(
+                f"checkpointed capacity {self.table.capacity} != daemon "
+                f"capacity {self.capacity} — the virtual-site axis is "
+                "pinned for the life of the service"
+            )
+        self.epochs_run = int(meta.get("epoch", 0))
+        self.held_rounds = int(meta.get("held_rounds", 0))
+        self._steps = meta.get("steps") or self._steps
+        self._rows = meta.get("rows") or self._rows
+        self._dirs = dict(meta.get("data_dirs", {}))
+        self._overrides = dict(meta.get("site_overrides", {}))
+        for site, slot in sorted(
+            self.table.members().items(), key=lambda kv: kv[1]
+        ):
+            arrays = self._admit(
+                site, self._dirs.get(site, ""), self._overrides.get(site)
+            )
+            if arrays is None:
+                raise RuntimeError(
+                    f"resume: cannot re-admit member {site!r} from "
+                    f"{self._dirs.get(site)!r}"
+                )
+            self._data[site] = arrays
+        self._ensure_state()
+        if self.state is not None:
+            self.state = self.trainer._place_state(
+                load_checkpoint(self.ckpt_path, self.state)
+            )
+        else:
+            # a service checkpointed with ZERO members (everyone left) has
+            # no data to shape a state template from — resume idle; the
+            # first join builds the template and THEN restores the
+            # checkpointed params (deferred load below), so the model the
+            # departed federation trained is not lost
+            self._pending_ckpt_load = True
+            self._log("[serve] resumed with an empty membership table — "
+                      "idling until a site joins")
+        self.trainer.membership_mask = self.table.occupancy()
+        self.trainer.fixed_steps = self._steps
+        self.trainer.fixed_inventory_rows = self._rows
+        self._log(
+            f"[serve] resumed at epoch {self.epochs_run} with "
+            f"{self.table.occupied}/{self.capacity} slots (membership "
+            f"epoch {self.table.epoch})"
+        )
+        return True
+
+    # -- the service loop --------------------------------------------------
+
+    def serve(self, max_epochs: int | None = None,
+              max_wall_s: float | None = None) -> dict:
+        """The daemon loop: drain the spool, hold below quorum, train,
+        checkpoint — until a shutdown event, SIGTERM/SIGINT (clean
+        checkpointed exit), ``max_epochs`` trained epochs or ``max_wall_s``
+        wall-clock. Returns a summary dict (and writes the telemetry
+        summary row when telemetry is on)."""
+        from ..robustness.preemption import PreemptionGuard
+
+        t0 = time.monotonic()
+        trained_here = 0
+        # held-state latch (self._idle): after a hold (below quorum / no
+        # state / nothing trainable) the loop idles on the spool instead of
+        # re-holding every poll iteration — held_rounds counts declined
+        # EPOCHS, only a membership change lifts the hold, and ingest()
+        # releases after_epoch-scheduled events while held (epochs_run is
+        # frozen then, and a scheduled join/shutdown may be the lift)
+        self._idle = False
+        with PreemptionGuard() as guard:
+            while not self._stop:
+                changed = self.ingest()
+                if changed:
+                    self._on_membership_change()
+                    self._idle = False
+                if self._stop:
+                    break
+                loss = None
+                if not self._idle:
+                    loss = self.train_epoch()
+                    if loss is None:
+                        self._idle = True
+                    else:
+                        trained_here += 1
+                        self.checkpoint()
+                if guard.requested is not None:
+                    self._preempted = True
+                    self._log(
+                        f"[serve] signal {guard.requested} — checkpointed, "
+                        "shutting down"
+                    )
+                    self.checkpoint()
+                    break
+                if max_epochs is not None and trained_here >= max_epochs:
+                    break
+                if max_wall_s is not None and time.monotonic() - t0 >= max_wall_s:
+                    break
+                if loss is None and not changed:
+                    # idle (held below quorum, empty spool): poll gently
+                    time.sleep(self.poll_s)
+        return self.close()
+
+    def close(self) -> dict:
+        """Final checkpoint + telemetry summary; returns the service
+        summary."""
+        from ..checks.sanitize import jit_cache_size
+        from ..robustness.membership import membership_rollup
+
+        self.checkpoint()
+        rollup = membership_rollup(
+            self.table, self.state, held_rounds=self.held_rounds
+        )
+        summary = {
+            "epochs_run": self.epochs_run,
+            "held_rounds": self.held_rounds,
+            "membership": rollup,
+            "table": self.table.to_json(),
+            "preempted": self._preempted,
+        }
+        if self._sink is not None:
+            compiles = (
+                (jit_cache_size(self.trainer.epoch_fn) or 0)
+                - (self._compiles0 or 0)
+            )
+            self._sink.append({
+                "kind": "summary", "fold": 0,
+                "epochs_run": self.epochs_run,
+                "epoch_compiles": compiles,
+                "best_val_epoch": 0,
+                "membership": rollup,
+            })
+            self._sink.close()
+            self._sink = None
+        return summary

@@ -153,6 +153,18 @@ def render_fit(dirpath: str) -> None:
             f"update‖·‖ last={_norm(last.get('update_sq_last', 0)):.5f} · "
             f"prefetch_stall_s={summary.get('prefetch_stall_s', 'n/a')}"
         )
+    membership = summary.get("membership")
+    if membership:
+        stale = membership.get("mean_staleness")
+        print(
+            "-- membership: "
+            f"{membership.get('slots_occupied')}/"
+            f"{membership.get('capacity')} slots occupied · "
+            f"membership_epoch={membership.get('membership_epoch')} · "
+            f"mean_staleness="
+            f"{'n/a' if stale is None else format(stale, '.2f')} · "
+            f"held_rounds={membership.get('held_rounds')}"
+        )
     if events:
         counts: dict[str, int] = {}
         for e in events:

@@ -55,6 +55,10 @@ ROW_REQUIRED = {
     "event": frozenset({"kind", "name"}),
     "summary": frozenset({
         "kind", "fold", "epochs_run", "epoch_compiles", "best_val_epoch",
+        # elastic-rounds rollup (robustness/membership.py membership_rollup):
+        # a dict for daemon-mode serves, null for batch-job fits — the key
+        # itself is part of the schema contract
+        "membership",
     }),
 }
 

@@ -122,6 +122,10 @@ def save_checkpoint(
         "round": state.round,
         "health": state.health if state.health is not None else {},
         "telemetry": state.telemetry if state.telemetry is not None else {},
+        # per-slot staleness buffers (buffered-async mode, r13): a resumed
+        # daemon must keep each slot's pending update + age, or a straggling
+        # site's in-flight contribution would be silently dropped on restart
+        "buffers": state.buffers if state.buffers is not None else {},
         # meta rides INSIDE the msgpack so state+meta are one atomic unit (a
         # kill between two separate files would pair epoch-N state with
         # epoch-(N-1) bookkeeping and resume from the wrong epoch)
@@ -168,6 +172,7 @@ def load_checkpoint(path: str, like: TrainState, with_meta: bool = False,
     eng_raw = raw.pop("engine_state", None)
     health_raw = raw.pop("health", None)
     telemetry_raw = raw.pop("telemetry", None)
+    buffers_raw = raw.pop("buffers", None)
     restored = flax.serialization.from_state_dict(template, raw)
     restored["meta_json"] = meta_json
     try:
@@ -207,6 +212,21 @@ def load_checkpoint(path: str, like: TrainState, with_meta: bool = False,
                 "not match the current run (site count or schema changed?); "
                 "resuming with fresh accumulators."
             )
+    # staleness buffers restore the same tolerant way: absent in pre-0.8
+    # checkpoints (or when the resuming run is bulk-sync) → fresh
+    # never-deposited buffers / None, never a failed resume
+    buffers = like.buffers
+    if buffers_raw and like.buffers is not None:
+        try:
+            buffers = flax.serialization.from_state_dict(
+                like.buffers, buffers_raw
+            )
+        except (KeyError, TypeError, ValueError):
+            warnings.warn(
+                f"[warn] checkpoint {path}: stored staleness buffers do not "
+                "match the current run (site count or model changed?); "
+                "resuming with fresh never-deposited buffers."
+            )
     state = TrainState(
         params=restored["params"],
         batch_stats=restored["batch_stats"],
@@ -216,6 +236,7 @@ def load_checkpoint(path: str, like: TrainState, with_meta: bool = False,
         round=jnp.asarray(restored["round"]),
         health=health,
         telemetry=telemetry,
+        buffers=buffers,
     )
     if with_meta:
         meta = restored.get("meta_json")
@@ -223,6 +244,20 @@ def load_checkpoint(path: str, like: TrainState, with_meta: bool = False,
             meta = meta.decode()
         return state, json.loads(meta or "{}")
     return state
+
+
+def load_meta(path: str) -> dict:
+    """The embedded (atomically-paired) meta of a checkpoint, readable
+    WITHOUT a state template — the daemon-mode runner reads the membership
+    table from here before it can even build a state (the table says which
+    sites' data to admit, and the data defines the state's shapes). Falls
+    back to ``.prev`` like :func:`load_checkpoint`, so a kill inside the
+    rotate window still yields a paired (state, meta) generation."""
+    raw = _load_raw(path)
+    meta = raw.get("meta_json")
+    if isinstance(meta, bytes):
+        meta = meta.decode()
+    return json.loads(meta or "{}")
 
 
 def load_params(path: str, like_params: Any):

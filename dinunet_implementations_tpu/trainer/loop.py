@@ -126,6 +126,8 @@ class FederatedTrainer:
             pipeline=self._pipeline,
             donate_state=self._donate,
             telemetry=self._telemetry_on,
+            staleness_bound=cfg.staleness_bound,
+            staleness_decay=cfg.staleness_decay,
         )
         self.eval_fn = make_eval_fn(self.task, mesh)
         self._inventory = None  # device-resident site inventory, one per fit
@@ -138,6 +140,21 @@ class FederatedTrainer:
         self._input_dtype = getattr(model, "compute_dtype", None) or None
         self._cache: dict = {}  # duration bookkeeping, reference-keyed
         self._last_transfer_bytes = 0  # per-epoch host→device traffic
+        # -- elastic-rounds hooks (runner/fed_runner.py FedDaemon, r13) --
+        # [S] occupancy mask from the membership table: folded into every
+        # epoch's liveness mask (an unoccupied slot is a site whose update
+        # never arrives). None = classic batch-job semantics. Setting it
+        # forces the liveness input to be FED even without a FaultPlan, so
+        # the daemon runs one compiled program whether or not faults are
+        # also injected.
+        self.membership_mask = None
+        # pinned per-epoch step-grid height: the daemon sets this so churn
+        # (a bigger site joining) can never change the plan's [S, steps, B]
+        # shape and force a retrace. None = derive steps from the site set.
+        self.fixed_steps = None
+        # pinned inventory row budget ([S, N_max, ...] grid height), same
+        # retrace-proofing for the device-resident inventory upload
+        self.fixed_inventory_rows = None
 
     def _coordinator(self) -> bool:
         """Multi-host runs: only process 0 writes logs/checkpoints (every
@@ -170,6 +187,7 @@ class FederatedTrainer:
             self.task, self.engine, self.optimizer, rng, sample_x,
             num_sites=num_sites or getattr(self, "_num_sites", 1),
             telemetry=self._telemetry_on,
+            staleness_bound=self.cfg.staleness_bound,
         )
         return self._place_state(state)
 
@@ -227,7 +245,10 @@ class FederatedTrainer:
 
             with self.tracer.span("inventory-upload"):
                 self._inventory = put_site_inventory(
-                    self.mesh, stack_site_inventory(train_sites),
+                    self.mesh,
+                    stack_site_inventory(
+                        train_sites, self.fixed_inventory_rows
+                    ),
                     self._input_dtype,
                 )
             self._inventory_src = key
@@ -248,11 +269,13 @@ class FederatedTrainer:
             plan = plan_epoch_positions(
                 train_sites, batch_size,
                 seed=self.cfg.seed * 100003 + epoch, pad_mode="wrap",
+                steps=self.fixed_steps,
             )
             rounds = plan.steps // max(self.cfg.local_iterations, 1)
             live, nan_mask = fault_window(
                 self.fault_plan, plan.num_sites, round0, rounds
             )
+            live = self._membership_live(live, plan.num_sites, rounds)
             # the NaN gate is fed whenever the PLAN carries nan_at (a
             # fit-static property), not only in windows that poison — the
             # compiled program must not change between epochs
@@ -263,6 +286,19 @@ class FederatedTrainer:
             from ..parallel.distributed import put_epoch_plan
 
             return put_epoch_plan(self.mesh, plan.positions, live, poison)
+
+    def _membership_live(self, live, num_sites: int, rounds: int):
+        """Fold the membership occupancy mask (FedDaemon, r13) into an
+        epoch's ``[S, rounds]`` liveness mask: an unoccupied slot never
+        arrives. Forces a mask into existence when membership is elastic —
+        the daemon's epoch program always takes the liveness input, so churn
+        and fault patterns share ONE compiled form."""
+        if self.membership_mask is None:
+            return live
+        occ = np.asarray(self.membership_mask, np.float32)[:num_sites, None]
+        if live is None:
+            return np.broadcast_to(occ, (num_sites, rounds)).copy()
+        return live * occ
 
     def run_epoch(self, state, train_sites, epoch: int, batch_size=None,
                   plan=None):
@@ -289,6 +325,7 @@ class FederatedTrainer:
             batch_size or self.cfg.batch_size,
             seed=self.cfg.seed * 100003 + epoch,
             pad_mode="wrap",
+            steps=self.fixed_steps,
         )
         # deterministic chaos: masks/poison are pure functions of the plan
         # and the GLOBAL round window (robustness/faults.py fault_window —
@@ -303,6 +340,11 @@ class FederatedTrainer:
             rounds = fb.steps // max(self.cfg.local_iterations, 1)
             live, nan_mask = fault_window(
                 self.fault_plan, fb.num_sites, int(state.round), rounds
+            )
+        if self.membership_mask is not None:
+            live = self._membership_live(
+                live, fb.num_sites,
+                fb.steps // max(self.cfg.local_iterations, 1),
             )
         if nan_mask is not None and nan_mask.any():
             # data-layer injection: real NaN inputs
@@ -531,6 +573,9 @@ class FederatedTrainer:
                 self._fit_summary = {
                     "kind": "summary", "fold": fold, "epochs_run": 0,
                     "best_val_epoch": 0, "best_val_metric": None,
+                    # elastic-rounds rollup (robustness/membership.py);
+                    # batch-job fits have no membership table → null
+                    "membership": None,
                     "_compiles0": jit_cache_size(self.epoch_fn) or 0,
                 }
             elif not tel_root and verbose:

@@ -33,7 +33,7 @@ import optax
 from dinunet_implementations_tpu.core.jaxcompat import shard_map
 from jax.sharding import PartitionSpec as P
 
-from ..engines.base import Engine
+from ..engines.base import Engine, default_async_buffers, staleness_weights
 from ..parallel.collectives import (
     PackedAxis,
     site_weight_scale,
@@ -78,6 +78,13 @@ class TrainState:
     # TrainConfig.telemetry="off" — the epoch program then carries no
     # telemetry ops at all (bitwise-equal to the pre-telemetry program).
     telemetry: Any = None
+    # PER-SLOT staleness buffers (engines/base.py default_async_buffers):
+    # each virtual site's last deposited update + its weight + arrival age —
+    # the carry of the buffered-async aggregation mode (r13). None whenever
+    # TrainConfig.staleness_bound == 0 — the epoch program then carries no
+    # buffering ops at all (bitwise-equal to the bulk-sync program, the
+    # telemetry=off pattern; S005-gated).
+    buffers: Any = None
 
 
 def _state_specs(state: TrainState):
@@ -96,6 +103,7 @@ def _state_specs(state: TrainState):
         round=P(),
         health=jax.tree.map(lambda _: P(SITE_AXIS), state.health),
         telemetry=jax.tree.map(lambda _: P(SITE_AXIS), state.telemetry),
+        buffers=jax.tree.map(lambda _: P(SITE_AXIS), state.buffers),
     )
 
 
@@ -167,6 +175,7 @@ def init_train_state(
     sample_x,
     num_sites: int = 1,
     telemetry: bool = False,
+    staleness_bound: int = 0,
 ) -> TrainState:
     params, batch_stats = task.init_variables(rng, sample_x)
     site_state = engine.init(params)
@@ -185,6 +194,12 @@ def init_train_state(
         # a telemetry-carrying state fed to a telemetry-off program would
         # force a structure change (and a recompile) at the jit boundary
         telemetry=default_round_telemetry(num_sites) if telemetry else None,
+        # staleness buffers only for the buffered-async mode (same structural
+        # reasoning as telemetry: the carried state must match the program)
+        buffers=(
+            default_async_buffers(num_sites, params)
+            if staleness_bound > 0 else None
+        ),
     )
 
 
@@ -220,6 +235,8 @@ def make_train_epoch_fn(
     pipeline: str = "host",
     donate_state: bool = False,
     telemetry: bool = False,
+    staleness_bound: int = 0,
+    staleness_decay: float = 0.5,
 ):
     """Build the jitted epoch function.
 
@@ -266,6 +283,28 @@ def make_train_epoch_fn(
     pre-robustness program, for benchmarking the machinery's cost.
     ``quarantine_rounds=None`` means the default (3).
 
+    Buffered-async aggregation (r13 — elastic rounds): ``staleness_bound >
+    0`` switches the aggregation semantics from bulk-synchronous to
+    staleness-bounded buffered-async. Each virtual site owns a per-slot
+    update buffer riding ``TrainState.buffers`` through the rounds scan: a
+    round where the site ARRIVES (scheduled live AND finite AND not
+    quarantined) deposits its fresh gradient + example weight and resets the
+    slot's age to 0; a round where it doesn't (drop, straggler ``delay_at``,
+    membership hole) leaves the buffer and ages it. Aggregation then runs
+    over the BUFFERS, each slot's weight scaled by ``staleness_decay^age``
+    (engines/base.py ``staleness_weights``) and hard-masked past
+    ``staleness_bound`` exactly like a dead site — so a straggling update
+    keeps pulling the model with fading weight instead of being lost, and a
+    site that stops arriving fades out instead of stalling the round. The
+    round loss / sync-BN / health counters stay keyed on FRESH arrivals; a
+    round with no in-bound buffered weight holds params/optimizer exactly
+    like an all-dead bulk-sync round. ``staleness_bound == 0`` (default)
+    statically compiles ALL of it out — the exact bulk-sync program
+    (lowering-identical; checks/semantic.py S005 "async-off"), and since
+    ``decay^0 == 1`` an async round where EVERY site arrives is bit-identical
+    to the bulk-sync round anyway. Arrival masks are traced inputs, so churn
+    and straggle patterns never recompile.
+
     Telemetry (telemetry/metrics.py): ``telemetry=True`` accumulates, every
     round, per-site grad/update norms, the engine aggregation residual and
     modeled payload bytes into ``state.telemetry`` — traced values riding the
@@ -294,6 +333,17 @@ def make_train_epoch_fn(
     model_axis = _model_axis_of(mesh)
     if quarantine_rounds is None:
         quarantine_rounds = 3  # the default threshold
+    if staleness_bound < 0:
+        raise ValueError(
+            f"staleness_bound must be >= 0, got {staleness_bound}"
+        )
+    if not 0.0 < staleness_decay <= 1.0:
+        raise ValueError(
+            f"staleness_decay must be in (0, 1], got {staleness_decay}"
+        )
+    # trace-time static: the buffered-async machinery exists iff the bound is
+    # positive — staleness_bound=0 compiles the exact bulk-sync program
+    buffered = staleness_bound > 0
 
     def loss_fn(params, batch_stats, rng, x, y, w):
         logits, new_stats = task.apply(
@@ -389,8 +439,9 @@ def make_train_epoch_fn(
         # the gradient tree, where-freezes/selects on engine state, params,
         # opt state, BN stats) compiles in only when quarantine is enabled OR
         # a liveness mask is fed; quarantine_rounds=-1 with no mask restores
-        # the exact pre-robustness program (the bench escape hatch)
-        guard = quarantine_rounds >= 0 or live is not None
+        # the exact pre-robustness program (the bench escape hatch). The
+        # buffered-async mode needs the arrival gates, so it implies guard.
+        guard = quarantine_rounds >= 0 or live is not None or buffered
         health = state.health  # filled by epoch_fn before any shard_map
         # trace-time static: telemetry accumulators exist iff the epoch was
         # built with telemetry=True (_ensure_aux normalizes the state), so a
@@ -432,7 +483,7 @@ def make_train_epoch_fn(
 
         def one_round(carry, xs):
             (params, batch_stats, opt_state, engine_state, health, telem_st,
-             rng, rnd) = carry
+             buffers, rng, rnd) = carry
             pz = None
             if use_scan_xs:
                 parts = list(xs)
@@ -570,6 +621,25 @@ def make_train_epoch_fn(
                     new_tree, old_tree,
                 )
 
+            def _deposit(bf, site_grad, n_sum, arrived, gate):
+                """Buffered-async arrival: a contributing site deposits this
+                round's fresh gradient + weight and resets its age; everyone
+                else's buffer survives and ages one round. ``arrived`` is the
+                bool arrival mask (scalar per site under the inner vmap, [k]
+                on the packed block); ``gate(leaf)`` broadcasts it against a
+                gradient leaf — the same shape-polymorphic convention as
+                ``_freeze_dead``. Only FINITE gradients are ever deposited
+                (arrival requires finiteness), so the buffers stay NaN-free
+                by construction."""
+                return {
+                    "grads": jax.tree.map(
+                        lambda g, b: jnp.where(gate(g), g, b),
+                        site_grad, bf["grads"],
+                    ),
+                    "weight": jnp.where(arrived, n_sum, bf["weight"]),
+                    "age": jnp.where(arrived, 0, bf["age"] + 1),
+                }
+
             def _round_loss(loss_sum, contribute, total_live, psum):
                 """Round-weighted global loss over LIVE sites (for logs);
                 NaN-safe: a dead site's loss sum is where-excluded. An
@@ -601,7 +671,7 @@ def make_train_epoch_fn(
                     "quarantined": quarantined,
                 }
 
-            def packed_round(hs, ts, ls, es):
+            def packed_round(hs, ts, bf, ls, es):
                 """The two-level round: per-site grads under the inner vmap,
                 everything that communicates outside it on the [k]-batched
                 block with PackedAxis collectives — one cross-device
@@ -636,16 +706,43 @@ def make_train_epoch_fn(
                             )),
                         )
                     )
-                    return agg, es_new, hs, ts_new, stats_out, loss_round, None
+                    return agg, es_new, hs, ts_new, bf, stats_out, loss_round, None
                 finite, contribute = _liveness_gate(ls, site_grad, hs, rows=k)
                 n_eff = n_sum * contribute
-                agg, es_new = engine.aggregate(
-                    site_grad, es, n_sum, pax, live=contribute
-                )
-                es_new = _freeze_dead(
-                    es_new, es, lambda leaf: _per_site(contribute > 0, leaf)
-                )
-                total_live = two_level_psum(n_eff, pax)
+                if buffered:
+                    # buffered-async: arrivals deposit, everyone aggregates
+                    # from the buffers at staleness-decayed weight; the
+                    # engine's collectives (and therefore the S002-proven
+                    # wire) are identical to the bulk-sync form
+                    arrived = contribute > 0
+                    bf = _deposit(
+                        bf, site_grad, n_sum, arrived,
+                        lambda leaf: _per_site(arrived, leaf),
+                    )
+                    stale_w = staleness_weights(
+                        bf["age"], staleness_bound, staleness_decay
+                    )
+                    eff_w = bf["weight"] * stale_w
+                    agg, es_new = engine.aggregate(
+                        bf["grads"], es, eff_w, pax,
+                        live=(stale_w > 0).astype(jnp.float32),
+                    )
+                    es_new = _freeze_dead(
+                        es_new, es, lambda leaf: _per_site(stale_w > 0, leaf)
+                    )
+                    # params-hold gate: total in-bound buffered weight; the
+                    # loss/BN gates stay keyed on FRESH arrivals below
+                    total_live = two_level_psum(eff_w, pax)
+                    total_fresh = two_level_psum(n_eff, pax)
+                else:
+                    agg, es_new = engine.aggregate(
+                        site_grad, es, n_sum, pax, live=contribute
+                    )
+                    es_new = _freeze_dead(
+                        es_new, es, lambda leaf: _per_site(contribute > 0, leaf)
+                    )
+                    total_live = two_level_psum(n_eff, pax)
+                    total_fresh = total_live
                 if task.has_batch_stats:
                     scale = site_weight_scale(n_eff, pax)
                     zeroed = jax.tree.map(
@@ -661,13 +758,13 @@ def make_train_epoch_fn(
                         zeroed,
                     )
                     stats_out = jax.tree.map(
-                        lambda sn, old: jnp.where(total_live > 0, sn, old),
+                        lambda sn, old: jnp.where(total_fresh > 0, sn, old),
                         syn, batch_stats,
                     )
                 else:
                     stats_out = batch_stats
                 loss_round = _round_loss(
-                    loss_site, contribute, total_live,
+                    loss_site, contribute, total_fresh,
                     lambda v: two_level_psum(v, pax),
                 )
                 hs_new = _health_round(hs, finite, contribute)
@@ -680,10 +777,10 @@ def make_train_epoch_fn(
                         )),
                     )
                 )
-                return (agg, es_new, hs_new, ts_new, stats_out, loss_round,
+                return (agg, es_new, hs_new, ts_new, bf, stats_out, loss_round,
                         total_live)
 
-            def site_part(es, hs, ts, ls, xs, ys, ws):
+            def site_part(es, hs, ts, bf, ls, xs, ys, ws):
                 site_grad, n_sum, new_stats, loss_sum = site_micro(xs, ys, ws)
                 if not guard:
                     # fault machinery statically compiled out: the exact
@@ -701,7 +798,7 @@ def make_train_epoch_fn(
                         loss_sum, site_axes
                     ) / jnp.maximum(jax.lax.psum(n_sum, site_axes), 1.0)
                     return (agg, es_new, hs, _ts_round_site(ts, site_grad, agg),
-                            new_stats, loss_round, None)
+                            bf, new_stats, loss_round, None)
                 # -- liveness: a poisoned batch (data corruption, overflow,
                 # fault injection) yields a non-finite site gradient; that
                 # site is skipped this round and its streak counter advances
@@ -709,15 +806,36 @@ def make_train_epoch_fn(
                 # recompilation.
                 finite, contribute = _liveness_gate(ls, site_grad, hs)
                 n_eff = n_sum * contribute
-                agg, es_new = engine.aggregate(
-                    site_grad, es, n_sum, site_axes, live=contribute
-                )
-                es_new = _freeze_dead(es_new, es, lambda _: contribute > 0)
-                total_live = jax.lax.psum(n_eff, site_axes)
-                # sync-BN: example-weighted average of LIVE sites' running
-                # stats (dead sites' stats may be NaN → where-zeroed, and
-                # their weight is already 0); an all-dead round keeps the
-                # previous stats
+                if buffered:
+                    # buffered-async (scalar-per-site twin of packed_round's
+                    # branch): deposit on arrival, aggregate the buffers at
+                    # staleness-decayed weight
+                    arrived = contribute > 0
+                    bf = _deposit(
+                        bf, site_grad, n_sum, arrived, lambda _: arrived
+                    )
+                    stale_w = staleness_weights(
+                        bf["age"], staleness_bound, staleness_decay
+                    )
+                    eff_w = bf["weight"] * stale_w
+                    agg, es_new = engine.aggregate(
+                        bf["grads"], es, eff_w, site_axes,
+                        live=(stale_w > 0).astype(jnp.float32),
+                    )
+                    es_new = _freeze_dead(es_new, es, lambda _: stale_w > 0)
+                    total_live = jax.lax.psum(eff_w, site_axes)
+                    total_fresh = jax.lax.psum(n_eff, site_axes)
+                else:
+                    agg, es_new = engine.aggregate(
+                        site_grad, es, n_sum, site_axes, live=contribute
+                    )
+                    es_new = _freeze_dead(es_new, es, lambda _: contribute > 0)
+                    total_live = jax.lax.psum(n_eff, site_axes)
+                    total_fresh = total_live
+                # sync-BN: example-weighted average of FRESHLY-ARRIVED sites'
+                # running stats (dead sites' stats may be NaN → where-zeroed,
+                # and their weight is already 0); a round with no arrivals
+                # keeps the previous stats (stats are not buffered)
                 if task.has_batch_stats:
                     scale = site_weight_scale(n_eff, site_axes)
                     new_stats = jax.tree.map(
@@ -728,28 +846,31 @@ def make_train_epoch_fn(
                         lambda s: jax.lax.psum(s * scale, site_axes), new_stats
                     )
                     new_stats = jax.tree.map(
-                        lambda syn, old: jnp.where(total_live > 0, syn, old),
+                        lambda syn, old: jnp.where(total_fresh > 0, syn, old),
                         new_stats, batch_stats,
                     )
                 loss_round = _round_loss(
-                    loss_sum, contribute, total_live,
+                    loss_sum, contribute, total_fresh,
                     lambda v: jax.lax.psum(v, site_axes),
                 )
                 hs_new = _health_round(hs, finite, contribute)
                 return (agg, es_new, hs_new, _ts_round_site(ts, site_grad, agg),
-                        new_stats, loss_round, total_live)
+                        bf, new_stats, loss_round, total_live)
 
             if packed:
                 # mesh topologies: the two-level form — engine/BN/loss
                 # collectives run ONCE per device on the [k]-batched block
                 # (agg/stats/loss come back unbatched and replicated)
-                (agg, engine_state, health, telem_k, batch_stats, loss_round,
-                 total_live) = packed_round(health, telem_st, lb, engine_state)
+                (agg, engine_state, health, telem_k, buffers, batch_stats,
+                 loss_round, total_live) = packed_round(
+                    health, telem_st, buffers, lb, engine_state
+                )
             else:
-                agg, engine_state, health, telem_k, stats_k, loss_k, tl_k = jax.vmap(
-                    site_part, in_axes=(0, 0, 0, 0, 0, 0, 0),
-                    out_axes=(0, 0, 0, 0, 0, 0, 0), axis_name=inner_axis,
-                )(engine_state, health, telem_st, lb, xb, yb, wb)
+                (agg, engine_state, health, telem_k, buffers, stats_k, loss_k,
+                 tl_k) = jax.vmap(
+                    site_part, in_axes=(0, 0, 0, 0, 0, 0, 0, 0),
+                    out_axes=(0, 0, 0, 0, 0, 0, 0, 0), axis_name=inner_axis,
+                )(engine_state, health, telem_st, buffers, lb, xb, yb, wb)
                 # agg/stats/loss are psum'd over site_axes → identical across
                 # the k in-device rows; collapse to one copy and update once
                 agg = jax.tree.map(lambda a: a[0], agg)
@@ -788,7 +909,7 @@ def make_train_epoch_fn(
                 }
             return (
                 params, batch_stats, opt_state, engine_state, health,
-                telem_k, rng, rnd + 1,
+                telem_k, buffers, rng, rnd + 1,
             ), loss_round
 
         carry0 = (
@@ -798,6 +919,7 @@ def make_train_epoch_fn(
             state.engine_state,
             health,
             state.telemetry,
+            state.buffers,
             jax.random.fold_in(state.rng, state.round),
             state.round,
         )
@@ -829,8 +951,8 @@ def make_train_epoch_fn(
                 xs = xs + (jnp.moveaxis(live_rounds, 1, 0),)
         else:
             xs = jnp.arange(rounds)
-        (params, stats, opt_state, engine_state, health, telem_out, rng,
-         rnd), losses = jax.lax.scan(one_round, carry0, xs)
+        (params, stats, opt_state, engine_state, health, telem_out, buf_out,
+         rng, rnd), losses = jax.lax.scan(one_round, carry0, xs)
         new_state = TrainState(
             params=params,
             batch_stats=stats,
@@ -840,6 +962,7 @@ def make_train_epoch_fn(
             round=rnd,
             health=health,
             telemetry=telem_out,
+            buffers=buf_out,
         )
         return new_state, losses
 
@@ -868,6 +991,20 @@ def make_train_epoch_fn(
         ):
             state = state.replace(
                 telemetry=default_round_telemetry(inputs.shape[0])
+            )
+        # staleness buffers mirror the bound this epoch was built with, same
+        # trace-time normalization: bound 0 drops any carried buffers (an
+        # async checkpoint resumed in bulk-sync mode — the program stays the
+        # legacy one), bound > 0 fills/resizes fresh never-deposited buffers
+        if not buffered:
+            if state.buffers is not None:
+                state = state.replace(buffers=None)
+        elif (
+            state.buffers is None
+            or state.buffers["age"].shape[0] != inputs.shape[0]
+        ):
+            state = state.replace(
+                buffers=default_async_buffers(inputs.shape[0], state.params)
             )
         return state
 
