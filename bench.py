@@ -408,6 +408,143 @@ def measure_rankdad_ab(obs: int = 5, n: int = TIMED_EPOCHS,
     return records
 
 
+# fused power-iteration A/B arms (--ab-poweriter, r14): the Pallas kernel
+# (ops/poweriter_pallas.py) against the legacy XLA loop, warm- and
+# cold-started (cold runs the full dad_num_pow_iters trip count — the
+# kernel's HBM-round-trip savings scale with trips), with the dSGD ceiling
+# for scale. On CPU the kernel runs in interpret mode — the artifact records
+# the kernel mode so a CPU number is never mistaken for a TPU one.
+_DAD10 = dict(dad_reduction_rank=10, dad_num_pow_iters=5, dad_tol=1e-3)
+POWERITER_AB_ARMS = {
+    "dsgd-ceiling": ("dSGD", {}),
+    "rankdad-warm-legacy": ("rankDAD", dict(
+        _DAD10, dad_warm_start=True, fused_poweriter=False)),
+    "rankdad-warm-fused": ("rankDAD", dict(
+        _DAD10, dad_warm_start=True, fused_poweriter=True)),
+    "rankdad-cold-legacy": ("rankDAD", dict(
+        _DAD10, dad_tol=0.0, dad_warm_start=False, fused_poweriter=False)),
+    "rankdad-cold-fused": ("rankDAD", dict(
+        _DAD10, dad_tol=0.0, dad_warm_start=False, fused_poweriter=True)),
+}
+
+
+def _engine_ab_records(arms: dict, metric: str, obs: int, n: int,
+                       dims: dict | None, extra=None) -> list[dict]:
+    """Shared paired-interleaved engine A/B driver (the --ab-rankdad
+    protocol): compile every arm up front, interleave observations, one JSON
+    record per arm. ``extra(arm, rec)`` may decorate each record."""
+    import jax
+
+    chains = {}
+    samples = None
+    for arm, (engine, kw) in arms.items():
+        chains[arm], samples = _setup_epoch(engine, kw, dims=dims)
+        chains[arm](1)  # compile + warm up before any timing starts
+    dists = interleaved_ab(chains, n, obs=obs)
+    records = []
+    for arm, dist in dists.items():
+        engine, kw = arms[arm]
+        rec = {
+            "metric": metric,
+            "arm": arm,
+            "engine": engine,
+            "engine_kw": kw,
+            "sites": (dims or {}).get("sites", NUM_SITES),
+            "backend": jax.default_backend(),
+            "chain_epochs": n,
+            "samples_per_sec": throughput_stats(dists[arm], samples),
+            "unit": "samples/sec/chip",
+        }
+        if dims:
+            rec["dims"] = dims
+        elif rec["samples_per_sec"]["value"] is not None:
+            rec["mfu"] = round(
+                rec["samples_per_sec"]["value"] * flops_per_sample()
+                / V5E_BF16_PEAK_FLOPS, 4,
+            )
+        if extra is not None:
+            extra(arm, rec)
+        records.append(rec)
+    return records
+
+
+def measure_poweriter_ab(obs: int = 5, n: int = TIMED_EPOCHS,
+                         dims: dict | None = None) -> list[dict]:
+    """Paired interleaved A/B of the fused power-iteration kernel
+    (``--ab-poweriter``), one JSON record per arm."""
+    import jax
+
+    def extra(arm, rec):
+        if "fused" in arm:
+            rec["poweriter_kernel"] = (
+                "pallas" if jax.default_backend() == "tpu"
+                else "pallas-interpret"
+            )
+        elif "rankdad" in arm:
+            rec["poweriter_kernel"] = "xla-legacy"
+
+    return _engine_ab_records(
+        POWERITER_AB_ARMS,
+        "samples/sec/chip (ICA-LSTM federated round, fused power-iteration "
+        "A/B)",
+        obs, n, dims, extra=extra,
+    )
+
+
+def _flagship_params_template(engine_name: str, dims: dict | None):
+    """The flagship parameter tree (shapes only matter), built ONCE — the
+    wire-byte models are pure shape arithmetic over it, so per-arm byte
+    figures never rebuild the arm's dataset/state."""
+    import jax
+    import jax.numpy as jnp
+
+    from dinunet_implementations_tpu.trainer import init_train_state
+
+    # sites/steps/batch don't shape the parameters — shrink them so the
+    # template build never allocates the (multi-GB at flagship dims)
+    # synthetic dataset just to read shapes
+    tiny = {**(dims or {}), "sites": 1, "steps": 1, "batch": 1}
+    d, task, engine, opt, np_x, _, _ = _flagship_arm(engine_name, None, tiny)
+    state = init_train_state(
+        task, engine, opt, jax.random.PRNGKey(0), jnp.asarray(np_x[0, 0]),
+        num_sites=1,
+    )
+    return state.params
+
+
+def measure_wirequant_ab(quants, obs: int = 5, n: int = TIMED_EPOCHS,
+                         dims: dict | None = None,
+                         engine_name: str = "dSGD") -> list[dict]:
+    """Paired interleaved A/B of the wire-quantization codecs
+    (``--wire-quant bf16,int8,fp8``) against the f32 wire, one JSON record
+    per arm with the MODELED per-device wire bytes and the shrink vs f32 —
+    the same figures S002 verifies against the traced program."""
+    from dinunet_implementations_tpu.engines import make_engine
+    from dinunet_implementations_tpu.telemetry.metrics import payload_bytes_of
+
+    arms = {"wire-f32": (engine_name, {})}
+    for q in quants:
+        arms[f"wire-{q}"] = (engine_name, dict(wire_quant=q))
+    params = _flagship_params_template(engine_name, dims)
+    bytes_by_arm = {
+        arm: int(payload_bytes_of(make_engine(e, **kw), params))
+        for arm, (e, kw) in arms.items()
+    }
+
+    def extra(arm, rec):
+        rec["wire_quant"] = arms[arm][1].get("wire_quant", "none")
+        rec["wire_bytes_per_device_round"] = bytes_by_arm[arm]
+        rec["wire_shrink_vs_f32"] = round(
+            bytes_by_arm["wire-f32"] / max(bytes_by_arm[arm], 1), 2
+        )
+
+    return _engine_ab_records(
+        arms,
+        "samples/sec/chip (ICA-LSTM federated round, quantized-wire A/B)",
+        obs, n, dims, extra=extra,
+    )
+
+
 def _setup_pipeline_arm(arm: str, dims: dict | None = None,
                         donate: bool = True):
     """One input-pipeline A/B arm (``--pipeline``): unlike the steady-state
@@ -822,6 +959,20 @@ def main():
         dims = SMALL_DIMS if "--small" in sys.argv else None
         engine_name = (sys.argv[sys.argv.index("--engine") + 1]
                        if "--engine" in sys.argv else "dSGD")
+        # quantized wires compose with the packed sweep (r14): the sweep's
+        # wire_bytes_per_device_round then records the codec-grid bytes —
+        # the CI int8 packed smoke rides this path
+        engine_kw = None
+        if "--wire-quant" in sys.argv:
+            wq = sys.argv[sys.argv.index("--wire-quant") + 1]
+            if "," in wq:
+                # the comma-list syntax belongs to the standalone A/B mode;
+                # the composed sweep runs ONE codec per invocation
+                raise SystemExit(
+                    f"--sites composes with a single --wire-quant codec, "
+                    f"got {wq!r} (run one sweep per codec)"
+                )
+            engine_kw = {"wire_quant": wq}
         # churn smoke composition (r13): `--faults` threads a liveness mask
         # (drops / delay_at stragglers) through the PACKED round, and
         # `--staleness N` switches it to the buffered-async aggregation —
@@ -835,7 +986,7 @@ def main():
                      if "--staleness" in sys.argv else 0)
         for rec in measure_sites_scaling(
             sites_list, packs=packs, obs=obs, n=n, dims=dims,
-            engine_name=engine_name, fault_plan=plan,
+            engine_name=engine_name, engine_kw=engine_kw, fault_plan=plan,
             staleness_bound=staleness,
         ):
             print(json.dumps(rec), flush=True)
@@ -857,6 +1008,40 @@ def main():
              if "--epochs" in sys.argv else TIMED_EPOCHS)
         dims = SMALL_DIMS if "--small" in sys.argv else None
         for rec in measure_rankdad_ab(obs=obs, n=n, dims=dims):
+            print(json.dumps(rec), flush=True)
+        return
+    if "--ab-poweriter" in sys.argv:
+        # paired interleaved A/B of the fused Pallas power-iteration kernel
+        # against the legacy XLA loop (r14; same protocol as --ab-rankdad).
+        # On CPU the kernel runs in interpret mode and the records say so —
+        # regen on TPU with the same command for the flagship numbers.
+        obs = int(sys.argv[sys.argv.index("--obs") + 1]) if "--obs" in sys.argv else 5
+        n = (int(sys.argv[sys.argv.index("--epochs") + 1])
+             if "--epochs" in sys.argv else TIMED_EPOCHS)
+        dims = SMALL_DIMS if "--small" in sys.argv else None
+        for rec in measure_poweriter_ab(obs=obs, n=n, dims=dims):
+            print(json.dumps(rec), flush=True)
+        return
+    if "--wire-quant" in sys.argv:
+        # quantized-wire A/B (r14): the listed codecs (comma list from
+        # {bf16,int8,fp8}) against the f32 wire, paired interleaved; each
+        # record carries the MODELED per-device wire bytes + shrink-vs-f32
+        # that checks/semantic.py S002 proves against the traced program.
+        # (With --sites this flag instead threads the codec into the packed
+        # sweep — handled above.)
+        quants = [
+            q for q in
+            sys.argv[sys.argv.index("--wire-quant") + 1].split(",") if q
+        ]
+        obs = int(sys.argv[sys.argv.index("--obs") + 1]) if "--obs" in sys.argv else 5
+        n = (int(sys.argv[sys.argv.index("--epochs") + 1])
+             if "--epochs" in sys.argv else TIMED_EPOCHS)
+        dims = SMALL_DIMS if "--small" in sys.argv else None
+        engine_name = (sys.argv[sys.argv.index("--engine") + 1]
+                       if "--engine" in sys.argv else "dSGD")
+        for rec in measure_wirequant_ab(
+            quants, obs=obs, n=n, dims=dims, engine_name=engine_name
+        ):
             print(json.dumps(rec), flush=True)
         return
     if "--pipeline" in sys.argv:

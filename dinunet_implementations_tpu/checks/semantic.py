@@ -166,11 +166,20 @@ class ProgramAudit:
 
 #: value-preserving / scaling ops the wire-dtype walk may look through: the
 #: payload chain between "quantized to the wire dtype" and "handed to the
-#: collective" is casts, scale multiplies, liveness selects and layout moves
+#: collective" is casts, scale multiplies, liveness selects and layout
+#: moves — plus the r14 quantized-wire codec's grid ops (round/floor to the
+#: int8 grid, clamp to its range): parallel/collectives.py WireCodec
 _PASSTHROUGH = frozenset({
     "convert_element_type", "mul", "div", "add", "sub", "neg", "select_n",
     "reshape", "transpose", "broadcast_in_dim", "squeeze", "expand_dims",
     "concatenate", "slice", "stop_gradient", "copy",
+    "round", "floor", "clamp", "max", "min",
+})
+
+#: the elementwise/broadcasting subset of _PASSTHROUGH: only here may an
+#: operand smaller than the output be dismissed as a broadcasting scale
+_ELEMENTWISE = frozenset({
+    "mul", "div", "add", "sub", "select_n", "max", "min", "clamp",
 })
 
 
@@ -203,6 +212,23 @@ def _float_itemsize(dtype):
 
     d = np.dtype(dtype)
     if d.kind == "f" or jnp.issubdtype(d, jnp.floating):
+        return d.itemsize
+    return None
+
+
+def _wire_itemsize_of(dtype):
+    """Effective wire itemsize of one dtype in a payload chain: floats carry
+    their own width; a SUB-WORD integer is a quantization grid (the r14 int8
+    wire codec's ``convert→int8→convert`` round-trip — the value the chain
+    carries from there on fits in that many bytes); word-size-and-up
+    integers (indices, counters) are not payloads at all → None."""
+    import numpy as np
+
+    f = _float_itemsize(dtype)
+    if f is not None:
+        return f
+    d = np.dtype(dtype)
+    if d.kind in "iu" and d.itemsize < 4:
         return d.itemsize
     return None
 
@@ -248,7 +274,10 @@ def _payload_itemsize(var, producers: dict, max_depth: int = 10):
         aval = getattr(v, "aval", None)
         if aval is None:
             return None
-        storage = _float_itemsize(aval.dtype)
+        # sub-word integers count as quantization grids (_wire_itemsize_of):
+        # the int8 wire codec's round-trip passes through an int8 value, and
+        # everything downstream of that cast carries ≤ 1 byte of payload
+        storage = _wire_itemsize_of(aval.dtype)
         if storage is None:
             return None
         eqn = producers.get(id(v))
@@ -256,9 +285,31 @@ def _payload_itemsize(var, producers: dict, max_depth: int = 10):
             return storage
         if eqn.primitive.name not in _PASSTHROUGH:
             return storage
+        out_elems = math.prod(tuple(getattr(aval, "shape", ())))
+        elementwise = eqn.primitive.name in _ELEMENTWISE
+
+        def _scale_like(iv):
+            # a scale/mask never carries the payload: literals, scalars,
+            # explicit broadcasts (_is_scale_operand) — and, for
+            # ELEMENTWISE ops only, any operand STRICTLY SMALLER than the
+            # output, i.e. one that broadcasts against the payload (the
+            # r14 packed per-row [K, 1, 1] quant scale reaches the mul at
+            # its own rank-kept shape, no broadcast_in_dim in the jaxpr).
+            # Shape-composing ops (concatenate, slice) keep every operand
+            # as data: their inputs are legitimately smaller than the
+            # output without being scales.
+            if _is_scale_operand(iv, producers):
+                return True
+            if not elementwise:
+                return False
+            a = getattr(iv, "aval", None)
+            if a is None:
+                return True
+            return math.prod(tuple(getattr(a, "shape", ()))) < out_elems
+
         data = [
             iv for iv in eqn.invars
-            if len(eqn.invars) == 1 or not _is_scale_operand(iv, producers)
+            if len(eqn.invars) == 1 or not _scale_like(iv)
         ]
         subs = [s for s in (eff(iv, depth + 1) for iv in data) if s is not None]
         if not subs:
@@ -663,6 +714,19 @@ class TraceCell:
     # collectives still carry exactly the modeled per-device wire (S002) —
     # buffering happens in registers/HBM, never on the wire.
     staleness: int = 0
+    # wire codec (r14, parallel/collectives.py WireCodec): quantized-wire
+    # cells verify S002's byte proof resolves the quant→collective→dequant
+    # chain to the codec itemsize (the ~4x shrink, proven not modeled) and
+    # S004 does not read the dequantized f32 operand as an upcast.
+    wire_quant: str = "none"
+    # overlapped-rounds mode (r14): the double-buffered stash round —
+    # overlap cells verify the stash apply ships the SAME per-device wire
+    # as the legacy round and keeps every collective inside the scan
+    overlap: bool = False
+    # free-form label suffix for cells distinguished only by engine_kw
+    # (e.g. "+fused" for the Pallas power-iteration corner) — labels key
+    # the semantic baseline, so they must stay unique per cell
+    tag: str = ""
 
     @property
     def label(self) -> str:
@@ -671,10 +735,13 @@ class TraceCell:
             name += "-dense"
         if self.precision_bits != "32":
             name += f"@{self.precision_bits}"
+        if self.wire_quant != "none":
+            name += f"@{self.wire_quant}"
         if self.donate:
             name += "+donate"
         if self.staleness:
             name += f"+async{self.staleness}"
+        name += self.tag
         return f"{name}/{self.topology}/{self.pipeline}"
 
 
@@ -726,7 +793,7 @@ def build_cell_inputs(cell: TraceCell, engine=None) -> tuple:
     if engine is None:
         engine = make_engine(
             cell.engine, precision_bits=cell.precision_bits,
-            **dict(cell.engine_kw),
+            wire_quant=cell.wire_quant, **dict(cell.engine_kw),
         )
     opt = make_optimizer("adam", 1e-2)
     mesh = host_mesh(2) if cell.topology in ("mesh", "fold", "fold4") else None
@@ -734,6 +801,7 @@ def build_cell_inputs(cell: TraceCell, engine=None) -> tuple:
         task, engine, opt, jax.random.PRNGKey(0),
         jnp.ones((B, D), jnp.float32), num_sites=S,
         staleness_bound=cell.staleness,
+        overlap_rounds=cell.overlap,
     )
     rng = np.random.default_rng(0)
     if cell.pipeline == "device":
@@ -763,6 +831,7 @@ def trace_cell(cell: TraceCell, engine=None) -> CellProgram:
     fn = make_train_epoch_fn(
         task, engine, opt, mesh=mesh, pipeline=cell.pipeline,
         donate_state=cell.donate, staleness_bound=cell.staleness,
+        overlap_rounds=cell.overlap,
     )
     closed, _, comp = epoch_program_artifacts(fn, *args, compiled=cell.donate)
     S = args[1].shape[0]
@@ -838,6 +907,52 @@ def default_matrix() -> list:
             engine_kw=(("dad_reduction_rank", 2),),
         ),
     ]
+    # quantized wires (r14): int8 across the engine corners plus fp8, the
+    # stochastic-rounding chain, and a packed-partial re-quantization cell —
+    # S002 must prove the codec-itemsize bytes against the traced
+    # quant→collective→dequant chain, and S004 must not read the
+    # dequantized f32 operand as an upcast
+    cells += [
+        TraceCell(name, "mesh", "host", engine_kw=kw, wire_quant="int8")
+        for name, kw, dense in _ENGINE_CORNERS
+        if not dense
+    ]
+    cells += [
+        TraceCell("dSGD", "mesh", "host", wire_quant="fp8"),
+        TraceCell(
+            "rankDAD", "mesh", "host", wire_quant="fp8",
+            engine_kw=(("dad_num_pow_iters", 2), ("dad_reduction_rank", 2)),
+        ),
+        TraceCell("dSGD", "fold4", "device", wire_quant="int8"),
+        TraceCell(
+            "dSGD", "mesh", "host", wire_quant="int8",
+            engine_kw=(("wire_stochastic", True),), tag="+sr",
+        ),
+        # fused Pallas power iteration in the traced program (interpret on
+        # CPU): the kernel changes where the factorization computes, never
+        # what ships — S001/S002 must stay green with the pallas_call in
+        # the jaxpr, incl. on a packed (K=4) cell where the kernel runs
+        # under the custom_vmap member fold
+        TraceCell(
+            "rankDAD", "mesh", "host", tag="+fused",
+            engine_kw=(("dad_num_pow_iters", 2), ("dad_reduction_rank", 2),
+                       ("fused_poweriter", True)),
+        ),
+        TraceCell(
+            "rankDAD", "fold4", "host", tag="+fused", wire_quant="int8",
+            engine_kw=(("dad_num_pow_iters", 2), ("dad_reduction_rank", 2),
+                       ("fused_poweriter", True)),
+        ),
+        # overlapped rounds (r14): the stash apply's collectives are the
+        # SAME wire as the legacy round (S002), and the stash selects stay
+        # inside the rounds scan (S001)
+        TraceCell("dSGD", "mesh", "host", tag="+overlap", overlap=True),
+        TraceCell("dSGD", "fold", "device", tag="+overlap", overlap=True),
+        # the stash must alias like every other carried state under
+        # donation, or overlap mode silently doubles a grads-sized residency
+        TraceCell("dSGD", "vmap", "device", donate=True, overlap=True,
+                  tag="+overlap"),
+    ]
     return cells
 
 
@@ -860,33 +975,88 @@ IDENTITY_CASES = {
     # bound must genuinely add the buffered round
     "async-off": (dict(staleness_bound=0), True),
     "async-on": (dict(staleness_bound=2), False),
+    # quantized wires (r14): wire_quant="none" must keep the legacy
+    # precision_bits program byte-for-byte, and each codec must genuinely
+    # change the wire path. The reserved "engine" key rebuilds the corner's
+    # engine with the given make_engine overrides (the knob lives in the
+    # engine, not the epoch builder).
+    "wirequant-off": (dict(engine=dict(wire_quant="none")), True),
+    "wirequant-bf16": (dict(engine=dict(wire_quant="bf16")), False),
+    "wirequant-int8": (dict(engine=dict(wire_quant="int8")), False),
+    # overlapped rounds (r14): off = the exact legacy round, on = the
+    # double-buffered stash apply genuinely in the program
+    "overlap-off": (dict(overlap_rounds=False), True),
+    "overlap-on": (dict(overlap_rounds=True), False),
 }
+
+#: the rankDAD corner's cases — the fused power-iteration kernel only
+#: exists in the compression engines' program. The BASE cell pins
+#: fused_poweriter=False (not the auto default, which resolves per backend
+#: — on a TPU host auto=ON would flip both pairs' expectations and fail the
+#: gate spuriously), so off == baseline and on must inject the pallas_call
+#: on EVERY backend.
+IDENTITY_CASES_RANKDAD = {
+    "poweriter-fused-off": (dict(engine=dict(fused_poweriter=False)), True),
+    "poweriter-fused-on": (dict(engine=dict(fused_poweriter=True)), False),
+}
+
+#: the corner IDENTITY_CASES_RANKDAD runs on (small ranks keep the trace
+#: cheap; vmap/host is the cheapest topology with a factorization path)
+RANKDAD_IDENTITY_CELL = TraceCell(
+    "rankDAD", "vmap", "host",
+    engine_kw=(("dad_num_pow_iters", 2), ("dad_reduction_rank", 2),
+               ("fused_poweriter", False)),
+)
+
+
+def identity_text_fn(cell: TraceCell):
+    """``text(**case_kw)`` builder for one identity corner — the ONE
+    implementation behind the S005 CLI gate and the tier-1 mirror
+    (tests/test_lowering_identity.py). ``case_kw`` may carry the reserved
+    ``engine`` key: a dict of ``make_engine`` overrides layered onto the
+    cell's engine kwargs (for knobs that live in the engine — wire_quant,
+    fused_poweriter)."""
+    from ..engines import make_engine
+    from ..trainer.steps import make_train_epoch_fn
+
+    task, engine, opt, _, args, mesh = build_cell_inputs(cell)
+
+    def text(**kw):
+        kw = dict(kw)
+        eng_kw = kw.pop("engine", None)
+        eng = engine
+        if eng_kw:
+            eng = make_engine(
+                cell.engine, precision_bits=cell.precision_bits,
+                **{"wire_quant": cell.wire_quant,
+                   **dict(cell.engine_kw), **eng_kw},
+            )
+        fn = make_train_epoch_fn(task, eng, opt, mesh=mesh, **kw)
+        return fn.lower(*args).as_text()
+
+    return text
 
 
 def _identity_gate() -> list:
-    """The S005 program-identity pairs (:data:`IDENTITY_CASES`) on the
-    flagship corner (dSGD/vmap/host)."""
+    """The S005 program-identity pairs (:data:`IDENTITY_CASES` on the
+    flagship dSGD corner, :data:`IDENTITY_CASES_RANKDAD` on the rankDAD
+    one)."""
     import jax
 
-    from ..trainer.steps import make_train_epoch_fn
-
-    task, engine, opt, _, args, mesh = build_cell_inputs(
-        TraceCell("dSGD", "vmap", "host")
-    )
-
-    def text(**kw):
-        fn = make_train_epoch_fn(task, engine, opt, mesh=mesh, **kw)
-        return fn.lower(*args).as_text()
-
-    base = text()
     pairs = []
-    for label, (kw, expect_identical) in IDENTITY_CASES.items():
-        if kw is None:
-            with jax.checking_leaks():
-                variant = text()
-        else:
-            variant = text(**kw)
-        pairs.append((label, base, variant, expect_identical))
+    for cell, cases in (
+        (TraceCell("dSGD", "vmap", "host"), IDENTITY_CASES),
+        (RANKDAD_IDENTITY_CELL, IDENTITY_CASES_RANKDAD),
+    ):
+        text = identity_text_fn(cell)
+        base = text()
+        for label, (kw, expect_identical) in cases.items():
+            if kw is None:
+                with jax.checking_leaks():
+                    variant = text()
+            else:
+                variant = text(**kw)
+            pairs.append((label, base, variant, expect_identical))
     return check_lowering_identity(pairs)
 
 

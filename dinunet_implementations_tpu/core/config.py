@@ -313,6 +313,31 @@ class TrainConfig:
     # per-round-of-age weight multiplier for buffered contributions; 1.0
     # keeps stale updates at full weight until the bound cuts them off
     staleness_decay: float = 0.5
+    # quantized collective wires (r14, parallel/collectives.py WireCodec):
+    # "none" (default) keeps the legacy precision_bits wire byte-for-byte
+    # (program-identical; S005-gated); "bf16" forces a bf16 wire; "int8" /
+    # "fp8" quantize every engine payload (dSGD deltas, rankDAD/powerSGD
+    # factors) to a 1-byte grid with a scale per payload before the
+    # collective, dequantizing after the reduce — ~4x fewer wire bytes than
+    # f32, proven exactly by checks/semantic.py S002 against the traced
+    # program. Matmul precision stays governed by precision_bits.
+    wire_quant: str = "none"
+    # stochastic rounding on the int8 wire grid (unbiased in expectation;
+    # value-hashed dither, no RNG state): False = round-to-nearest-even
+    wire_stochastic: bool = False
+    # fused Pallas power-iteration kernel (r14, ops/poweriter_pallas.py):
+    # one VMEM-resident kernel per rank class for the rankDAD subspace
+    # iteration — no HBM round trips between power refinements. None =
+    # auto (on for the TPU backend, off elsewhere); False = the exact
+    # legacy XLA loop (program-identical, S005-gated); True forces the
+    # kernel (interpret-mode on CPU — parity tests / A/B bench).
+    fused_poweriter: bool | None = None
+    # overlapped rounds (r14, trainer/steps.py): issue round t's
+    # aggregation collective while round t+1's batch gather + compute run
+    # (double-buffered TrainState.overlap stash; one-round-delayed
+    # pipelined update). False (default) compiles the exact legacy round
+    # (S005-gated). Mutually exclusive with staleness_bound > 0.
+    overlap_rounds: bool = False
     # fault tolerance (robustness/): a site whose round gradient is
     # non-finite for this many CONSECUTIVE rounds is quarantined — zero
     # weight for the rest of the fit, params advance on the live sites'

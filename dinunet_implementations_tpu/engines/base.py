@@ -57,6 +57,14 @@ semantic analyzer cross-checks these against the TRACED program's collective
 operands, so a ``wire_bytes`` figure the telemetry layer reports is
 verified, not merely modeled; the shape sum must equal ``wire_bytes``
 exactly — at every pack factor.
+
+Quantized wires (r14, parallel/collectives.py ``WireCodec``): engines take
+``wire_quant`` (``none`` | ``bf16`` | ``int8`` | ``fp8``) and
+``wire_stochastic`` factory kwargs — every payload round-trips the codec
+grid (scale per payload) before its collective and the wire models above
+follow the CODEC dtype, so an int8 wire models (and S002 proves) 1 byte per
+element. ``wire_quant="none"`` keeps the legacy ``precision_bits`` path
+program-identically (S005-gated).
 """
 
 from __future__ import annotations

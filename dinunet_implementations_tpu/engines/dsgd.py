@@ -12,10 +12,14 @@ from __future__ import annotations
 
 import numpy as np
 
+import jax
+
 from ..parallel.collectives import (
+    PackedAxis,
     payload_cast,
     payload_dtype,
     payload_uncast,
+    resolve_wire_codec,
     site_weighted_mean,
 )
 from .base import (
@@ -28,8 +32,14 @@ from .base import (
 
 
 @register_engine("dSGD")
-def make_dsgd(precision_bits="32", **_unused) -> Engine:
-    pdtype = np.dtype(payload_dtype(precision_bits))
+def make_dsgd(precision_bits="32", wire_quant="none", wire_stochastic=False,
+              **_unused) -> Engine:
+    # the wire codec (parallel/collectives.py, r14): "none" keeps the legacy
+    # precision_bits payload cast byte-for-byte; int8/fp8 quantize each
+    # site's payload (scale-per-payload) before the collective and the
+    # packed partial again before the cross-device hop
+    codec = resolve_wire_codec(precision_bits, wire_quant, wire_stochastic)
+    pdtype = np.dtype(codec.dtype)
     itemsize = pdtype.itemsize
 
     def init(grads):
@@ -62,8 +72,26 @@ def make_dsgd(precision_bits="32", **_unused) -> Engine:
         # psum — the two-level reduction; the per-site payload cast below
         # keeps the reference's per-site quantization semantics either way.
         grads, weight = mask_dead_site(grads, weight, live)
-        payload = payload_cast(grads, precision_bits)
-        agg = site_weighted_mean(payload, weight, axis_name, wire_dtype=pdtype)
+        if codec.quant == "none":
+            # legacy precision_bits wire, program-identical to pre-r14
+            # (S005-gated: the disabled codec must compile the exact legacy
+            # epoch)
+            payload = payload_cast(grads, precision_bits)
+            agg = site_weighted_mean(
+                payload, weight, axis_name, wire_dtype=pdtype
+            )
+            return payload_uncast(agg, grads), state
+        # quantized wire: each (virtual) site round-trips its payload through
+        # the codec grid — scale per payload, per packed row under a
+        # PackedAxis — then the f32-accumulating weighted mean runs as usual;
+        # on the packed path the in-register partial re-quantizes before the
+        # single cross-device psum (two_level_psum). The traced
+        # quantize→psum chain is what S002/S004 resolve to prove the shrink.
+        packed = isinstance(axis_name, PackedAxis)
+        payload = jax.tree.map(
+            lambda g: codec.compress(g, batched=packed), grads
+        )
+        agg = site_weighted_mean(payload, weight, axis_name, wire_dtype=codec)
         return payload_uncast(agg, grads), state
 
     return Engine("dSGD", init, aggregate, wire_bytes=wire_bytes,

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from ..parallel.collectives import (
     PackedAxis,
     payload_dtype,
+    resolve_wire_codec,
     site_weight_scale,
     two_level_psum,
     weighted_site_sum,
@@ -46,6 +47,8 @@ def make_powersgd(
     dad_reduction_rank: int = 10,
     precision_bits="32",
     seed: int = 0,
+    wire_quant="none",
+    wire_stochastic=False,
     **_unused,
 ) -> Engine:
     pdtype = payload_dtype(precision_bits)
@@ -53,6 +56,24 @@ def make_powersgd(
     # wire also runs the big M@q / MᵀP products as bf16×bf16→f32 MXU
     # contractions; orthonormalization stays f32. "16-ieee"/"32" keep f32.
     mm_dtype = jnp.bfloat16 if pdtype == jnp.bfloat16 else None
+    # quantized wire (r14): the two factor psums ride the codec grid —
+    # quantization noise on P/Q' lands in the error-feedback residual e and
+    # is flushed over subsequent rounds, exactly the mechanism powerSGD's
+    # own low-rank truncation already relies on. "none" keeps the legacy
+    # precision_bits wire byte-for-byte (S005-gated).
+    codec = resolve_wire_codec(precision_bits, wire_quant, wire_stochastic)
+    import numpy as np
+
+    wdtype = np.dtype(codec.dtype)
+
+    def _compress(x):
+        if codec.quant == "none":
+            return wire_compress(x, pdtype)  # the exact legacy program
+        return codec.compress(x)
+
+    # what two_level_psum quantizes the packed partial with (the legacy arm
+    # must stay lowering-identical, so it keeps the plain-dtype spelling)
+    wire_arg = codec if codec.quant != "none" else pdtype
 
     def init(grads):
         leaves, treedef = jax.tree.flatten(grads)
@@ -80,10 +101,8 @@ def make_powersgd(
         # both factor psums and the dense 1-D psums reduce over the packed
         # virtual-site axis in-register before the wire (two_level_psum), so
         # the device ships one partial per factor regardless of K.
-        import numpy as np
-
         return lowrank_wire_bytes(
-            grads, dad_reduction_rank, np.dtype(pdtype).itemsize
+            grads, dad_reduction_rank, wdtype.itemsize
         )
 
     def wire_shapes(grads, pack: int = 1):
@@ -94,7 +113,7 @@ def make_powersgd(
         import numpy as np
 
         groups, dense = lowrank_rank_groups(grads, dad_reduction_rank)
-        pd = np.dtype(pdtype)
+        pd = wdtype
         shapes = []
         for r, mns in groups:
             for m, n in mns:
@@ -147,12 +166,12 @@ def make_powersgd(
                 sc = scale[:, None, None]
                 M = jax.vmap(to_matrix)(g).astype(jnp.float32) + e
                 P = two_level_psum(
-                    lp_matmul(M, q, mm_dtype) * sc, axis_name, pdtype
+                    lp_matmul(M, q, mm_dtype) * sc, axis_name, wire_arg
                 )
                 P = orthonormalize(P)
                 q_new = two_level_psum(
                     lp_matmul(jnp.swapaxes(M, 1, 2), P, mm_dtype) * sc,
-                    axis_name, pdtype,
+                    axis_name, wire_arg,
                 )
                 G_hat = P @ q_new.T  # the global aggregate, replicated
                 e_new = M - G_hat[None]
@@ -165,15 +184,16 @@ def make_powersgd(
                     e_new,
                 )
             M = to_matrix(g).astype(jnp.float32) + e
-            # wire-compress to the payload dtype, then accumulate in fp32
-            # (policy in parallel/collectives.py: psum never runs in bf16)
+            # wire-compress to the payload/codec grid, then accumulate in
+            # fp32 (policy in parallel/collectives.py: psum never runs in a
+            # narrow dtype)
             P = jax.lax.psum(
-                wire_compress(lp_matmul(M, q, mm_dtype) * scale, pdtype),
+                _compress(lp_matmul(M, q, mm_dtype) * scale),
                 axis_name,
             )
             P = orthonormalize(P)
             q_new = jax.lax.psum(
-                wire_compress(lp_matmul(M.T, P, mm_dtype) * scale, pdtype),
+                _compress(lp_matmul(M.T, P, mm_dtype) * scale),
                 axis_name,
             )
             G_hat = P @ q_new.T
@@ -191,7 +211,5 @@ def make_powersgd(
         }
         return agg, new_state
 
-    import numpy as np
-
     return Engine("powerSGD", init, aggregate, wire_bytes=wire_bytes,
-                  wire_shapes=wire_shapes, wire_dtype=np.dtype(pdtype))
+                  wire_shapes=wire_shapes, wire_dtype=wdtype)

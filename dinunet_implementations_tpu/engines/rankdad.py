@@ -43,6 +43,7 @@ import jax.numpy as jnp
 from ..parallel.collectives import (
     PackedAxis,
     payload_dtype,
+    resolve_wire_codec,
     site_all_gather_packed,
     site_weight_scale,
     weighted_site_sum,
@@ -66,12 +67,36 @@ def make_rankdad(
     dad_tol: float = 1e-3,
     precision_bits="32",
     dad_warm_start: bool = True,
+    wire_quant="none",
+    wire_stochastic=False,
+    fused_poweriter: bool | None = None,
     **_unused,
 ) -> Engine:
     pdtype = payload_dtype(precision_bits)
     # bf16 wire ⇒ bf16 power-iteration matmuls (see module docstring);
     # "16-ieee"/"32" keep f32 math.
     mm_dtype = jnp.bfloat16 if pdtype == jnp.bfloat16 else None
+    # quantized wire (r14): the gathered P/Q factor blocks round-trip the
+    # codec grid (scale per factor, per virtual-site row under packing)
+    # before the all_gather; "none" keeps the legacy precision_bits cast
+    # byte-for-byte (S005-gated). The matmul precision stays governed by
+    # precision_bits — wire and compute knobs compose.
+    codec = resolve_wire_codec(precision_bits, wire_quant, wire_stochastic)
+    import numpy as np
+
+    wdtype = np.dtype(codec.dtype)
+
+    def _use_fused() -> bool:
+        # fused Pallas power iteration (ops/poweriter_pallas.py): None =
+        # auto (on for the TPU backend, off elsewhere — the interpret-mode
+        # CPU kernel exists for parity tests and the A/B bench, not as the
+        # default CPU path). Resolved lazily at trace time so engine
+        # construction never forces jax backend initialization.
+        if fused_poweriter is None:
+            return jax.default_backend() == "tpu"
+        # factory kwarg, never a tracer: a static Python flag from
+        # TrainConfig.fused_poweriter
+        return bool(fused_poweriter)  # jaxlint: disable=R005
 
     def _effective_rank(g) -> int:
         # shape arithmetic only (g may be a ShapeDtypeStruct row template on
@@ -101,11 +126,11 @@ def make_rankdad(
         # payload model (engines/lowrank.py lowrank_wire_bytes). The gather
         # half scales with the site-packing factor K (every virtual site's
         # factors genuinely cross the wire); the dense 1-D psum half reduces
-        # locally over the pack axis first and is K-invariant.
-        import numpy as np
-
+        # locally over the pack axis first and is K-invariant. Bytes follow
+        # the WIRE dtype (codec grid), not the compute dtype — int8/fp8
+        # wires model (and S002 proves) the 4x shrink.
         return lowrank_wire_bytes(
-            grads, dad_reduction_rank, np.dtype(pdtype).itemsize, pack=pack
+            grads, dad_reduction_rank, wdtype.itemsize, pack=pack
         )
 
     def wire_shapes(grads, pack: int = 1):
@@ -118,7 +143,7 @@ def make_rankdad(
 
         groups, dense = lowrank_rank_groups(grads, dad_reduction_rank)
         shapes = [
-            ((pack, sum(m + n for m, n in mns), r), np.dtype(pdtype))
+            ((pack, sum(m + n for m, n in mns), r), wdtype)
             for r, mns in groups
         ]
         return shapes + [(s, np.dtype(np.float32)) for s in dense]
@@ -185,6 +210,7 @@ def make_rankdad(
                 return subspace_iteration_grouped(
                     [(ms, r, oms) for r, (ms, oms) in zip(rs, groups_in)],
                     dad_num_pow_iters, dad_tol, matmul_dtype=mm_dtype,
+                    fused=_use_fused(),
                 )
 
             results = jax.vmap(factorize)(arg)
@@ -196,6 +222,7 @@ def make_rankdad(
                     for r, idxs in order
                 ],
                 dad_num_pow_iters, dad_tol, matmul_dtype=mm_dtype,
+                fused=_use_fused(),
             )
         for (r, idxs), pqs in zip(order, results):
             # weight one factor so the gathered reconstruction sums to the
@@ -205,8 +232,17 @@ def make_rankdad(
             parts = []
             for P, Q in pqs:
                 qs = Q * (scale[:, None, None] if packed else scale)
-                parts.append(P.astype(pdtype))
-                parts.append(qs.astype(pdtype))
+                if codec.quant == "none":
+                    # legacy precision_bits cast (program-identical pre-r14)
+                    parts.append(P.astype(pdtype))
+                    parts.append(qs.astype(pdtype))
+                else:
+                    # quantized wire: each factor round-trips the codec grid
+                    # (scale per factor / per virtual-site row) before the
+                    # gather; the traced quantize→all_gather chain is what
+                    # S002/S004 resolve to prove the byte shrink
+                    parts.append(codec.compress(P, batched=packed))
+                    parts.append(codec.compress(qs, batched=packed))
             gathered = site_all_gather_packed(parts, axis_name)
             for k, (i, (P, Q)) in enumerate(zip(idxs, pqs)):
                 G_hat = jnp.einsum(
@@ -235,7 +271,5 @@ def make_rankdad(
         )
         return jax.tree.unflatten(treedef, out), new_state
 
-    import numpy as np
-
     return Engine("rankDAD", init, aggregate, wire_bytes=wire_bytes,
-                  wire_shapes=wire_shapes, wire_dtype=np.dtype(pdtype))
+                  wire_shapes=wire_shapes, wire_dtype=wdtype)

@@ -32,6 +32,7 @@ Axis forms (r12 — site packing). ``axis_name`` may be:
 from __future__ import annotations
 
 import dataclasses
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -106,9 +107,14 @@ def two_level_psum(x, axes: PackedAxis, wire_dtype=None):
     ``[K]`` virtual-site axis, the partial optionally quantized to
     ``wire_dtype`` (what the device actually ships — f32 accumulation resumes
     after the collective, policy above), then ONE cross-device psum of the
-    UNBATCHED partial. The wire cost is K-independent by construction."""
+    UNBATCHED partial. The wire cost is K-independent by construction.
+    ``wire_dtype`` may be a plain dtype (legacy bf16 round-trip) or a
+    :class:`WireCodec` (r14 quantized wires — the partial re-quantizes with
+    its own per-payload scale before the cross-device hop)."""
     part = jnp.sum(x, axis=0)
-    if wire_dtype is not None:
+    if isinstance(wire_dtype, WireCodec):
+        part = wire_dtype.compress(part)
+    elif wire_dtype is not None:
         part = wire_compress(part, wire_dtype)
     if axes.name is None:
         return part
@@ -232,6 +238,140 @@ def wire_compress(x, pdtype):
     reduction itself accumulates at full precision (policy above: psum never
     runs in bf16)."""
     return x.astype(pdtype).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# quantized wire codecs (r14)
+# ---------------------------------------------------------------------------
+
+#: accepted TrainConfig.wire_quant values. "none" keeps the legacy
+#: precision_bits wire byte-for-byte (program-identical, S005-gated);
+#: "bf16" forces a bf16 wire regardless of precision_bits; "int8"/"fp8"
+#: are the scale-per-payload quantized codecs below.
+WIRE_QUANTS = ("none", "bf16", "int8", "fp8")
+
+#: largest finite float8_e4m3fn magnitude — the fp8 codec maps each
+#: payload's amax onto it so small-gradient tensors don't flush to zero
+#: (e4m3's min normal is ~1.6e-2; raw-cast gradients of ~1e-4 would vanish)
+FP8_E4M3_MAX = 448.0
+
+
+def _dither_uniform(v):
+    """Deterministic per-element uniform in [0, 1) for stochastic rounding,
+    derived by hashing the value's own float bits (splitmix/murmur-style
+    integer finalizer) — no RNG key to thread through the engines, identical
+    across topologies and replays, and decorrelated across elements/rounds
+    because the hashed bits change with the value. 24-bit mantissa-exact."""
+    bits = jax.lax.bitcast_convert_type(v.astype(jnp.float32), jnp.uint32)
+    h = bits * jnp.uint32(0x9E3779B9)
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> jnp.uint32(16))
+    return (h >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
+
+
+def _payload_amax_scale(xf, batched: bool, grid_max: float):
+    """Per-payload symmetric scale mapping ``amax`` onto the codec grid's
+    largest representable magnitude. ``batched=True`` treats the LEADING axis
+    as the virtual-site axis (one scale per packed row — each virtual site
+    quantizes its own payload, matching the per-member semantics of the
+    classic one-site-per-device form). All-zero (a masked dead site's
+    where-zeroed payload) and non-finite amax fall back to scale 1.0, so the
+    codec never manufactures NaN out of a 0/0."""
+    axes = tuple(range(1, xf.ndim)) if batched else None
+    amax = jnp.max(jnp.abs(xf), axis=axes, keepdims=batched)
+    ok = jnp.isfinite(amax) & (amax > 0)
+    return jnp.where(ok, amax / jnp.float32(grid_max), 1.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class WireCodec:
+    """One wire-quantization codec: what a collective payload is QUANTIZED to
+    before it ships, and how it is restored after.
+
+    ``compress`` follows the repo's established bf16-wire pattern
+    (:func:`wire_compress`): the payload round-trips through the wire grid
+    and the collective itself accumulates in f32 — reductions never run in a
+    narrow dtype, and dequantization distributes over the sum exactly
+    (``Σ_s scale_s·q_s`` is the same value whether each member dequantizes
+    before the reduce or a transport dequantizes after it; the traced
+    program carries the quantize→collective chain, which checks/semantic.py
+    S002/S004 resolve to the wire dtype to PROVE the byte shrink). ``dtype``
+    is what crosses the wire per element — int8/fp8 are 1 byte, a 4× shrink
+    over f32; a physical transport adds one f32 scale scalar per payload
+    (modeled as negligible, not counted in ``Engine.wire_bytes``).
+
+    ``quant="none"`` reproduces the legacy ``precision_bits`` round-trip
+    bit-for-bit — engines keep their historical code path there, so the
+    disabled codec is program-identical (S005-gated).
+
+    ``stochastic=True`` (int8 only) rounds stochastically on the quant grid
+    — ``floor(v + u)``, ``u ~ U[0,1)`` from :func:`_dither_uniform` — making
+    the quantizer unbiased in expectation; fp8 keeps round-to-nearest-even
+    (hardware cast semantics)."""
+
+    quant: str  # "none" | "bf16" | "int8" | "fp8"
+    dtype: Any  # numpy dtype on the wire (what Engine.wire_dtype reports)
+    stochastic: bool = False
+
+    def compress(self, x, batched: bool = False):
+        """Round-trip one payload leaf through the wire grid (f32 in/out).
+        ``batched=True``: leading axis is the packed virtual-site axis —
+        scale per row (see :func:`_payload_amax_scale`)."""
+        xf = x.astype(jnp.float32)
+        if self.quant == "none":
+            return wire_compress(xf, self.dtype)
+        if self.quant == "bf16":
+            return wire_compress(xf, jnp.bfloat16)
+        if self.quant == "int8":
+            scale = _payload_amax_scale(xf, batched, 127.0)
+            v = xf / scale
+            if self.stochastic:
+                q = jnp.floor(v + _dither_uniform(v))
+            else:
+                q = jnp.round(v)
+            q = jnp.clip(q, -127.0, 127.0).astype(jnp.int8)
+            return q.astype(jnp.float32) * scale
+        if self.quant == "fp8":
+            scale = _payload_amax_scale(xf, batched, FP8_E4M3_MAX)
+            q = (xf / scale).astype(jnp.float8_e4m3fn)
+            return q.astype(jnp.float32) * scale
+        raise ValueError(f"unknown wire codec {self.quant!r}")
+
+
+def resolve_wire_codec(precision_bits="32", wire_quant: str = "none",
+                       stochastic: bool = False) -> WireCodec:
+    """Resolve ``(precision_bits, TrainConfig.wire_quant)`` to the engine's
+    wire codec. ``wire_quant="none"`` defers entirely to ``precision_bits``
+    (the legacy wire); any other value overrides the WIRE dtype only — the
+    power-iteration matmul precision stays governed by ``precision_bits``
+    (engines/rankdad.py ``mm_dtype``), the two knobs compose."""
+    import numpy as np
+
+    if wire_quant not in WIRE_QUANTS:
+        raise ValueError(
+            f"wire_quant must be one of {WIRE_QUANTS}, got {wire_quant!r}"
+        )
+    if wire_quant == "none":
+        dtype = np.dtype(_PAYLOAD_DTYPES[precision_bits])
+    elif wire_quant == "bf16":
+        dtype = np.dtype(jnp.bfloat16)
+    elif wire_quant == "int8":
+        dtype = np.dtype(np.int8)
+    else:  # fp8
+        if not hasattr(jnp, "float8_e4m3fn"):  # pragma: no cover - old jax
+            raise ValueError(
+                "wire_quant='fp8' needs jnp.float8_e4m3fn (ml_dtypes); "
+                "this jax build lacks it — use 'int8' or 'bf16'"
+            )
+        dtype = np.dtype(jnp.float8_e4m3fn)
+    # factory kwarg, never a tracer: TrainConfig.wire_stochastic is static
+    return WireCodec(
+        quant=wire_quant, dtype=dtype,
+        stochastic=bool(stochastic) and wire_quant == "int8",  # jaxlint: disable=R005
+    )
 
 
 def site_index(axis_name=SITE_AXIS):

@@ -147,6 +147,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--process-id", type=int, default=None,
                    help="multi-host runs: this process's rank")
     p.add_argument("--quiet", action="store_true")
+    p.add_argument("--wire-quant", default=None,
+                   choices=["none", "bf16", "int8", "fp8"],
+                   help="quantize collective payloads to this wire grid "
+                        "(scale per payload, dequant after reduce; ~4x "
+                        "fewer wire bytes at int8/fp8 — "
+                        "parallel/collectives.py WireCodec)")
+    p.add_argument("--overlap-rounds", action="store_true", default=None,
+                   help="overlap round t's aggregation collective with "
+                        "round t+1's batch gather + compute (one-round-"
+                        "delayed pipelined update; trainer/steps.py)")
+    p.add_argument("--fused-poweriter", default=None,
+                   choices=["auto", "on", "off"],
+                   help="fused Pallas power-iteration kernel for the "
+                        "rankDAD subspace iteration (default auto: on for "
+                        "the TPU backend; ops/poweriter_pallas.py)")
     p.add_argument("--set", dest="overrides", action="append", default=[],
                    metavar="KEY=VALUE",
                    help="override any TrainConfig / task-args field "
@@ -168,6 +183,12 @@ def main(argv: list[str] | None = None) -> int:
         ("xprof_dir", args.xprof_dir),
         ("pipeline", args.pipeline),
         ("compile_cache_dir", args.compile_cache),
+        ("wire_quant", args.wire_quant),
+        ("overlap_rounds", args.overlap_rounds),
+        ("fused_poweriter", (
+            None if args.fused_poweriter in (None, "auto")
+            else args.fused_poweriter == "on"
+        )),
     ):
         if val is not None:
             overrides[key] = val

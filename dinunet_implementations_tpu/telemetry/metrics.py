@@ -120,8 +120,15 @@ def payload_bytes_of(engine, grads_template, pack: int = 1) -> float:
         return float(wb(grads_template))
     import jax
 
+    # model-less engines: every leaf shipped whole AT THE ENGINE'S DECLARED
+    # WIRE dtype — the modeled bytes must follow wire_dtype, not assume the
+    # f32 compute itemsize, or telemetry's payload_bytes_per_round (and the
+    # logs.json rollups) silently overstate a quantized wire 4x (r14 fix;
+    # S002 enforces the figure against the traced program)
+    isz = np.dtype(getattr(engine, "wire_dtype", None) or np.float32).itemsize
     return float(sum(
-        math.prod(leaf.shape) * 4 for leaf in jax.tree.leaves(grads_template)
+        math.prod(leaf.shape) * isz
+        for leaf in jax.tree.leaves(grads_template)
     ))
 
 
@@ -143,8 +150,11 @@ def modeled_wire_shapes(engine, grads_template, pack: int = 1) -> list:
         return [(tuple(s), np.dtype(d)) for s, d in shapes]
     import jax
 
+    # fallback mirrors payload_bytes_of: dense leaves at the engine's
+    # declared wire dtype (f32 only when the engine declares nothing)
+    d = np.dtype(getattr(engine, "wire_dtype", None) or np.float32)
     return [
-        (tuple(leaf.shape), np.dtype(np.float32))
+        (tuple(leaf.shape), d)
         for leaf in jax.tree.leaves(grads_template)
     ]
 

@@ -126,6 +126,10 @@ def save_checkpoint(
         # daemon must keep each slot's pending update + age, or a straggling
         # site's in-flight contribution would be silently dropped on restart
         "buffers": state.buffers if state.buffers is not None else {},
+        # overlapped-rounds stash (r14): the round whose aggregation is in
+        # flight when the fit checkpoints — resume applies it instead of
+        # dropping one round of data
+        "overlap": state.overlap if state.overlap is not None else {},
         # meta rides INSIDE the msgpack so state+meta are one atomic unit (a
         # kill between two separate files would pair epoch-N state with
         # epoch-(N-1) bookkeeping and resume from the wrong epoch)
@@ -173,6 +177,7 @@ def load_checkpoint(path: str, like: TrainState, with_meta: bool = False,
     health_raw = raw.pop("health", None)
     telemetry_raw = raw.pop("telemetry", None)
     buffers_raw = raw.pop("buffers", None)
+    overlap_raw = raw.pop("overlap", None)
     restored = flax.serialization.from_state_dict(template, raw)
     restored["meta_json"] = meta_json
     try:
@@ -227,6 +232,22 @@ def load_checkpoint(path: str, like: TrainState, with_meta: bool = False,
                 "match the current run (site count or model changed?); "
                 "resuming with fresh never-deposited buffers."
             )
+    # the overlapped-rounds stash restores the same tolerant way: absent in
+    # pre-0.9 checkpoints (or when the resuming run has overlap off) → a
+    # fresh EMPTY stash / None (the resumed first round then applies
+    # nothing, like a fresh fit's), never a failed resume
+    overlap = like.overlap
+    if overlap_raw and like.overlap is not None:
+        try:
+            overlap = flax.serialization.from_state_dict(
+                like.overlap, overlap_raw
+            )
+        except (KeyError, TypeError, ValueError):
+            warnings.warn(
+                f"[warn] checkpoint {path}: stored overlap stash does not "
+                "match the current run (site count or model changed?); "
+                "resuming with an empty stash."
+            )
     state = TrainState(
         params=restored["params"],
         batch_stats=restored["batch_stats"],
@@ -237,6 +258,7 @@ def load_checkpoint(path: str, like: TrainState, with_meta: bool = False,
         health=health,
         telemetry=telemetry,
         buffers=buffers,
+        overlap=overlap,
     )
     if with_meta:
         meta = restored.get("meta_json")

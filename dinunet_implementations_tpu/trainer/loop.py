@@ -87,7 +87,9 @@ class FederatedTrainer:
         self.task = FederatedTask(model)
         task_args = dataclasses.asdict(cfg.task_args())
         self.engine = make_engine(
-            cfg.agg_engine, precision_bits=cfg.precision_bits, seed=cfg.seed, **task_args
+            cfg.agg_engine, precision_bits=cfg.precision_bits, seed=cfg.seed,
+            wire_quant=cfg.wire_quant, wire_stochastic=cfg.wire_stochastic,
+            fused_poweriter=cfg.fused_poweriter, **task_args
         )
         self.optimizer = make_optimizer(cfg.optimizer, cfg.learning_rate)
         if cfg.pipeline not in ("device", "host"):
@@ -128,6 +130,7 @@ class FederatedTrainer:
             telemetry=self._telemetry_on,
             staleness_bound=cfg.staleness_bound,
             staleness_decay=cfg.staleness_decay,
+            overlap_rounds=cfg.overlap_rounds,
         )
         self.eval_fn = make_eval_fn(self.task, mesh)
         self._inventory = None  # device-resident site inventory, one per fit
@@ -188,6 +191,7 @@ class FederatedTrainer:
             num_sites=num_sites or getattr(self, "_num_sites", 1),
             telemetry=self._telemetry_on,
             staleness_bound=self.cfg.staleness_bound,
+            overlap_rounds=self.cfg.overlap_rounds,
         )
         return self._place_state(state)
 

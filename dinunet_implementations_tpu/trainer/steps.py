@@ -85,6 +85,18 @@ class TrainState:
     # buffering ops at all (bitwise-equal to the bulk-sync program, the
     # telemetry=off pattern; S005-gated).
     buffers: Any = None
+    # PER-SITE double-buffered round payload (r14 compute/comm overlap,
+    # :func:`default_overlap_stash`): the previous round's gradients /
+    # weights / loss / liveness, whose aggregation collective is issued
+    # while the NEXT round's batch gather + forward/backward compute — the
+    # one-round-delayed pipelined update. Riding TrainState (not just the
+    # scan carry) means no round is ever dropped at an epoch boundary: the
+    # epoch's last stash applies at the next epoch's first round, and a
+    # checkpointed fit resumes with its in-flight round intact. None
+    # whenever TrainConfig.overlap_rounds is off — the epoch program then
+    # carries no overlap ops at all (bitwise-equal legacy program,
+    # S005-gated).
+    overlap: Any = None
 
 
 def _state_specs(state: TrainState):
@@ -104,6 +116,7 @@ def _state_specs(state: TrainState):
         health=jax.tree.map(lambda _: P(SITE_AXIS), state.health),
         telemetry=jax.tree.map(lambda _: P(SITE_AXIS), state.telemetry),
         buffers=jax.tree.map(lambda _: P(SITE_AXIS), state.buffers),
+        overlap=jax.tree.map(lambda _: P(SITE_AXIS), state.overlap),
     )
 
 
@@ -176,6 +189,7 @@ def init_train_state(
     num_sites: int = 1,
     telemetry: bool = False,
     staleness_bound: int = 0,
+    overlap_rounds: bool = False,
 ) -> TrainState:
     params, batch_stats = task.init_variables(rng, sample_x)
     site_state = engine.init(params)
@@ -200,7 +214,37 @@ def init_train_state(
             default_async_buffers(num_sites, params)
             if staleness_bound > 0 else None
         ),
+        # overlap stash only for the pipelined-rounds mode (same structural
+        # reasoning: the carried state must match the program)
+        overlap=(
+            default_overlap_stash(num_sites, params, batch_stats)
+            if overlap_rounds else None
+        ),
     )
+
+
+def default_overlap_stash(num_sites: int, params, batch_stats) -> dict:
+    """Fresh (empty) double-buffered round payload for the overlapped-rounds
+    mode (r14): per-site ``grads``/``stats``/``weight``/``loss``/``live``
+    slots holding the round whose aggregation is still in flight, plus
+    ``valid`` (0 = nothing stashed yet — the very first round of a fit
+    applies no update). All leaves carry the ``[num_sites]`` leading axis,
+    ride ``TrainState.overlap`` sharded ``P(site)``, are checkpointed
+    (trainer/checkpoint.py — a resumed fit continues its in-flight round),
+    and are distinct arrays so state donation never aliases a buffer
+    twice."""
+    return {
+        "grads": jax.tree.map(
+            lambda p: jnp.zeros((num_sites,) + p.shape, p.dtype), params
+        ),
+        "stats": jax.tree.map(
+            lambda s: jnp.zeros((num_sites,) + s.shape, s.dtype), batch_stats
+        ),
+        "weight": jnp.zeros((num_sites,), jnp.float32),
+        "loss": jnp.zeros((num_sites,), jnp.float32),
+        "live": jnp.zeros((num_sites,), jnp.float32),
+        "valid": jnp.zeros((num_sites,), jnp.float32),
+    }
 
 
 def _gather_batch(inv_x, inv_y, ixs, poison):
@@ -237,6 +281,7 @@ def make_train_epoch_fn(
     telemetry: bool = False,
     staleness_bound: int = 0,
     staleness_decay: float = 0.5,
+    overlap_rounds: bool = False,
 ):
     """Build the jitted epoch function.
 
@@ -305,6 +350,29 @@ def make_train_epoch_fn(
     to the bulk-sync round anyway. Arrival masks are traced inputs, so churn
     and straggle patterns never recompile.
 
+    Overlapped rounds (r14 — compute/communication overlap):
+    ``overlap_rounds=True`` software-pipelines the rounds scan so round
+    *t*'s aggregation collective is issued against a double-buffered stash
+    (``TrainState.overlap``) while round *t+1*'s batch gather and
+    forward/backward run — the two are data-independent, so XLA's
+    latency-hiding scheduler can split the collective into start/done and
+    hide ICI/DCN time under the compute (the TPUv4 pjit overlap playbook;
+    an ``optimization_barrier`` pins the stash read ahead of the batch
+    block). The cost is ONE ROUND of update delay: round *t*'s gradients
+    are computed at parameters that do not yet include round *t−1*'s
+    update (classic pipelined/delayed SGD — momentum smooths the one-step
+    staleness exactly as it does for the buffered-async mode). The stash
+    rides ``TrainState`` rather than the bare scan carry, so nothing is
+    dropped at epoch boundaries (the last round of epoch *e* applies at
+    the first round of epoch *e+1*) and checkpoint/resume keeps the
+    in-flight round. The very first round of a fit applies nothing
+    (``valid=0`` — reported as a NaN loss, like an all-dead round).
+    Mutually exclusive with ``staleness_bound > 0`` (two different
+    staleness semantics over one buffer would compound); implies the
+    guarded round form. ``overlap_rounds=False`` (default) statically
+    compiles ALL of it out — the exact legacy program (S005
+    "overlap-off").
+
     Telemetry (telemetry/metrics.py): ``telemetry=True`` accumulates, every
     round, per-site grad/update norms, the engine aggregation residual and
     modeled payload bytes into ``state.telemetry`` — traced values riding the
@@ -344,6 +412,15 @@ def make_train_epoch_fn(
     # trace-time static: the buffered-async machinery exists iff the bound is
     # positive — staleness_bound=0 compiles the exact bulk-sync program
     buffered = staleness_bound > 0
+    # builder kwarg, never a tracer: the static TrainConfig.overlap_rounds
+    overlap = bool(overlap_rounds)  # jaxlint: disable=R005
+    if overlap and buffered:
+        raise ValueError(
+            "overlap_rounds and staleness_bound > 0 are mutually exclusive: "
+            "both buffer per-site updates with their own staleness "
+            "semantics (one-round pipeline delay vs decay^age weighting) "
+            "and composing them would compound the delays"
+        )
 
     def loss_fn(params, batch_stats, rng, x, y, w):
         logits, new_stats = task.apply(
@@ -440,8 +517,12 @@ def make_train_epoch_fn(
         # opt state, BN stats) compiles in only when quarantine is enabled OR
         # a liveness mask is fed; quarantine_rounds=-1 with no mask restores
         # the exact pre-robustness program (the bench escape hatch). The
-        # buffered-async mode needs the arrival gates, so it implies guard.
-        guard = quarantine_rounds >= 0 or live is not None or buffered
+        # buffered-async mode needs the arrival gates, so it implies guard;
+        # so does the overlapped-rounds mode (its empty-stash first round is
+        # a zero-live-weight round, which only the guarded form holds).
+        guard = (
+            quarantine_rounds >= 0 or live is not None or buffered or overlap
+        )
         health = state.health  # filled by epoch_fn before any shard_map
         # trace-time static: telemetry accumulators exist iff the epoch was
         # built with telemetry=True (_ensure_aux normalizes the state), so a
@@ -483,7 +564,7 @@ def make_train_epoch_fn(
 
         def one_round(carry, xs):
             (params, batch_stats, opt_state, engine_state, health, telem_st,
-             buffers, rng, rnd) = carry
+             buffers, ov, rng, rnd) = carry
             pz = None
             if use_scan_xs:
                 parts = list(xs)
@@ -509,6 +590,19 @@ def make_train_epoch_fn(
                         live_rounds, xs, axis=1, keepdims=False
                     )
                 )
+            if overlap:
+                # overlapped rounds: tie the stashed (previous-round) payload
+                # and this round's batch block into one availability point.
+                # The stash aggregation collectives and the gather+forward
+                # are data-independent; the barrier keeps XLA from sinking
+                # the stash read below the compute, so the latency-hiding
+                # scheduler is free to issue collective-start first and hide
+                # the ICI/DCN time under phase B (TPUv4 pjit overlap
+                # playbook — the async start/done split happens in XLA).
+                if inventory is not None:
+                    ov, ib = jax.lax.optimization_barrier((ov, ib))
+                else:
+                    ov, xb = jax.lax.optimization_barrier((ov, xb))
             if inventory is not None:
                 # on-device batch gather from the resident inventory — only
                 # this round's [k, L, B, ...] block is materialized
@@ -671,14 +765,15 @@ def make_train_epoch_fn(
                     "quarantined": quarantined,
                 }
 
-            def packed_round(hs, ts, bf, ls, es):
-                """The two-level round: per-site grads under the inner vmap,
-                everything that communicates outside it on the [k]-batched
-                block with PackedAxis collectives — one cross-device
-                collective per payload, k-invariant psum wire."""
-                site_grad, n_sum, stats_k, loss_site = jax.vmap(
-                    site_micro, axis_name=inner_axis
-                )(xb, yb, wb)
+            def packed_apply(hs, ts, bf, ls, es, site_grad, n_sum, stats_k,
+                             loss_site):
+                """The communicate/apply half of the two-level round, on an
+                already-computed per-site payload: engine aggregate, sync-BN,
+                round loss and health on the [k]-batched block with
+                PackedAxis collectives — one cross-device collective per
+                payload, k-invariant psum wire. In the overlapped-rounds
+                mode the payload comes from the previous round's stash
+                instead of this round's fresh gradients."""
                 gsq = _rows_sq_sum(site_grad) if ts is not None else None
                 if not guard:
                     agg, es_new = engine.aggregate(
@@ -780,8 +875,21 @@ def make_train_epoch_fn(
                 return (agg, es_new, hs_new, ts_new, bf, stats_out, loss_round,
                         total_live)
 
-            def site_part(es, hs, ts, bf, ls, xs, ys, ws):
-                site_grad, n_sum, new_stats, loss_sum = site_micro(xs, ys, ws)
+            def packed_round(hs, ts, bf, ls, es):
+                """The two-level round: per-site grads under the inner vmap,
+                then :func:`packed_apply` on this round's fresh payload."""
+                site_grad, n_sum, stats_k, loss_site = jax.vmap(
+                    site_micro, axis_name=inner_axis
+                )(xb, yb, wb)
+                return packed_apply(
+                    hs, ts, bf, ls, es, site_grad, n_sum, stats_k, loss_site
+                )
+
+            def site_apply(es, hs, ts, bf, ls, site_grad, n_sum, new_stats,
+                           loss_sum):
+                """The communicate/apply half of the classic (in-vmap) round
+                on an already-computed per-site payload — the scalar twin of
+                :func:`packed_apply`."""
                 if not guard:
                     # fault machinery statically compiled out: the exact
                     # legacy round (no finite check, no selects, no counters)
@@ -857,7 +965,66 @@ def make_train_epoch_fn(
                 return (agg, es_new, hs_new, _ts_round_site(ts, site_grad, agg),
                         bf, new_stats, loss_round, total_live)
 
-            if packed:
+            def site_part(es, hs, ts, bf, ls, xs, ys, ws):
+                site_grad, n_sum, new_stats, loss_sum = site_micro(xs, ys, ws)
+                return site_apply(
+                    es, hs, ts, bf, ls, site_grad, n_sum, new_stats, loss_sum
+                )
+
+            if overlap:
+                # -- overlapped rounds (r14): phase B computes THIS round's
+                # per-site gradients at the carried (pre-update) params;
+                # phase A aggregates and applies the STASHED previous round.
+                # The two phases share no data, so the stash collectives
+                # overlap the gather+forward in the XLA schedule (barrier
+                # above). Health/telemetry are valid-gated: the empty-stash
+                # first round must not count skips or accumulate rounds.
+                fresh_grad, fresh_n, fresh_stats, fresh_loss = jax.vmap(
+                    site_micro, axis_name=inner_axis
+                )(xb, yb, wb)
+                ls_prev = ov["live"] * ov["valid"]
+                if packed:
+                    (agg, es_new, hs_new, ts_new, buffers, batch_stats,
+                     loss_round, total_live) = packed_apply(
+                        health, telem_st, buffers, ls_prev, engine_state,
+                        ov["grads"], ov["weight"], ov["stats"], ov["loss"],
+                    )
+                else:
+                    (agg, es_new, hs_new, ts_new, buffers, stats_k, loss_k,
+                     tl_k) = jax.vmap(
+                        site_apply,
+                        in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0),
+                        out_axes=(0, 0, 0, 0, 0, 0, 0, 0),
+                        axis_name=inner_axis,
+                    )(engine_state, health, telem_st, buffers, ls_prev,
+                      ov["grads"], ov["weight"], ov["stats"], ov["loss"])
+                    agg = jax.tree.map(lambda a: a[0], agg)
+                    batch_stats = jax.tree.map(lambda a: a[0], stats_k)
+                    loss_round = loss_k[0]
+                    total_live = tl_k[0]
+                vgate = ov["valid"] > 0
+                engine_state = es_new
+                health = jax.tree.map(
+                    lambda new, old: jnp.where(vgate, new, old), hs_new, health
+                )
+                telem_k = (
+                    None if telem_st is None else jax.tree.map(
+                        lambda new, old: jnp.where(vgate, new, old),
+                        ts_new, telem_st,
+                    )
+                )
+                # refill the stash with this round's fresh payload — its
+                # aggregation is issued at the NEXT scan step (or the next
+                # epoch's first round: the stash rides TrainState)
+                ov = {
+                    "grads": fresh_grad,
+                    "stats": fresh_stats,
+                    "weight": fresh_n,
+                    "loss": fresh_loss,
+                    "live": lb,
+                    "valid": jnp.ones((k,), jnp.float32),
+                }
+            elif packed:
                 # mesh topologies: the two-level form — engine/BN/loss
                 # collectives run ONCE per device on the [k]-batched block
                 # (agg/stats/loss come back unbatched and replicated)
@@ -909,7 +1076,7 @@ def make_train_epoch_fn(
                 }
             return (
                 params, batch_stats, opt_state, engine_state, health,
-                telem_k, buffers, rng, rnd + 1,
+                telem_k, buffers, ov, rng, rnd + 1,
             ), loss_round
 
         carry0 = (
@@ -920,6 +1087,7 @@ def make_train_epoch_fn(
             health,
             state.telemetry,
             state.buffers,
+            state.overlap,
             jax.random.fold_in(state.rng, state.round),
             state.round,
         )
@@ -952,7 +1120,7 @@ def make_train_epoch_fn(
         else:
             xs = jnp.arange(rounds)
         (params, stats, opt_state, engine_state, health, telem_out, buf_out,
-         rng, rnd), losses = jax.lax.scan(one_round, carry0, xs)
+         ov_out, rng, rnd), losses = jax.lax.scan(one_round, carry0, xs)
         new_state = TrainState(
             params=params,
             batch_stats=stats,
@@ -963,6 +1131,7 @@ def make_train_epoch_fn(
             health=health,
             telemetry=telem_out,
             buffers=buf_out,
+            overlap=ov_out,
         )
         return new_state, losses
 
@@ -1005,6 +1174,23 @@ def make_train_epoch_fn(
         ):
             state = state.replace(
                 buffers=default_async_buffers(inputs.shape[0], state.params)
+            )
+        # the overlap stash mirrors the overlap_rounds flag the same
+        # trace-time way: off drops any carried stash (an overlapped fit's
+        # checkpoint resumed in the plain mode — the program stays legacy,
+        # the in-flight round is dropped once), on fills/resizes an EMPTY
+        # (valid=0) stash whose first round applies nothing
+        if not overlap:
+            if state.overlap is not None:
+                state = state.replace(overlap=None)
+        elif (
+            state.overlap is None
+            or state.overlap["valid"].shape[0] != inputs.shape[0]
+        ):
+            state = state.replace(
+                overlap=default_overlap_stash(
+                    inputs.shape[0], state.params, state.batch_stats
+                )
             )
         return state
 

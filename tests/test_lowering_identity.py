@@ -5,15 +5,20 @@ replaces the ad-hoc ``lowered.as_text() == ...`` comparisons that used to be
 duplicated across tests/test_telemetry.py and tests/test_robustness.py:
 
 - every OFF-form (telemetry off, faults at their default resolution, the
-  sanitizer's leak-checking observation mode) must be lowering-identical to
-  the baseline epoch program;
-- every static OPT-OUT/OPT-IN (``quarantine_rounds=-1``, ``telemetry=True``)
-  must genuinely diverge — if these become identical, "compiled out" has
+  sanitizer's leak-checking observation mode, wire_quant="none", the fused
+  power-iteration kernel off, overlap_rounds off) must be lowering-identical
+  to the baseline epoch program;
+- every static OPT-OUT/OPT-IN (``quarantine_rounds=-1``, ``telemetry=True``,
+  a quantized wire codec, the fused kernel, overlapped rounds) must
+  genuinely diverge — if these become identical, "compiled out" has
   silently stopped being true.
 
 The same pairs gate the CLI via rule S005
 (``python -m dinunet_implementations_tpu.checks --semantic``); this file is
-the fast tier-1 mirror with per-pair failure reports.
+the fast tier-1 mirror with per-pair failure reports. The engine-knob cases
+(``{"engine": {...}}``) and the rankDAD corner ride the semantic tier's
+``identity_text_fn``/table definitions, so the two gates can never test
+different pair sets.
 """
 
 import jax
@@ -22,10 +27,11 @@ import pytest
 from dinunet_implementations_tpu.checks.lowering import diff_report
 from dinunet_implementations_tpu.checks.semantic import (
     IDENTITY_CASES,
+    IDENTITY_CASES_RANKDAD,
+    RANKDAD_IDENTITY_CELL,
     TraceCell,
-    build_cell_inputs,
+    identity_text_fn,
 )
-from dinunet_implementations_tpu.trainer import make_train_epoch_fn
 
 
 @pytest.fixture(scope="module")
@@ -33,29 +39,34 @@ def corner():
     """The flagship matrix corner (dSGD / folded sites / host pipeline),
     built by the semantic tier's shared corner builder — the same programs
     the S005 CLI gate compares."""
-    task, engine, opt, _, args, mesh = build_cell_inputs(
-        TraceCell("dSGD", "vmap", "host")
-    )
-
-    def text(**kw):
-        fn = make_train_epoch_fn(task, engine, opt, mesh=mesh, **kw)
-        return fn.lower(*args).as_text()
-
+    text = identity_text_fn(TraceCell("dSGD", "vmap", "host"))
     # the default build's text once, not once per test
     return text(), text
 
 
-#: derived from the semantic tier's IDENTITY_CASES so this harness and the
-#: S005 CLI gate can never test different pair sets. kwargs=None is the
+@pytest.fixture(scope="module")
+def rankdad_corner():
+    """The rankDAD corner the fused-power-iteration pairs run on."""
+    text = identity_text_fn(RANKDAD_IDENTITY_CELL)
+    return text(), text
+
+
+def _split(cases):
+    identical = {
+        label: kw for label, (kw, ident) in cases.items()
+        if ident and kw is not None
+    }
+    divergent = {
+        label: kw for label, (kw, ident) in cases.items() if not ident
+    }
+    return identical, divergent
+
+
+#: derived from the semantic tier's tables so this harness and the S005 CLI
+#: gate can never test different pair sets. kwargs=None is the
 #: checking_leaks observation mode (its own test below).
-IDENTICAL_CASES = {
-    label: kw for label, (kw, identical) in IDENTITY_CASES.items()
-    if identical and kw is not None
-}
-DIVERGENT_CASES = {
-    label: kw for label, (kw, identical) in IDENTITY_CASES.items()
-    if not identical
-}
+IDENTICAL_CASES, DIVERGENT_CASES = _split(IDENTITY_CASES)
+IDENTICAL_RD, DIVERGENT_RD = _split(IDENTITY_CASES_RANKDAD)
 
 
 @pytest.mark.parametrize("case", sorted(IDENTICAL_CASES))
@@ -75,6 +86,26 @@ def test_opt_out_really_changes_the_program(corner, case):
     base, text = corner
     assert diff_report(
         base, text(**DIVERGENT_CASES[case]), "default-build", case
+    ) is not None
+
+
+@pytest.mark.parametrize("case", sorted(IDENTICAL_RD))
+def test_rankdad_off_form_is_lowering_identical(rankdad_corner, case):
+    """fused_poweriter=False (and the CPU auto default) must compile the
+    exact legacy XLA power-iteration loop."""
+    base, text = rankdad_corner
+    report = diff_report(
+        base, text(**IDENTICAL_RD[case]), "default-build", case
+    )
+    assert report is None, report
+
+
+@pytest.mark.parametrize("case", sorted(DIVERGENT_RD))
+def test_rankdad_opt_in_really_changes_the_program(rankdad_corner, case):
+    """fused_poweriter=True must genuinely inject the Pallas kernel."""
+    base, text = rankdad_corner
+    assert diff_report(
+        base, text(**DIVERGENT_RD[case]), "default-build", case
     ) is not None
 
 

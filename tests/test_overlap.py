@@ -1,0 +1,213 @@
+"""Overlapped rounds (r14 — trainer/steps.py overlap_rounds).
+
+The pipelined round applies round t's stashed payload while round t+1's
+gradients compute. The contract under test:
+
+- the very first round of a fit applies NOTHING (empty stash: params/opt
+  hold, NaN loss, health/telemetry untouched);
+- round t+1 then applies round t's payload EXACTLY as the legacy round
+  would have (first applied update bit-equal to the legacy one-round fit);
+- the stash rides TrainState across epoch boundaries (no round dropped)
+  and through checkpoint/resume bit-exactly;
+- liveness masks apply to the round the DATA came from;
+- one compiled program (CompileGuard);
+- overlap + buffered-async is rejected (two staleness semantics).
+
+The off-form's program identity (overlap_rounds=False == legacy, bitwise)
+is gated in tests/test_lowering_identity.py / S005.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dinunet_implementations_tpu.checks.semantic import (
+    TraceCell,
+    build_cell_inputs,
+)
+from dinunet_implementations_tpu.trainer.checkpoint import (
+    load_checkpoint,
+    save_checkpoint,
+)
+from dinunet_implementations_tpu.trainer.steps import (
+    default_overlap_stash,
+    init_train_state,
+    make_train_epoch_fn,
+)
+
+
+@pytest.fixture(scope="module")
+def corner():
+    return build_cell_inputs(TraceCell("dSGD", "vmap", "host"))
+
+
+def _leaves_equal(a, b):
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+def test_first_round_applies_nothing_and_first_apply_is_legacy_bit_exact(
+    corner,
+):
+    task, engine, opt, state, args, mesh = corner
+    x, y, w = args[1:]
+    legacy = make_train_epoch_fn(task, engine, opt, mesh=mesh)
+    overlap = make_train_epoch_fn(task, engine, opt, mesh=mesh,
+                                  overlap_rounds=True)
+    s_ov, losses = overlap(state, x, y, w)
+    losses = np.asarray(losses)
+    # round 0: empty stash — NaN loss, params/opt untouched
+    assert np.isnan(losses[0])
+    # rounds 1..: each applies the previous round's payload; with a 2-round
+    # epoch the final params equal the LEGACY params after exactly round 0
+    # (bit-for-bit: same grads at the same initial params, same optimizer
+    # step from the same initial moments)
+    s_legacy1, l_legacy = legacy(state, x[:, :1], y[:, :1], w[:, :1])
+    assert _leaves_equal(s_ov.params, s_legacy1.params)
+    assert _leaves_equal(s_ov.opt_state, s_legacy1.opt_state)
+    np.testing.assert_array_equal(losses[1], np.asarray(l_legacy)[0])
+    # the stash now holds round 1's payload, valid everywhere
+    np.testing.assert_array_equal(np.asarray(s_ov.overlap["valid"]), 1.0)
+
+
+def test_stash_survives_epoch_boundary(corner):
+    """Nothing is dropped at an epoch boundary: epoch 2's first round
+    applies epoch 1's last stash (finite loss at step 0 of epoch 2)."""
+    task, engine, opt, state, args, mesh = corner
+    x, y, w = args[1:]
+    fn = make_train_epoch_fn(task, engine, opt, mesh=mesh,
+                             overlap_rounds=True)
+    s1, l1 = fn(state, x, y, w)
+    s2, l2 = fn(s1, x, y, w)
+    assert np.isnan(np.asarray(l1)[0])
+    assert np.isfinite(np.asarray(l2)).all()  # the carried stash applied
+
+
+def test_overlap_checkpoint_roundtrip_bit_exact(corner, tmp_path):
+    task, engine, opt, state, args, mesh = corner
+    x, y, w = args[1:]
+    fn = make_train_epoch_fn(task, engine, opt, mesh=mesh,
+                             overlap_rounds=True)
+    s1, _ = fn(state, x, y, w)
+    path = str(tmp_path / "ov.msgpack")
+    save_checkpoint(path, s1)
+    like = init_train_state(
+        task, engine, opt, jax.random.PRNGKey(0), x[0, 0],
+        num_sites=x.shape[0], overlap_rounds=True,
+    )
+    restored = load_checkpoint(path, like)
+    assert _leaves_equal(s1.overlap, restored.overlap)
+    sa, la = fn(s1, x, y, w)
+    sb, lb = fn(restored, x, y, w)
+    assert _leaves_equal(sa, sb)
+    np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_overlap_resumed_without_flag_drops_stash(corner, tmp_path):
+    """An overlapped fit's checkpoint resumed with overlap OFF: the stash
+    is dropped once (documented) and the legacy program runs."""
+    task, engine, opt, state, args, mesh = corner
+    x, y, w = args[1:]
+    ov = make_train_epoch_fn(task, engine, opt, mesh=mesh,
+                             overlap_rounds=True)
+    legacy = make_train_epoch_fn(task, engine, opt, mesh=mesh)
+    s1, _ = ov(state, x, y, w)
+    s2, l2 = legacy(s1, x, y, w)
+    assert s2.overlap is None
+    assert np.isfinite(np.asarray(l2)).all()
+
+
+def test_overlap_liveness_applies_to_the_data_round(corner):
+    """A site dead in round 0 contributes nothing when round 0's stash
+    applies (at step 1) — masking follows the data, not the apply step."""
+    task, engine, opt, state, args, mesh = corner
+    x, y, w = args[1:]
+    S, rounds = x.shape[0], x.shape[1]
+    fn = make_train_epoch_fn(task, engine, opt, mesh=mesh,
+                             overlap_rounds=True)
+    all_live = jnp.ones((S, rounds), jnp.float32)
+    dead0 = all_live.at[:, 0].set(0.0)  # every site dead in ROUND 0
+    s_live, l_live = fn(state, x, y, w, all_live)
+    s_dead, l_dead = fn(state, x, y, w, dead0)
+    # round 0's payload applies at step 1: all-dead round 0 → step-1 apply
+    # holds params (and reports NaN), exactly like a legacy all-dead round
+    assert np.isnan(np.asarray(l_dead)[1])
+    assert np.isfinite(np.asarray(l_live)[1])
+    assert _leaves_equal(s_dead.params, state.params)  # 2-round epoch:
+    # round 1's payload is still in flight, round 0's was masked — nothing
+    # has applied yet
+    assert not _leaves_equal(s_live.params, state.params)
+
+
+def test_overlap_health_not_counted_on_empty_stash(corner):
+    """The valid gate: the empty-stash first round must not count a skip
+    against every site."""
+    task, engine, opt, state, args, mesh = corner
+    x, y, w = args[1:]
+    fn = make_train_epoch_fn(task, engine, opt, mesh=mesh,
+                             overlap_rounds=True)
+    s1, _ = fn(state, x, y, w)
+    # 2 rounds ran; only round 1 (the first valid apply) touched health,
+    # and with healthy data it recorded no skips
+    np.testing.assert_array_equal(np.asarray(s1.health["skips"]), 0)
+    np.testing.assert_array_equal(np.asarray(s1.health["quarantined"]), 0)
+
+
+def test_overlap_packed_mesh_matches_vmap_trajectory():
+    """The packed two-level form and the vmap fold run the same overlapped
+    math (same data, same seeds → same loss trajectory)."""
+    cell_v = TraceCell("dSGD", "vmap", "host")
+    cell_m = TraceCell("dSGD", "mesh", "host")
+    task_v, eng_v, opt_v, st_v, args_v, _ = build_cell_inputs(cell_v)
+    task_m, eng_m, opt_m, st_m, args_m, mesh = build_cell_inputs(cell_m)
+    fn_v = make_train_epoch_fn(task_v, eng_v, opt_v, mesh=None,
+                               overlap_rounds=True)
+    fn_m = make_train_epoch_fn(task_m, eng_m, opt_m, mesh=mesh,
+                               overlap_rounds=True)
+    _, l_v = fn_v(st_v, *args_v[1:])
+    _, l_m = fn_m(st_m, *args_m[1:])
+    np.testing.assert_allclose(
+        np.asarray(l_v), np.asarray(l_m), rtol=1e-5
+    )
+
+
+def test_overlap_epoch_compiles_once(corner):
+    """Chained overlapped epochs are ONE compiled program — provided the
+    initial state carries the stash (init_train_state(overlap_rounds=True),
+    what the trainer does; a stash-less state costs one structural warmup
+    compile by design, same as resuming a telemetry run)."""
+    from dinunet_implementations_tpu.checks.sanitize import jit_cache_size
+
+    task, engine, opt, state, args, mesh = corner
+    x, y, w = args[1:]
+    fn = make_train_epoch_fn(task, engine, opt, mesh=mesh,
+                             overlap_rounds=True)
+    s = init_train_state(
+        task, engine, opt, jax.random.PRNGKey(0), x[0, 0],
+        num_sites=x.shape[0], overlap_rounds=True,
+    )
+    for _ in range(3):
+        s, _ = fn(s, x, y, w)
+    jax.tree.map(np.asarray, s)
+    assert jit_cache_size(fn) == 1
+
+
+def test_overlap_rejects_buffered_async(corner):
+    task, engine, opt, *_ = corner
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        make_train_epoch_fn(task, engine, opt, overlap_rounds=True,
+                            staleness_bound=2)
+
+
+def test_default_overlap_stash_structure():
+    params = {"w": jnp.ones((3, 2))}
+    stats = {"bn": {"mean": jnp.zeros((2,))}}
+    ov = default_overlap_stash(4, params, stats)
+    assert ov["grads"]["w"].shape == (4, 3, 2)
+    assert ov["stats"]["bn"]["mean"].shape == (4, 2)
+    for k in ("weight", "loss", "live", "valid"):
+        assert ov[k].shape == (4,)
+    np.testing.assert_array_equal(np.asarray(ov["valid"]), 0.0)

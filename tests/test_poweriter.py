@@ -1,0 +1,254 @@
+"""Fused Pallas power-iteration kernel (ops/poweriter_pallas.py, r14).
+
+Four layers:
+- kernel-vs-legacy PARITY: the interpret-mode kernel must reproduce
+  ``lowrank.subspace_iteration_grouped`` member-for-member across rank
+  classes, shape buckets, warm starts, zero members and the empty group
+  (on CPU both sides run the same LAPACK CholeskyQR, so parity is
+  bit-exact; the bf16 arm gets a tolerance for batching-order float noise);
+- engine level: fused rankDAD's aggregate matches legacy rankDAD's on the
+  same inputs (vmap-folded and packed topologies);
+- fit level: a fused full fit tracks the legacy trajectory (tight
+  tolerance) and clears the same hard-SNR golden floor;
+- CompileGuard: the fused epoch compiles ONCE.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dinunet_implementations_tpu.core.config import TrainConfig
+from dinunet_implementations_tpu.engines import make_engine
+from dinunet_implementations_tpu.engines.lowrank import (
+    subspace_iteration_grouped,
+)
+from dinunet_implementations_tpu.ops import poweriter_pallas as pp
+from dinunet_implementations_tpu.runner import FedRunner
+
+
+def _mk(rng, m, n, scale=1.0):
+    return jnp.asarray((rng.normal(size=(m, n)) * scale).astype(np.float32))
+
+
+def _flat(results):
+    return [
+        a for group in results for (P, Q) in group for a in (P, Q)
+    ]
+
+
+def _assert_close(legacy, fused, tol=0.0):
+    for a, b in zip(_flat(legacy), _flat(fused)):
+        assert a.shape == b.shape
+        err = float(jnp.abs(a - b).max())
+        assert err <= tol, f"{a.shape}: max diff {err} > {tol}"
+
+
+# ---------------------------------------------------------------------------
+# kernel parity
+# ---------------------------------------------------------------------------
+
+
+def test_fused_matches_legacy_across_rank_classes_bit_exact():
+    """All rank classes at once — mixed shapes (several buckets), a
+    duplicate-shape pair (one stacked bucket), rank clamped by small dims,
+    and an exactly-zero member (the CholeskyQR canonical-basis fallback).
+    On CPU the kernel's interpret mode traces to the same LAPACK math as
+    the legacy loop, so parity is bit-exact."""
+    rng = np.random.default_rng(0)
+    groups = [
+        ([_mk(rng, 12, 7), _mk(rng, 9, 7), _mk(rng, 12, 7),
+          _mk(rng, 20, 5)], 4, None),
+        ([_mk(rng, 6, 3)], 2, None),
+        ([jnp.zeros((8, 5), jnp.float32)], 3, None),
+    ]
+    legacy = subspace_iteration_grouped(groups, 5, 1e-3)
+    fused = subspace_iteration_grouped(groups, 5, 1e-3, fused=True)
+    _assert_close(legacy, fused, tol=0.0)
+
+
+def test_fused_matches_legacy_with_warm_starts():
+    rng = np.random.default_rng(1)
+    Gs = [_mk(rng, 10, 6), _mk(rng, 14, 6)]
+    oms = [_mk(rng, 6, 4), _mk(rng, 6, 4)]
+    legacy = subspace_iteration_grouped([(Gs, 4, oms)], 5, 1e-3)
+    fused = subspace_iteration_grouped([(Gs, 4, oms)], 5, 1e-3, fused=True)
+    _assert_close(legacy, fused, tol=0.0)
+
+
+def test_fused_bf16_matmuls_match_legacy():
+    """The lp_matmul mixed-precision policy inside the kernel: bf16 inputs,
+    f32 accumulation — small float noise vs the legacy bf16 loop from
+    batching order is allowed, nothing more."""
+    rng = np.random.default_rng(2)
+    Gs = [_mk(rng, 16, 8), _mk(rng, 16, 8)]
+    legacy = subspace_iteration_grouped(
+        [(Gs, 4, None)], 5, 1e-3, matmul_dtype=jnp.bfloat16
+    )
+    fused = subspace_iteration_grouped(
+        [(Gs, 4, None)], 5, 1e-3, matmul_dtype=jnp.bfloat16, fused=True
+    )
+    _assert_close(legacy, fused, tol=1e-5)
+
+
+def test_fused_empty_group_and_empty_list():
+    assert subspace_iteration_grouped([], 5, 1e-3, fused=True) == []
+    assert pp.fused_subspace_iteration_grouped([], 5, 1e-3) == []
+
+
+def test_fused_reconstruction_quality_matches_legacy():
+    """The factorization is a rank-r approximation — fused and legacy must
+    agree on its quality, not just its bits."""
+    rng = np.random.default_rng(3)
+    G = _mk(rng, 24, 12)
+    P, Q = subspace_iteration_grouped([([G], 6, None)], 8, 0.0,
+                                      fused=True)[0][0]
+    rec = float(jnp.linalg.norm(G - P @ Q.T) / jnp.linalg.norm(G))
+    Pl, Ql = subspace_iteration_grouped([([G], 6, None)], 8, 0.0)[0][0]
+    rec_l = float(jnp.linalg.norm(G - Pl @ Ql.T) / jnp.linalg.norm(G))
+    assert abs(rec - rec_l) < 1e-6
+    assert rec < 0.75  # rank-6 of a random 24x12 captures over a quarter
+
+
+def test_vmem_budget_gate_falls_back_to_legacy():
+    """A class bigger than the VMEM budget must not be fused — the split is
+    trace-time static and the legacy loop carries it."""
+    small = [jnp.ones((8, 4), jnp.float32)]
+    assert pp.class_fits_vmem(small, 2)
+    huge = [jax.ShapeDtypeStruct((4096, 4096), jnp.float32)] * 4
+    assert not pp.class_fits_vmem(huge, 10)
+    # mixed: the small class fuses, results still line up in order
+    rng = np.random.default_rng(4)
+    groups = [
+        ([_mk(rng, 8, 4)], 2, None),
+        ([_mk(rng, 10, 5)], 3, None),
+    ]
+    legacy = subspace_iteration_grouped(groups, 4, 1e-3)
+    fused = subspace_iteration_grouped(groups, 4, 1e-3, fused=True)
+    _assert_close(legacy, fused, tol=0.0)
+
+
+def test_fused_under_vmap_folds_into_member_axis():
+    """The custom_vmap rule: a mapped axis folds into the kernel's member
+    axis instead of a sequential grid dim — results identical to mapping
+    the legacy loop."""
+    rng = np.random.default_rng(5)
+    Gb = jnp.asarray(rng.normal(size=(6, 12, 7)).astype(np.float32))
+    omb = jnp.asarray(rng.normal(size=(6, 7, 4)).astype(np.float32))
+
+    def leg(G, om):
+        return subspace_iteration_grouped([([G], 4, [om])], 5, 1e-3)[0][0]
+
+    def fus(G, om):
+        return subspace_iteration_grouped(
+            [([G], 4, [om])], 5, 1e-3, fused=True
+        )[0][0]
+
+    Pl, Ql = jax.vmap(leg)(Gb, omb)
+    Pf, Qf = jax.vmap(fus)(Gb, omb)
+    assert float(jnp.abs(Pl - Pf).max()) <= 1e-6
+    assert float(jnp.abs(Ql - Qf).max()) <= 1e-6
+
+
+# ---------------------------------------------------------------------------
+# engine level
+# ---------------------------------------------------------------------------
+
+
+def _site_grads(rng, S):
+    return {
+        "enc": jnp.asarray(rng.normal(size=(S, 12, 8)).astype(np.float32)),
+        "head": jnp.asarray(rng.normal(size=(S, 8, 2)).astype(np.float32)),
+        "bias": jnp.asarray(rng.normal(size=(S, 8)).astype(np.float32)),
+    }
+
+
+def test_fused_rankdad_aggregate_matches_legacy():
+    """Engine level, vmap-folded sites: the fused engine's aggregate (and
+    its warm-start Ω state) must match legacy's."""
+    rng = np.random.default_rng(6)
+    S = 4
+    grads = _site_grads(rng, S)
+    row = jax.tree.map(lambda g: g[0], grads)
+    results = {}
+    for fused in (False, True):
+        eng = make_engine("rankDAD", dad_reduction_rank=3,
+                          fused_poweriter=fused)
+        st = jax.tree.map(
+            lambda a: jnp.stack([a] * S), eng.init(row)
+        )
+        agg, new_st = jax.vmap(
+            lambda g, s, w: eng.aggregate(g, s, w, "site"),
+            axis_name="site",
+        )(grads, st, jnp.ones((S,)))
+        results[fused] = (agg, new_st)
+    for a, b in zip(jax.tree.leaves(results[False]),
+                    jax.tree.leaves(results[True])):
+        assert float(jnp.abs(a - b).max()) <= 1e-6
+
+
+# ---------------------------------------------------------------------------
+# fit level + CompileGuard
+# ---------------------------------------------------------------------------
+
+
+def _hard_tree(tmp_path):
+    from tests.test_golden import _make_hard_ica_tree
+
+    _make_hard_ica_tree(tmp_path)
+
+
+def _ica_cfg(**kw):
+    return TrainConfig(
+        task_id="ICA-Classification", agg_engine="rankDAD", epochs=8,
+        patience=8, batch_size=8, split_ratio=(0.7, 0.15, 0.15), seed=0,
+        **kw,
+    )
+
+
+def test_fused_full_fit_tracks_legacy_trajectory(tmp_path):
+    """A short fused fit must track the legacy fit's loss trajectory to
+    float-noise tolerance (the kernel changes WHERE the factorization
+    computes, not what it computes)."""
+    _hard_tree(tmp_path)
+    losses = {}
+    for fused in (False, True):
+        res = FedRunner(
+            _ica_cfg(fused_poweriter=fused),
+            data_path=str(tmp_path),
+            out_dir=str(tmp_path / f"out_{fused}"),
+        ).run(verbose=False)[0]
+        losses[fused] = res["epoch_losses"]
+    a = np.asarray(losses[False], np.float64)
+    b = np.asarray(losses[True], np.float64)
+    assert a.shape == b.shape
+    assert float(np.nanmax(np.abs(a - b))) < 5e-4, (a, b)
+
+
+def test_fused_epoch_compiles_once():
+    """CompileGuard: the fused epoch is still ONE compiled program across
+    chained epochs."""
+    from dinunet_implementations_tpu.checks.sanitize import jit_cache_size
+    from dinunet_implementations_tpu.checks.semantic import (
+        TraceCell,
+        build_cell_inputs,
+    )
+    from dinunet_implementations_tpu.trainer.steps import make_train_epoch_fn
+
+    task, _, opt, _, args, mesh = build_cell_inputs(
+        TraceCell("rankDAD", "vmap", "host")
+    )
+    eng = make_engine("rankDAD", dad_reduction_rank=2, dad_num_pow_iters=2,
+                      fused_poweriter=True)
+    from dinunet_implementations_tpu.trainer.steps import init_train_state
+
+    state = init_train_state(
+        task, eng, opt, jax.random.PRNGKey(0), args[1][0, 0],
+        num_sites=args[1].shape[0],
+    )
+    fn = make_train_epoch_fn(task, eng, opt, mesh=mesh)
+    s = state
+    for _ in range(3):
+        s, _ = fn(s, *args[1:])
+    jax.tree.map(np.asarray, s)
+    assert jit_cache_size(fn) == 1

@@ -371,6 +371,80 @@ def test_s004_walk_sees_through_wire_compress_round_trip():
     ) == 2
 
 
+def test_s004_int8_declared_but_f32_shipped_flagged():
+    """The r14 negative fixture: an engine whose wire model DECLARES an int8
+    wire but whose aggregate ships raw (unquantized) f32 payloads — S004
+    must flag the upcast on every payload and S002's byte totals must
+    disagree (the 4x shrink is claimed, not happening)."""
+    e8 = make_engine("dSGD", wire_quant="int8")
+
+    def agg(grads, state, weight, axis_name, live=None):
+        grads, weight = mask_dead_site(grads, weight, live)
+        return site_weighted_mean(grads, weight, axis_name), state
+
+    cheat = dataclasses.replace(e8, aggregate=agg)
+    prog = _trace("dSGD", engine=cheat)
+    fs = sem.check_precision_flow(
+        prog.audit.collectives, cheat, prog.state.params, prog.block,
+        prog.path,
+    )
+    assert fs and set(_rules(fs)) == {"S004"}
+    assert all(f.snippet.startswith("upcast") for f in fs)
+    assert any("int8" in f.message for f in fs)
+    fs2 = sem.check_wire_bytes(
+        prog.audit.collectives, cheat, prog.state.params, prog.block,
+        prog.path,
+    )
+    assert any(f.snippet == "bytes-mismatch" for f in fs2)
+
+
+def test_s004_walk_resolves_int8_quant_chain():
+    """The quant→collective→dequant chain (round/clamp → int8 cast →
+    dequant mul) reads as a 1-byte wire — the r14 codec's round-trip is
+    proven, not re-greened via a dropped cast."""
+    from dinunet_implementations_tpu.parallel.collectives import (
+        resolve_wire_codec,
+    )
+
+    codec = resolve_wire_codec("32", "int8")
+    sr = resolve_wire_codec("32", "int8", stochastic=True)
+
+    def int8_wire(g):
+        return jax.lax.psum(codec.compress(g), "sites")
+
+    def int8_sr_wire(g):
+        return jax.lax.psum(sr.compress(g), "sites")
+
+    x = jnp.linspace(-1.0, 1.0, 8)
+    assert _psum_wire_itemsize(_one_site_shard(int8_wire), x) == 1
+    assert _psum_wire_itemsize(_one_site_shard(int8_sr_wire), x) == 1
+
+
+def test_s004_walk_packed_row_scale_does_not_widen():
+    """The packed per-row [K, 1, 1] quant scale reaches the dequant mul at
+    its own rank-kept shape (no broadcast_in_dim in the jaxpr) — it must
+    still read as a scale, not as f32 payload data (the r14
+    rankDAD@int8/fold4 cell's regression: the gathered factor block ships
+    every virtual site's row, each with its own scale)."""
+    from dinunet_implementations_tpu.parallel.collectives import (
+        resolve_wire_codec,
+    )
+
+    codec = resolve_wire_codec("32", "int8")
+
+    def packed_gather(g):  # g [K, m, n], per-row scales, gathered whole
+        return jax.lax.all_gather(
+            codec.compress(g, batched=True), "sites", axis=0
+        )
+
+    x = jnp.arange(24.0).reshape(4, 3, 2) + 1.0
+    audit = sem.audit_jaxpr(
+        jax.make_jaxpr(_one_site_shard(packed_gather))(x)
+    )
+    site = next(s for s in audit.collectives if s.prim == "all_gather")
+    assert site.wire_itemsizes[0] == 1
+
+
 def test_s002_match_prefers_exact_dtype_for_same_shape_payloads():
     """Two same-shape payloads at different dtypes (a bf16 factor next to an
     f32 dense leaf) must pair with their own model entries — first-fit by
