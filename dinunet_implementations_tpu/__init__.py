@@ -26,7 +26,7 @@ from .core.config import (
 )
 from .parallel.mesh import MODEL_AXIS, SITE_AXIS, host_mesh, make_site_mesh
 
-__version__ = "0.9.0"
+__version__ = "0.10.0"
 
 
 def __getattr__(name):
@@ -52,4 +52,8 @@ def __getattr__(name):
         from . import telemetry
 
         return getattr(telemetry, name)
+    if name == "InferenceEngine":
+        from .serving import InferenceEngine
+
+        return InferenceEngine
     raise AttributeError(name)

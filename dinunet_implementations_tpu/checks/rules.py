@@ -24,6 +24,7 @@ PRINT_ALLOWED_FILES = {
     "analysis.py",  # notebook-parity report CLI (prints summary_markdown)
     "checks/__main__.py",  # this analyzer's own CLI
     "telemetry/report.py",  # telemetry run-summary CLI (tables on stdout)
+    "serving/__main__.py",  # serving CLI: summary/latency JSON on stdout
 }
 
 #: R002 — packages where a swallowed ``except Exception`` can eat the

@@ -1060,6 +1060,126 @@ def _identity_gate() -> list:
     return check_lowering_identity(pairs)
 
 
+# ---------------------------------------------------------------------------
+# serving cells (r15)
+# ---------------------------------------------------------------------------
+
+
+def check_no_collectives(collectives: list, path: str) -> list:
+    """S001, serving form: the REQUEST PATH must contain ZERO cross-device
+    collectives — inference is replicated per device, and a stray psum in a
+    serving program would stall every request on every other device's
+    traffic (the training rule merely confines collectives to the rounds
+    scan; serving forbids them outright)."""
+    findings = []
+    for site in collectives:
+        if site.prim not in COMM_PRIMS:
+            continue
+        findings.append(Finding(
+            rule="S001", path=path, line=0, col=0,
+            message=(
+                f"serving request path contains a cross-device collective "
+                f"'{site.prim}' (axes {site.named_axes or '(positional)'}) "
+                f"— inference must be replicated, never synchronized"
+            ),
+            snippet=f"{site.prim} in-request-path",
+            fixit="keep collectives out of eval_forward/ICALstmStream; "
+                  "multi-device serving replicates the engine per device",
+        ))
+    return findings
+
+
+def build_serving_cell():
+    """The real serving programs on a tiny CPU corner: the engine's batched
+    (``eval_forward``) and streaming (session gather→step→scatter) jitted
+    entries, exactly as :class:`~..serving.engine.InferenceEngine` compiles
+    them at warmup. Returns the engine plus per-lane ``(fn, args)``."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.config import NNComputation, TrainConfig
+    from ..runner.registry import get_task
+    from ..serving.engine import InferenceEngine
+    from ..trainer.steps import FederatedTask
+
+    cfg = TrainConfig(task_id=NNComputation.TASK_ICA).with_overrides({
+        "ica_args": {
+            "num_components": 3, "window_size": 4, "temporal_size": 32,
+            "window_stride": 4, "input_size": 8, "hidden_size": 6,
+            "bidirectional": False,
+        },
+    })
+    task = FederatedTask(get_task(cfg.task_id).build_model(cfg))
+    params, stats = task.init_variables(
+        jax.random.PRNGKey(0), jnp.ones((2, 8, 3, 4))
+    )
+    engine = InferenceEngine(
+        cfg, params=params, batch_stats=stats, row_buckets=(4,),
+        stream_buckets=(2,), stream_chunk=4, stream_slots=4,
+    )
+    infer_args = (
+        engine._params, engine._stats,
+        jnp.zeros((4, 8, 3, 4), jnp.float32), jnp.ones((4,), jnp.float32),
+    )
+    stream_args = (
+        engine._params, engine._stats, engine._table,
+        jnp.zeros((2,), jnp.int32), jnp.zeros((2,), jnp.float32),
+        jnp.zeros((2, 4, 3, 4), jnp.float32), jnp.ones((2, 4), jnp.float32),
+        jnp.ones((2,), jnp.float32),
+    )
+    return engine, (engine._infer_jit, infer_args), (
+        engine._stream_jit, stream_args
+    )
+
+
+def run_serving_checks() -> list:
+    """The serving S-rule cells (r15): S001 zero collectives on both lanes,
+    S003 the donated session-carry table fully aliases in the compiled
+    streaming step, S005 the batched serving program is lowering-identical
+    to the trainer's eval forward (the bit-exactness bridge as a program
+    property, not just a test vector) — and the streaming program genuinely
+    diverges from it (the differ is not trivially green)."""
+    import jax
+
+    from ..trainer.steps import epoch_program_artifacts, eval_forward
+
+    findings: list = []
+    engine, (infer_jit, infer_args), (stream_jit, stream_args) = (
+        build_serving_cell()
+    )
+    infer_jaxpr, infer_low, _ = epoch_program_artifacts(
+        infer_jit, *infer_args, lowered=True
+    )
+    findings += check_no_collectives(
+        audit_jaxpr(infer_jaxpr).collectives, "trace://serving/infer"
+    )
+    stream_jaxpr, stream_low, stream_comp = epoch_program_artifacts(
+        stream_jit, *stream_args, lowered=True, compiled=True
+    )
+    findings += check_no_collectives(
+        audit_jaxpr(stream_jaxpr).collectives, "trace://serving/stream"
+    )
+    # S003: the session-carry table (stream arg 2, donated) must alias into
+    # the returned table — the in-place O(1) session cache claim
+    findings += check_donation(
+        stream_comp, stream_args, (2,), "trace://serving/stream"
+    )
+    # S005: the batched lane IS the eval forward — prove it at the lowering
+    # level against an independently-built reference program
+    task = engine.task
+    ref = jax.jit(
+        lambda p, s, x, w: eval_forward(task, p, s, x, None, w)
+    ).lower(*infer_args).as_text()
+    findings += check_lowering_identity(
+        [
+            ("serve-infer-is-eval-forward", ref, infer_low.as_text(), True),
+            ("serve-stream-diverges", ref, stream_low.as_text(), False),
+        ],
+        path_prefix="lowering://serving/",
+    )
+    return findings
+
+
 def run_semantic_checks(cells=None) -> list:
     """Trace the matrix and run every S-rule; returns findings sorted like
     the AST tier's. The CLI gates on this list (after the semantic
@@ -1098,5 +1218,7 @@ def run_semantic_checks(cells=None) -> list:
                 prog.compiled, prog.args, (0,), prog.path
             )
     findings += _identity_gate()
+    if cells is None:
+        findings += run_serving_checks()
     findings.sort(key=lambda f: (f.path, f.rule, f.snippet))
     return findings

@@ -1,5 +1,5 @@
 from .cnn3d import SMRI3DNet
-from .icalstm import BiLSTM, ICALstm, LSTMCell
+from .icalstm import BiLSTM, ICALstm, ICALstmStream, LSTMCell
 from .layers import BatchNorm, masked_moments
 from .msannet import MSANNet
 from .transformer import MultimodalNet

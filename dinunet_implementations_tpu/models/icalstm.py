@@ -268,6 +268,133 @@ class BiLSTM(nn.Module):
         )
 
 
+class _StreamLSTM(nn.Module):
+    """Streaming (single-direction) LSTM step over a CHUNK of new windows,
+    with the mean-pool accumulator folded into the recurrence carry — the
+    O(1) autoregressive state of the serving path (serving/session.py).
+
+    Declares the exact ``fwd`` cell param tree of the dense path
+    (:class:`_LSTMCellParams`), so a trained unidirectional :class:`ICALstm`
+    checkpoint drives this module unchanged. The carry is ``(h, c, pooled,
+    count)``: hidden/cell state plus the running hidden-state SUM and valid
+    timestep count — everything the classifier head needs, at a size
+    independent of how many windows the session has already consumed.
+
+    Bit-exact chunk composition: the pooled sum accumulates INSIDE the
+    ``lax.scan`` (a strict left fold in time order), so feeding windows
+    ``[0..t1)`` then ``[t1..T)`` performs literally the same sequence of
+    additions as feeding ``[0..T)`` in one chunk — streaming in chunks is
+    bitwise identical to full-sequence replay through this module
+    (tests/test_serving.py). ``step_valid`` gates padded chunk slots: an
+    invalid step is an exact identity on all four carry parts, so
+    time-padding a short chunk up to its shape bucket cannot perturb the
+    session."""
+
+    hidden: int
+    compute_dtype: str | None = None
+
+    @nn.compact
+    def __call__(self, enc, h, c, pooled, count, step_valid):
+        D = enc.shape[-1]
+        w_ih, b, w_hh = _LSTMCellParams(D, self.hidden, name="fwd")()
+        cdt = compute_dtype_of(self.compute_dtype)
+        if cdt is not None:
+            # mirror LSTMCell's mixed-precision scan path op-for-op: bf16
+            # MXU matmuls with f32 accumulation, bf16 xi stream
+            xi = (jnp.dot(
+                enc.astype(cdt), w_ih.astype(cdt),
+                preferred_element_type=jnp.float32,
+            ) + b).astype(cdt)
+        else:
+            xi = enc @ w_ih + b  # [B, t, 4H] — one hoisted matmul
+
+        H = self.hidden
+
+        def step(carry, inp):
+            h, c, pooled, count = carry
+            xt, sv = inp  # [B, 4H] pre-projected window, [B] valid gate
+            if cdt is not None:
+                preact = xt + jnp.dot(
+                    h.astype(cdt), w_hh.astype(cdt),
+                    preferred_element_type=jnp.float32,
+                )
+            else:
+                preact = xt + h @ w_hh
+            i, f, o, g = _lstm_gates(preact, H, False)
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            live = sv[:, None] > 0
+            # invalid steps are exact identities: h/c/pooled hold, count
+            # adds sv == 0 — a padded slot can never move the session
+            return (
+                jnp.where(live, h_new, h),
+                jnp.where(live, c_new, c),
+                jnp.where(live, pooled + h_new, pooled),
+                count + sv,
+            ), None
+
+        (h, c, pooled, count), _ = jax.lax.scan(
+            step,
+            (h, c, pooled, count),
+            (jnp.swapaxes(xi, 0, 1), jnp.swapaxes(step_valid, 0, 1)),
+        )
+        return h, c, pooled, count
+
+
+class ICALstmStream(nn.Module):
+    """Streaming twin of :class:`ICALstm` — the serving path's O(1) per-chunk
+    step (serving/engine.py).
+
+    Same parameter tree as the dense model (submodule names ``encoder`` /
+    ``lstm/fwd`` / ``cls_fc1`` / ``cls_bn`` / ``cls_fc2`` / ``cls_fc3``), so
+    one trained checkpoint serves both the batched full-sequence path and
+    this incremental one. Processes only the chunk's NEW windows (encoder +
+    recurrence from the carried ``(h, c)``), updates the scan-accumulated
+    mean-pool state, and re-runs the tiny classifier head on the updated
+    pool — cost per chunk is independent of the session's history length.
+
+    Unidirectional only (``ICALstm(bidirectional=False)`` checkpoints): the
+    reverse direction of a biLSTM reads the future, so no O(1) carry can
+    reproduce it — the serving engine refuses streaming for bidirectional
+    checkpoints rather than approximate them (docs/ARCHITECTURE.md
+    "Serving"). Dropout is eval-mode (identity) by construction; the head
+    BatchNorm runs on the checkpoint's running stats, so co-batched sessions
+    never perturb each other."""
+
+    input_size: int = 256
+    hidden_size: int = 256
+    num_cls: int = 2
+    num_comps: int = 53
+    window_size: int = 20
+    compute_dtype: str | None = None
+
+    @nn.compact
+    def __call__(self, x, h, c, pooled, count, step_valid):
+        # x: [B, t, C, W] new windows; h/c/pooled: [B, H]; count: [B];
+        # step_valid: [B, t] (1.0 = real window, 0.0 = chunk padding)
+        B, t = x.shape[0], x.shape[1]
+        flat = x.reshape(B, t, -1)
+        cdt = compute_dtype_of(self.compute_dtype)
+        enc = nn.relu(
+            dense(self.input_size, fan_in=self.num_comps * self.window_size,
+                  name="encoder", dtype=cdt)(flat)
+        )
+        h, c, pooled, count = _StreamLSTM(
+            self.hidden_size, self.compute_dtype, name="lstm"
+        )(enc, h, c, pooled, count, step_valid)
+        # classifier head on the running mean — identical layer stack (and
+        # eval semantics) to ICALstm's; Dropout is a train-only no-op there
+        o = (pooled / jnp.maximum(count, 1.0)[:, None]).astype(jnp.float32)
+        o = dense(256, fan_in=o.shape[-1], name="cls_fc1")(o)
+        o = BatchNorm(256, track_running_stats=True, name="cls_bn")(
+            o, train=False
+        )
+        o = nn.relu(o)
+        o = nn.relu(dense(64, fan_in=256, name="cls_fc2")(o))
+        logits = dense(self.num_cls, fan_in=64, name="cls_fc3")(o)
+        return logits, (h, c, pooled, count)
+
+
 class ICALstm(nn.Module):
     input_size: int = 256
     hidden_size: int = 256

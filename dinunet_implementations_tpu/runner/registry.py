@@ -31,6 +31,40 @@ class TaskSpec:
     build_model: Callable[[TrainConfig], object]
     dataset_cls: type[SiteDataset]
     handle_cls: type[DataHandle]
+    # per-task inference forward spec (serving/engine.py): how the serving
+    # path shapes a request for this task. None = the task has no serving
+    # surface yet (it cannot be loaded into an InferenceEngine).
+    serving: "ServingSpec | None" = None
+
+
+def _ica_windows(a) -> int:
+    """Window count per subject — the reference's rule: count from
+    window_size, offset from stride (data/ica.py window_timecourses)."""
+    return int(a.temporal_size / a.window_size)
+
+
+@dataclass(frozen=True)
+class ServingSpec:
+    """What the serving engine needs to know about a task, statically.
+
+    ``sample_shape(cfg)`` is ONE example's feature shape (no batch axis) —
+    the shape the microbatcher's row buckets pad to, and the shape a
+    request's rows must carry. ``stream_shape(cfg)`` is one STREAMING
+    timestep's shape (None = the task has no recurrent session semantics);
+    ``streaming_ok(cfg)`` gates the streaming lane on the config actually
+    being causal — the ICA-LSTM streams iff ``bidirectional=False`` (the
+    reverse direction of a biLSTM reads the future; models/icalstm.py
+    ICALstmStream)."""
+
+    sample_shape: Callable[[TrainConfig], tuple]
+    stream_shape: Callable[[TrainConfig], tuple] | None = None
+    streaming_ok: Callable[[TrainConfig], bool] | None = None
+
+    def supports_streaming(self, cfg: TrainConfig) -> bool:
+        return (
+            self.stream_shape is not None
+            and (self.streaming_ok is None or bool(self.streaming_ok(cfg)))
+        )
 
 
 def _build_msannet(cfg: TrainConfig):
@@ -102,17 +136,51 @@ def _build_multimodal(cfg: TrainConfig):
 
 TASKS: dict[str, TaskSpec] = {
     NNComputation.TASK_FREE_SURFER: TaskSpec(
-        NNComputation.TASK_FREE_SURFER, _build_msannet, FreeSurferDataset, FSVDataHandle
+        NNComputation.TASK_FREE_SURFER, _build_msannet, FreeSurferDataset,
+        FSVDataHandle,
+        serving=ServingSpec(
+            sample_shape=lambda cfg: (cfg.fs_args.input_size,),
+        ),
     ),
     NNComputation.TASK_ICA: TaskSpec(
-        NNComputation.TASK_ICA, _build_icalstm, ICADataset, ICADataHandle
+        NNComputation.TASK_ICA, _build_icalstm, ICADataset, ICADataHandle,
+        serving=ServingSpec(
+            sample_shape=lambda cfg: (
+                _ica_windows(cfg.ica_args),
+                cfg.ica_args.num_components,
+                cfg.ica_args.window_size,
+            ),
+            # one streaming timestep = one temporal window [C, W]
+            stream_shape=lambda cfg: (
+                cfg.ica_args.num_components, cfg.ica_args.window_size,
+            ),
+            streaming_ok=lambda cfg: not cfg.ica_args.bidirectional,
+        ),
     ),
     NNComputation.TASK_SMRI_3D: TaskSpec(
-        NNComputation.TASK_SMRI_3D, _build_smri3d, SMRIDataset, SMRIDataHandle
+        NNComputation.TASK_SMRI_3D, _build_smri3d, SMRIDataset, SMRIDataHandle,
+        serving=ServingSpec(
+            # pipeline-folded shape when space_to_depth is on (data/smri.py
+            # space_to_depth_222_np — requests arrive pre-folded, like the
+            # training inventory), the raw single-channel volume otherwise
+            sample_shape=lambda cfg: (
+                tuple(d // 2 for d in cfg.smri3d_args.volume_shape) + (8,)
+                if cfg.smri3d_args.space_to_depth
+                else tuple(cfg.smri3d_args.volume_shape)
+            ),
+        ),
     ),
     NNComputation.TASK_MULTIMODAL: TaskSpec(
         NNComputation.TASK_MULTIMODAL, _build_multimodal,
         MultimodalDataset, MultimodalDataHandle,
+        serving=ServingSpec(
+            sample_shape=lambda cfg: (
+                cfg.multimodal_args.fs_input_size
+                + _ica_windows(cfg.multimodal_args)
+                * cfg.multimodal_args.num_components
+                * cfg.multimodal_args.window_size,
+            ),
+        ),
     ),
 }
 

@@ -1,0 +1,31 @@
+"""Serving path (r15): AOT-compiled, continuously-batched inference.
+
+The first surface that ANSWERS a request (ROADMAP item 5): an
+:class:`~.engine.InferenceEngine` loads a trained checkpoint (params +
+batch_stats only), AOT-compiles one executable per (lane, shape bucket) at
+startup against the persistent XLA compile cache, and serves through a
+continuous microbatcher with max-batch/max-delay admission — plus an O(1)
+per-session streaming lane for causal recurrent heads (device-resident
+session-slot carry table, models/icalstm.py ICALstmStream).
+
+    python -m dinunet_implementations_tpu.serving \
+        --data-path datasets/demo --checkpoint out/.../checkpoint_best.msgpack \
+        --smoke 100 --out-dir out
+
+See docs/ARCHITECTURE.md "Serving (r15)".
+"""
+
+from .engine import InferenceEngine, ServingError
+from .microbatch import Microbatcher, RequestError, RequestFuture
+from .session import SessionError, SessionTable, init_carry_table
+
+__all__ = [
+    "InferenceEngine",
+    "Microbatcher",
+    "RequestError",
+    "RequestFuture",
+    "ServingError",
+    "SessionError",
+    "SessionTable",
+    "init_carry_table",
+]

@@ -1,0 +1,251 @@
+"""Serving CLI — stand up an InferenceEngine over a trained checkpoint and
+drive it with a (scripted or synthetic) mixed batched+streaming workload.
+
+    # train something first
+    dinunet-tpu --data-path datasets/demo --epochs 3 --out-dir out
+    # then serve its best checkpoint and fire 100 mixed requests
+    python -m dinunet_implementations_tpu.serving --data-path datasets/demo \
+        --out-dir out --smoke 100 --sanitize compile
+
+Request payloads come from the tree's test split (the real data the trainer
+evaluated), so the served numbers are comparable with the trainer's eval
+path. ``--script FILE`` replays a JSONL request script instead of the
+synthetic smoke mix; each line is one op:
+
+    {"op": "infer", "n": 3, "rows": 2}     # 3 requests of 2 samples each
+    {"op": "stream", "session": "s0", "windows": 4}
+    {"op": "drain"}                        # barrier: wait for all futures
+
+Telemetry (always on here — a serving run with no latency record is not
+evidence): manifest.json + metrics.jsonl (per-dispatch rows + the final
+serve_summary row with p50/p95/p99 latency, pad waste, bucket hit-rate) +
+trace files under ``<out-dir>/telemetry/serving``, schema-gated by
+``telemetry.report --validate`` like every other artifact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m dinunet_implementations_tpu.serving",
+        description="AOT-compiled, continuously-batched inference over a "
+                    "trained checkpoint (docs/ARCHITECTURE.md Serving r15).",
+    )
+    p.add_argument("--data-path", required=True,
+                   help="dataset tree (simulator layout) — request payloads "
+                        "come from its test split")
+    p.add_argument("--task", default=None,
+                   help="task id (default: TrainConfig/inputspec default)")
+    p.add_argument("--checkpoint", default=None,
+                   help="checkpoint to serve (default: the fold-0 best "
+                        "checkpoint under --out-dir)")
+    p.add_argument("--out-dir", default=None,
+                   help="output root (default <data-path>/output); serving "
+                        "telemetry lands under <out-dir>/telemetry/serving")
+    p.add_argument("--script", default=None, metavar="FILE",
+                   help="JSONL request script (see module docstring)")
+    p.add_argument("--smoke", type=int, default=None, metavar="N",
+                   help="synthetic mixed workload: N requests across "
+                        "batched + (if supported) streaming lanes")
+    p.add_argument("--row-buckets", default="1,2,4,8,16",
+                   help="batched-lane shape buckets (row capacities)")
+    p.add_argument("--stream-buckets", default="1,4",
+                   help="streaming-lane session-count buckets")
+    p.add_argument("--stream-chunk", type=int, default=8,
+                   help="windows per streaming chunk executable")
+    p.add_argument("--stream-slots", type=int, default=32,
+                   help="session-slot table capacity (LRU-evicted)")
+    p.add_argument("--max-delay-ms", type=float, default=2.0,
+                   help="microbatch admission: max wait before a partial "
+                        "bucket dispatches")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="persistent XLA compile cache: warm restarts load "
+                        "the bucket executables from disk")
+    p.add_argument("--sanitize", nargs="?", const="1", default=None,
+                   metavar="FLAGS",
+                   help="runtime sanitizer flags (checks/sanitize.py); the "
+                        "engine's zero-compile guard runs regardless")
+    p.add_argument("--set", dest="overrides", action="append", default=[],
+                   metavar="KEY=VALUE",
+                   help="override any TrainConfig / task-args field (must "
+                        "match the training run's overrides so the model "
+                        "rebuilds identically)")
+    p.add_argument("--quiet", action="store_true")
+    return p
+
+
+def default_checkpoint(out_dir: str, task_id: str) -> str:
+    """The fold-0 best checkpoint the trainer writes (trainer/logs.py
+    fold_dir layout) — without creating directories."""
+    return os.path.join(
+        out_dir, "remote", "simulatorRun", task_id, "fold_0",
+        "checkpoint_best.msgpack",
+    )
+
+
+def smoke_script(n: int, streaming: bool) -> list[dict]:
+    """A deterministic mixed workload: ~2/3 batched requests over a cycle of
+    row counts (so every bucket gets traffic), ~1/3 streaming chunks over a
+    handful of long-lived sessions, drained at the end."""
+    ops = []
+    rows_cycle = (1, 2, 3, 4, 8)
+    for i in range(n):
+        if streaming and i % 3 == 2:
+            ops.append({
+                "op": "stream", "session": f"smoke-{(i // 3) % 4}",
+                "windows": 2 + (i % 3),
+            })
+        else:
+            ops.append({"op": "infer", "n": 1,
+                        "rows": rows_cycle[i % len(rows_cycle)]})
+    ops.append({"op": "drain"})
+    return ops
+
+
+class _Pool:
+    """Cycling request-payload pool over the tree's test split."""
+
+    def __init__(self, sites):
+        self.inputs = np.concatenate([s.inputs for s in sites if len(s)])
+        self._at = 0
+
+    def take(self, n: int) -> np.ndarray:
+        ix = [(self._at + i) % len(self.inputs) for i in range(n)]
+        self._at = (self._at + n) % len(self.inputs)
+        return self.inputs[ix]
+
+
+def run_script(engine, ops: list[dict], pool: _Pool, verbose: bool) -> int:
+    """Execute a request script; returns the number of requests fired.
+    Futures are collected and resolved at each drain (and at the end), so a
+    dispatch error surfaces as a CLI failure, not a lost request."""
+    futures = []
+    stream_pos: dict[str, int] = {}
+    fired = 0
+
+    def drain():
+        engine.drain()
+        while futures:
+            futures.pop().result()
+
+    for op in ops:
+        kind = op.get("op")
+        if kind == "infer":
+            for _ in range(int(op.get("n", 1))):
+                futures.append(engine.submit(pool.take(int(op.get("rows", 1)))))
+                fired += 1
+        elif kind == "stream":
+            sid = str(op.get("session", "s0"))
+            t = int(op.get("windows", 1))
+            seq = pool.take(1)[0]  # [S, C, W] — one subject's window run
+            pos = stream_pos.get(sid, 0)
+            chunk = np.take(
+                seq, [(pos + j) % seq.shape[0] for j in range(t)], axis=0
+            )
+            stream_pos[sid] = pos + t
+            futures.append(engine.stream(sid, chunk))
+            fired += 1
+        elif kind == "drain":
+            drain()
+        elif kind == "close_session":
+            engine.close_session(str(op["session"]))
+        else:
+            raise SystemExit(f"unknown script op {op!r}")
+    drain()
+    if verbose:
+        print(json.dumps({"requests_fired": fired}))
+    return fired
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if (args.script is None) == (args.smoke is None):
+        raise SystemExit("exactly one of --script or --smoke is required")
+
+    if args.sanitize is not None:
+        from ..checks.sanitize import ENV_VAR, sanitize_flags
+
+        try:
+            sanitize_flags(args.sanitize)
+        except ValueError as e:
+            raise SystemExit(f"--sanitize: {e}")
+        os.environ[ENV_VAR] = args.sanitize
+
+    from ..core.config import TrainConfig, resolve_site_configs
+    from ..runner.cli import _parse_set
+    from ..runner.fed_runner import discover_site_dirs, load_site_splits
+    from ..telemetry.sink import FitTelemetry, _finite
+    from ..telemetry.tracer import SpanTracer
+
+    overrides = _parse_set(args.overrides)
+    if args.task is not None:
+        overrides["task_id"] = args.task
+    if args.compile_cache is not None:
+        overrides["compile_cache_dir"] = args.compile_cache
+    site_dirs = discover_site_dirs(args.data_path)
+    site_cfgs = resolve_site_configs(
+        TrainConfig().with_overrides(overrides), args.data_path,
+        num_sites=len(site_dirs),
+    )
+    cfg = site_cfgs[0]
+    out_dir = args.out_dir or os.path.join(args.data_path, "output")
+    ckpt = args.checkpoint or default_checkpoint(out_dir, cfg.task_id)
+    if not (os.path.exists(ckpt) or os.path.exists(ckpt + ".prev")):
+        raise SystemExit(
+            f"no checkpoint at {ckpt} — train first (dinunet-tpu --data-path "
+            f"{args.data_path} --out-dir {out_dir}) or pass --checkpoint"
+        )
+
+    # request payloads: the tree's fold-0 test split (what the trainer
+    # evaluated — the served numbers are comparable with eval)
+    folds = load_site_splits(cfg, site_dirs, site_cfgs)
+    pool = _Pool(folds[0]["test"])
+
+    tracer = SpanTracer()
+    sink = FitTelemetry.open(
+        os.path.join(out_dir, "telemetry", "serving"), cfg, mesh=None,
+        fold=0, tracer=tracer,
+    )
+    from ..checks.sanitize import SanitizerViolation
+    from .engine import InferenceEngine
+
+    engine = InferenceEngine(
+        cfg, checkpoint=ckpt,
+        row_buckets=[int(b) for b in args.row_buckets.split(",")],
+        stream_buckets=[int(b) for b in args.stream_buckets.split(",")],
+        stream_chunk=args.stream_chunk, stream_slots=args.stream_slots,
+        max_delay_ms=args.max_delay_ms, tracer=tracer, sink=sink,
+    )
+    try:
+        warm = engine.warmup()
+        if not args.quiet:
+            print(json.dumps({
+                "warmup_seconds": engine.warmup_seconds,
+                "executables": warm,
+                "streaming": engine.streaming,
+                "checkpoint": ckpt,
+            }))
+        if args.script is not None:
+            with open(args.script) as fh:
+                ops = [json.loads(ln) for ln in fh if ln.strip()]
+        else:
+            ops = smoke_script(args.smoke, engine.streaming)
+        run_script(engine, ops, pool, verbose=not args.quiet)
+        summary = engine.close()
+    except SanitizerViolation as v:
+        print(json.dumps({"sanitizer_violation": str(v)}), file=sys.stderr)
+        return 70
+    print(json.dumps(_finite(summary), default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,561 @@
+"""InferenceEngine — AOT-compiled, continuously-batched serving of trained
+checkpoints.
+
+The first inference surface of the build (ROADMAP item 5): load a trained
+checkpoint (params + batch_stats ONLY — optimizer/engine/buffer state
+stripped by trainer/checkpoint.py ``load_inference_state``), compile every
+program the server will ever run at startup, and answer requests through the
+continuous microbatcher. Three invariants the tests and the semantic tier
+pin:
+
+- **Compile-free request path.** Warmup ``.lower().compile()``s ONE
+  executable per (lane, shape bucket) — against the persistent XLA compile
+  cache (PR 4) when ``TrainConfig.compile_cache_dir`` is set, so a restart
+  loads machine code from disk instead of recompiling (the cold/warm gap
+  ``bench.py --serve`` measures). The request path only ever invokes those
+  stored ``Compiled`` executables: a shape outside the bucket set is a loud
+  error, never a silent retrace. A :class:`~..checks.sanitize.CompileGuard`
+  snapshots the engine's jitted entry points AFTER warmup with
+  ``max_compiles=0`` — :meth:`assert_no_compiles` is the zero-compile proof
+  the CI smoke and tests gate on.
+- **Bit-exactness with the trainer.** The batched lane compiles the SAME
+  ``eval_forward`` the trainer's eval path runs (trainer/steps.py) — served
+  probabilities on a batch reproduce the trainer's recorded eval outputs
+  bit-for-bit (tests/test_serving.py; checks/semantic.py S005 serving cell
+  proves the programs lower identically).
+- **O(1) streaming.** The ICA-LSTM lane keeps per-session ``(h, c, pooled,
+  count)`` carry in a device-resident ``[slots+1, …]`` table
+  (serving/session.py); the streaming executable gathers carries by slot
+  index, advances only the chunk's NEW windows (models/icalstm.py
+  ICALstmStream), and scatters back — per-chunk cost independent of how long
+  the session has been running. The table is the executable's DONATED input
+  buffer: it updates in place (input/output aliased, proven by the S003
+  serving cell), so session state never double-resides in HBM.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ..core.config import TrainConfig
+from ..telemetry.tracer import NULL_TRACER
+
+#: serving shape buckets: row capacities the batched lane compiles (requests
+#: pad into the smallest bucket that fits — a small closed set keeps warmup
+#: cheap and the compiled-program set finite)
+DEFAULT_ROW_BUCKETS = (1, 2, 4, 8, 16)
+#: session capacities per streaming dispatch
+DEFAULT_STREAM_BUCKETS = (1, 4)
+#: windows per streaming chunk executable (longer runs split; shorter pad
+#: with step_valid=0 — exact identities on the carry)
+DEFAULT_STREAM_CHUNK = 8
+
+
+class ServingError(RuntimeError):
+    """The serving engine cannot honor a request/configuration."""
+
+
+class _Req:
+    """One queued request (either lane)."""
+
+    __slots__ = ("rows", "weights", "future", "session", "slot", "generation",
+                 "fresh", "step_valid", "_submit_t")
+
+    def __init__(self, rows, weights=None, session=None, step_valid=None):
+        from .microbatch import RequestFuture
+
+        self.rows = rows
+        self.weights = weights
+        self.session = session
+        self.step_valid = step_valid
+        self.slot = self.generation = 0
+        self.fresh = False
+        self.future = RequestFuture()
+        self._submit_t = 0.0
+
+
+class InferenceEngine:
+    """See module docstring. Construct, :meth:`warmup`, then submit; always
+    :meth:`close` (or use as a context manager) — it stops the lane threads
+    and finalizes the serving telemetry rows."""
+
+    def __init__(self, cfg: TrainConfig, *, checkpoint: str | None = None,
+                 params=None, batch_stats=None,
+                 row_buckets=DEFAULT_ROW_BUCKETS,
+                 stream_buckets=DEFAULT_STREAM_BUCKETS,
+                 stream_chunk: int = DEFAULT_STREAM_CHUNK,
+                 stream_slots: int = 32,
+                 max_delay_ms: float = 2.0,
+                 streaming: bool | None = None,
+                 tracer=None, sink=None):
+        import jax
+
+        from ..runner.registry import get_task
+        from ..trainer.checkpoint import load_inference_state
+        from ..trainer.steps import FederatedTask
+
+        self.cfg = cfg
+        self.tracer = tracer or NULL_TRACER
+        self.sink = sink
+        self.spec = get_task(cfg.task_id)
+        if self.spec.serving is None:
+            raise ServingError(
+                f"task {cfg.task_id!r} has no serving spec "
+                "(runner/registry.py ServingSpec)"
+            )
+        self.meta: dict = {}
+        if checkpoint is not None:
+            params, batch_stats, self.meta = load_inference_state(checkpoint)
+        if params is None:
+            raise ServingError("need a checkpoint path or explicit params")
+        if cfg.compile_cache_dir:
+            from ..core.jaxcompat import enable_compile_cache
+
+            enable_compile_cache(cfg.compile_cache_dir)
+        self.model = self.spec.build_model(cfg)
+        self.task = FederatedTask(
+            self.model, has_batch_stats=bool(batch_stats)
+        )
+        self._params = jax.device_put(params)
+        self._stats = jax.device_put(batch_stats or {})
+        self.sample_shape = tuple(self.spec.serving.sample_shape(cfg))
+        self.row_buckets = tuple(sorted(set(int(b) for b in row_buckets)))
+        self.stream_chunk = int(stream_chunk)
+        self.stream_buckets = tuple(sorted(set(int(b) for b in stream_buckets)))
+        # streaming lane: auto (the task/config supports it) unless the
+        # caller opts out (streaming=False — e.g. a batched-only deployment
+        # that wants the persistent-compile-cache warm start; see warmup)
+        self.streaming = self.spec.serving.supports_streaming(cfg)
+        if streaming is False:
+            self.streaming = False
+        elif streaming is True and not self.streaming:
+            raise ServingError(
+                f"task {cfg.task_id!r} with this config cannot stream "
+                "(needs a causal recurrent head)"
+            )
+        self._warm = False
+        self._exec: dict = {}  # (lane, bucket) -> Compiled
+        self._guard = None
+        self._lock = threading.Lock()  # stats + latency list
+        # SessionTable bookkeeping is mutated by the stream lane's dispatch
+        # thread (resolve) AND the caller's thread (close_session, summary's
+        # occupancy read) — every access goes through this lock
+        self._session_lock = threading.Lock()
+        self._latencies: list = []  # (lane, seconds) per request
+        self._t0 = time.monotonic()
+        self.warmup_seconds = 0.0
+        self.stats = {"requests": 0, "samples": 0, "stream_chunks": 0}
+        self._max_delay_ms = max_delay_ms
+
+        # -- the two jitted entry points (warmup traces them; the request
+        # path only runs their stored AOT executables)
+        from ..trainer.steps import eval_forward
+
+        def infer_fn(params, stats, x, w):
+            return eval_forward(self.task, params, stats, x, None, w)
+
+        self._infer_jit = jax.jit(infer_fn)
+
+        self._stream_jit = None
+        self._table = None
+        self.sessions = None
+        if self.streaming:
+            from ..models.icalstm import ICALstmStream
+            from .session import SessionTable, init_carry_table
+
+            if stream_slots < self.stream_buckets[-1]:
+                # a dispatch of B sessions needs B distinct slots: with
+                # fewer, resolving request k can LRU-evict a session
+                # resolved EARLIER IN THE SAME BATCH — duplicate slot
+                # indices in one scatter, two live streams sharing (and
+                # corrupting) one carry row
+                raise ServingError(
+                    f"stream_slots={stream_slots} is below the largest "
+                    f"stream bucket ({self.stream_buckets[-1]}); a single "
+                    "dispatch could evict its own batch's sessions"
+                )
+            a = cfg.ica_args
+            self._stream_model = ICALstmStream(
+                input_size=a.input_size, hidden_size=a.hidden_size,
+                num_cls=a.num_class, num_comps=a.num_components,
+                window_size=a.window_size,
+                compute_dtype=a.compute_dtype or None,
+            )
+            self.sessions = SessionTable(stream_slots)
+            self._table = jax.device_put(
+                init_carry_table(stream_slots, a.hidden_size)
+            )
+            self._stream_jit = jax.jit(
+                self._stream_step, donate_argnums=(2,)
+            )
+
+    # -- traced programs -------------------------------------------------
+
+    def _stream_step(self, params, stats, table, slot_ix, fresh, x,
+                     step_valid, valid):
+        """The streaming executable: gather carries by slot, zero fresh
+        sessions in-trace, advance the chunk, scatter back (valid-gated, so
+        padded request slots are exact identities on their — trash — row).
+        ``table`` is donated: the update aliases in place."""
+        import jax
+        import jax.numpy as jnp
+
+        h, c, pooled = (
+            table["h"][slot_ix], table["c"][slot_ix], table["pooled"][slot_ix]
+        )
+        count = table["count"][slot_ix]
+        keep = (1.0 - fresh)[:, None]
+        h, c, pooled = h * keep, c * keep, pooled * keep
+        count = count * (1.0 - fresh)
+        variables = {"params": params}
+        if self.task.has_batch_stats:
+            variables["batch_stats"] = stats
+        logits, (h2, c2, p2, n2) = self._stream_model.apply(
+            variables, x, h, c, pooled, count, step_valid
+        )
+        probs = jax.nn.softmax(logits, -1)
+        vg = valid[:, None] > 0
+        new_table = {
+            "h": table["h"].at[slot_ix].set(jnp.where(vg, h2, h)),
+            "c": table["c"].at[slot_ix].set(jnp.where(vg, c2, c)),
+            "pooled": table["pooled"].at[slot_ix].set(jnp.where(vg, p2, pooled)),
+            "count": table["count"].at[slot_ix].set(
+                jnp.where(valid > 0, n2, count)
+            ),
+        }
+        return probs, new_table
+
+    # -- warmup (the only place anything compiles) -----------------------
+
+    def warmup(self) -> dict:
+        """AOT-compile every (lane, bucket) executable; returns
+        ``{lane/bucket: seconds}``. After this, the engine is armed: the
+        CompileGuard snapshot makes any later compilation a hard failure.
+
+        Persistent-compile-cache caveat (jax 0.4.37 / jaxlib 0.4.36, CPU):
+        when ANY cache-DESERIALIZED executable lives in the process,
+        invoking the streaming step (whose session table is a donated,
+        input-output-aliased buffer) corrupts the heap — reproduced by
+        building the engine twice against one cache dir and streaming a few
+        chunks (segfault); fresh-compiled executables are fine, and so is a
+        cache-restart of the donation-free batched lane alone. So a
+        STREAMING engine bypasses the cache for its whole warmup (paying a
+        fresh compile per start — correctness over restart latency), while
+        a batched-only engine keeps the PR 4 cache's cold/warm win
+        (``bench.py --serve`` measures it on exactly that shape)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..checks.sanitize import CompileGuard
+
+        t0 = time.monotonic()
+        times = {}
+        cache_prev = jax.config.jax_enable_compilation_cache
+        with self.tracer.span("serve-warmup"):
+            try:
+                if self.streaming:
+                    jax.config.update("jax_enable_compilation_cache", False)
+                for b in self.row_buckets:
+                    tb = time.monotonic()
+                    x = jnp.zeros((b,) + self.sample_shape, jnp.float32)
+                    w = jnp.ones((b,), jnp.float32)
+                    self._exec[("infer", b)] = self._infer_jit.lower(
+                        self._params, self._stats, x, w
+                    ).compile()
+                    times[f"infer/{b}"] = round(time.monotonic() - tb, 4)
+                if self.streaming:
+                    a = self.cfg.ica_args
+                    t = self.stream_chunk
+                    for b in self.stream_buckets:
+                        tb = time.monotonic()
+                        args = (
+                            self._params, self._stats, self._table,
+                            jnp.zeros((b,), jnp.int32),
+                            jnp.zeros((b,), jnp.float32),
+                            jnp.zeros(
+                                (b, t, a.num_components, a.window_size),
+                                jnp.float32,
+                            ),
+                            jnp.zeros((b, t), jnp.float32),
+                            jnp.zeros((b,), jnp.float32),
+                        )
+                        self._exec[("stream", b)] = self._stream_jit.lower(
+                            *args
+                        ).compile()
+                        times[f"stream/{b}"] = round(
+                            time.monotonic() - tb, 4
+                        )
+            finally:
+                jax.config.update(
+                    "jax_enable_compilation_cache", cache_prev
+                )
+        self.warmup_seconds = round(time.monotonic() - t0, 4)
+        # zero-compile proof: the jitted entries must gain NO cached programs
+        # from here on (the request path runs only the stored executables —
+        # any growth means a silent fallback traced)
+        self._guard = CompileGuard(
+            {"infer_fn": self._infer_jit, "stream_fn": self._stream_jit},
+            max_compiles=0, label="serving",
+        )
+        self._start_lanes()
+        self._warm = True
+        return times
+
+    def _start_lanes(self) -> None:
+        from .microbatch import Microbatcher
+
+        self._infer_lane = Microbatcher(
+            self._dispatch_infer, self.row_buckets,
+            max_delay_ms=self._max_delay_ms, name="infer",
+            on_dispatch=self._record_dispatch,
+        )
+        self._stream_lane = None
+        if self.streaming:
+            self._stream_lane = Microbatcher(
+                self._dispatch_stream, self.stream_buckets,
+                rows_of=lambda req: 1,
+                conflict_key=lambda req: req.session,
+                max_delay_ms=self._max_delay_ms, name="stream",
+                on_dispatch=self._record_dispatch,
+            )
+
+    # -- request path (Compiled executables only) ------------------------
+
+    def _record_dispatch(self, lane, batch, bucket, rows, depth) -> None:
+        if self.sink is not None:
+            self.sink.append({
+                "kind": "dispatch", "lane": lane, "bucket": int(bucket),
+                "rows": int(rows), "pad_rows": int(bucket - rows),
+                "queue_depth": int(depth),
+            })
+
+    def _finish(self, reqs, lane: str) -> None:
+        now = time.monotonic()
+        with self._lock:
+            for r in reqs:
+                self._latencies.append((lane, now - r._submit_t))
+            self.stats["requests"] += len(reqs)
+
+    def _dispatch_infer(self, reqs, bucket: int) -> None:
+        """Pack collected requests into the bucket's padded batch and run its
+        pre-compiled executable. Pad rows carry weight 0 — for batch-stat
+        models (MSANNet) the mask keeps them out of the BatchNorm statistics,
+        exactly like eval-plan padding."""
+        x = np.zeros((bucket,) + self.sample_shape, np.float32)
+        w = np.zeros((bucket,), np.float32)
+        at = 0
+        spans = []
+        for r in reqs:
+            n = len(r.rows)
+            x[at:at + n] = r.rows
+            w[at:at + n] = 1.0 if r.weights is None else r.weights
+            spans.append((r, at, n))
+            at += n
+        with self.tracer.span("serve-infer", bucket=bucket, rows=at):
+            probs = np.asarray(self._exec[("infer", bucket)](
+                self._params, self._stats, x, w
+            ))
+        for r, lo, n in spans:
+            r.future.set_result(probs[lo:lo + n])
+        with self._lock:
+            self.stats["samples"] += at
+        self._finish(reqs, "infer")
+
+    def _dispatch_stream(self, reqs, bucket: int) -> None:
+        """One streaming step over up to ``bucket`` sessions: resolve slots
+        (assign/evict on the host table), run the chunk executable, rebind
+        the donated carry table."""
+        a = self.cfg.ica_args
+        t = self.stream_chunk
+        slot_ix = np.full((bucket,), self.sessions.trash_slot, np.int32)
+        fresh = np.zeros((bucket,), np.float32)
+        x = np.zeros(
+            (bucket, t, a.num_components, a.window_size), np.float32
+        )
+        sv = np.zeros((bucket, t), np.float32)
+        valid = np.zeros((bucket,), np.float32)
+        for i, r in enumerate(reqs):
+            with self._session_lock:
+                slot, gen, is_fresh = self.sessions.resolve(r.session)
+            r.slot, r.generation, r.fresh = slot, gen, is_fresh
+            slot_ix[i] = slot
+            fresh[i] = 1.0 if (is_fresh or r.fresh) else 0.0
+            n = len(r.rows)
+            x[i, :n] = r.rows
+            sv[i, :n] = 1.0 if r.step_valid is None else r.step_valid
+            valid[i] = 1.0
+        with self.tracer.span("serve-stream", bucket=bucket, rows=len(reqs)):
+            probs, self._table = self._exec[("stream", bucket)](
+                self._params, self._stats, self._table,
+                slot_ix, fresh, x, sv, valid,
+            )
+            probs = np.asarray(probs)
+        for i, r in enumerate(reqs):
+            r.future.set_result(
+                {"probs": probs[i], "session": r.session,
+                 "generation": r.generation, "restarted": bool(r.fresh)}
+            )
+        with self._lock:
+            self.stats["samples"] += len(reqs)
+            self.stats["stream_chunks"] += len(reqs)
+        self._finish(reqs, "stream")
+
+    # -- public API ------------------------------------------------------
+
+    def submit(self, rows, weights=None):
+        """Batched inference: ``rows [n, ...sample_shape]`` → future of
+        ``probs [n, C]``. ``weights`` masks rows (eval semantics)."""
+        self._ensure_warm()
+        rows = np.asarray(rows, np.float32)
+        if rows.shape[1:] != self.sample_shape:
+            raise ServingError(
+                f"request rows shaped {rows.shape[1:]} but task "
+                f"{self.cfg.task_id!r} serves {self.sample_shape}"
+            )
+        req = _Req(rows, weights=weights)
+        self._infer_lane.submit(req)
+        return req.future
+
+    def stream(self, session_id: str, windows):
+        """Streaming inference: feed ``windows [t, C, W]`` (the session's NEW
+        timesteps) and get a future of the classification over everything
+        the session has seen. Runs longer than one chunk are split into
+        in-order chunk submissions; the returned future is the LAST chunk's
+        (the full-prefix answer)."""
+        self._ensure_warm()
+        if not self.streaming:
+            raise ServingError(
+                "this checkpoint has no streaming lane (streaming needs a "
+                "causal recurrent head: ICA-Classification with "
+                "bidirectional=false — the reverse direction of a biLSTM "
+                "reads the future, so no O(1) carry can serve it)"
+            )
+        windows = np.asarray(windows, np.float32)
+        a = self.cfg.ica_args
+        if windows.ndim != 3 or windows.shape[1:] != (
+                a.num_components, a.window_size):
+            raise ServingError(
+                f"stream windows must be [t, {a.num_components}, "
+                f"{a.window_size}], got {windows.shape}"
+            )
+        if len(windows) == 0:
+            raise ServingError(
+                "stream() needs at least one window (an empty chunk has "
+                "nothing to advance the session with)"
+            )
+        from .microbatch import ChainedFuture
+
+        links = []
+        for lo in range(0, len(windows), self.stream_chunk):
+            req = _Req(windows[lo:lo + self.stream_chunk], session=session_id)
+            self._stream_lane.submit(req)
+            links.append(req.future)
+        # the chain surfaces ANY chunk's dispatch error — a failed middle
+        # chunk must not be masked by a later chunk succeeding on a carry
+        # that silently missed its windows
+        return links[0] if len(links) == 1 else ChainedFuture(links)
+
+    def close_session(self, session_id: str) -> None:
+        with self._session_lock:
+            self.sessions.close(session_id)
+
+    def _ensure_warm(self) -> None:
+        if not self._warm:
+            raise ServingError("call warmup() before submitting requests")
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Block until both lanes' queues are empty (best effort — used by
+        the request-script runner between phases)."""
+        deadline = time.monotonic() + timeout
+        lanes = [L for L in (self._infer_lane, self._stream_lane) if L]
+        while time.monotonic() < deadline:
+            if all(L._q.qsize() == 0 and not L._stash for L in lanes):
+                return
+            time.sleep(0.002)
+
+    # -- proofs + rollup -------------------------------------------------
+
+    def compiles_after_warmup(self) -> dict:
+        return self._guard.counts() if self._guard is not None else {}
+
+    def assert_no_compiles(self) -> None:
+        """The zero-compile proof: raises
+        :class:`~..checks.sanitize.SanitizerViolation` if any jitted serving
+        entry compiled a program since warmup."""
+        if self._guard is not None:
+            self._guard.check(context="serving request path")
+
+    def summary(self) -> dict:
+        with self._lock:
+            lats = sorted(s for _, s in self._latencies)
+        with self._session_lock:
+            occupied = self.sessions.occupied if self.sessions else 0
+            evictions = self.sessions.evictions if self.sessions else 0
+        lanes = [
+            L for L in (getattr(self, "_infer_lane", None),
+                        getattr(self, "_stream_lane", None)) if L
+        ]
+        rows = sum(L.stats["rows"] for L in lanes)
+        pads = sum(L.stats["pad_rows"] for L in lanes)
+        disp = sum(L.stats["dispatches"] for L in lanes)
+        hits = sum(L.stats["bucket_hits"] for L in lanes)
+        elapsed = max(time.monotonic() - self._t0, 1e-9)
+
+        def pct(p):
+            if not lats:
+                return None
+            return round(
+                1e3 * lats[min(int(p * len(lats)), len(lats) - 1)], 4
+            )
+
+        return {
+            "kind": "serve_summary",
+            "task_id": self.cfg.task_id,
+            "requests": self.stats["requests"],
+            "samples": self.stats["samples"],
+            "stream_chunks": self.stats["stream_chunks"],
+            "dispatches": disp,
+            "latency_ms_p50": pct(0.50),
+            "latency_ms_p95": pct(0.95),
+            "latency_ms_p99": pct(0.99),
+            "requests_per_s": round(self.stats["requests"] / elapsed, 2),
+            "samples_per_s": round(self.stats["samples"] / elapsed, 2),
+            "pad_waste_pct": round(100.0 * pads / max(rows + pads, 1), 2),
+            "bucket_hit_rate": round(hits / max(disp, 1), 4),
+            "max_queue_depth": max(
+                (L.stats["max_queue_depth"] for L in lanes), default=0
+            ),
+            "warmup_seconds": self.warmup_seconds,
+            "buckets": {
+                "infer": list(self.row_buckets),
+                "stream": list(self.stream_buckets) if self.streaming else [],
+                "stream_chunk": self.stream_chunk if self.streaming else 0,
+            },
+            "stream_sessions": occupied,
+            "stream_evictions": evictions,
+            "compiles_after_warmup": sum(self.compiles_after_warmup().values()),
+        }
+
+    def close(self) -> dict:
+        """Stop the lanes, verify the zero-compile invariant, emit the
+        serve_summary telemetry row; returns the summary."""
+        for lane in (getattr(self, "_infer_lane", None),
+                     getattr(self, "_stream_lane", None)):
+            if lane is not None:
+                lane.close()
+        summary = self.summary()
+        if self.sink is not None:
+            self.sink.append(summary)
+            self.sink.close()
+        self.assert_no_compiles()
+        return summary
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

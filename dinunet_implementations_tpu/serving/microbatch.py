@@ -1,0 +1,216 @@
+"""Continuous microbatcher — the request-queue half of the serving path.
+
+One :class:`Microbatcher` per lane (batched inference / streaming step): a
+thread-safe queue plus a single dispatch thread that coalesces requests under
+a **max-batch / max-delay** admission rule — a dispatch fires as soon as the
+pending rows fill the largest shape bucket, or when the OLDEST pending
+request has waited ``max_delay_ms``, whichever comes first. The dispatch
+callback (serving/engine.py) pads the collected requests into the smallest
+bucket that fits and runs ONE pre-compiled executable — the request path
+never traces or compiles, whatever the traffic pattern (that is the point of
+bucketing: the compiled-shape set is closed at warmup).
+
+Admission details that matter:
+
+- **FIFO with conflict stash**: requests dispatch in arrival order, except a
+  request whose ``conflict_key`` collides with one already collected (two
+  chunks of the SAME streaming session — the second must see the first's
+  updated carry) is stashed for the next dispatch, preserving order.
+- **No oversize silently**: a request bigger than the largest bucket is
+  rejected at submit with a clear error — splitting is the caller's policy
+  decision (the engine's ``stream()`` splits long window runs into
+  chunk-bucket pieces before submitting).
+- The dispatch thread is a **daemon** and closes via sentinel, so a crashed
+  caller never wedges interpreter shutdown (the with_retry lesson, r13).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as _futures
+import queue
+import threading
+import time
+
+
+class ServingClosed(RuntimeError):
+    """Submit after close()."""
+
+
+class RequestError(RuntimeError):
+    """A request the serving path cannot admit (oversize, bad shape)."""
+
+
+class RequestFuture(_futures.Future):
+    """The stdlib future with a bounded default wait: a serving client that
+    forgets a timeout hangs 30 s and gets a clear ``TimeoutError``, not a
+    forever-block on a lost dispatch."""
+
+    def result(self, timeout: float | None = 30.0):
+        return super().result(timeout)
+
+
+class ChainedFuture:
+    """A future over an in-order CHAIN of requests (a multi-chunk
+    ``stream()`` call): ``result()`` waits the chain and raises the FIRST
+    link's error — an early chunk's dispatch failure must surface, never be
+    masked by a later chunk happening to succeed on a carry that silently
+    missed the failed chunk's windows."""
+
+    def __init__(self, links: list):
+        self._links = links
+
+    def done(self) -> bool:
+        return all(f.done() for f in self._links)
+
+    def result(self, timeout: float | None = 30.0):
+        out = None
+        for f in self._links:
+            out = f.result(timeout)
+        return out
+
+
+class Microbatcher:
+    """One serving lane's queue + dispatch thread (see module docstring).
+
+    ``dispatch(requests, bucket)`` receives the collected request objects and
+    the chosen bucket (row capacity); it must resolve every request's
+    ``future``. ``rows_of(req)`` counts a request's bucket rows (samples for
+    the batched lane, 1 session for the streaming lane); ``conflict_key``
+    (optional) serializes requests that must not share a dispatch."""
+
+    def __init__(self, dispatch, buckets, *, rows_of=None, conflict_key=None,
+                 max_delay_ms: float = 2.0, name: str = "lane",
+                 on_dispatch=None):
+        if not buckets:
+            raise ValueError("need at least one shape bucket")
+        self.dispatch = dispatch
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        self.rows_of = rows_of or (lambda req: len(req.rows))
+        self.conflict_key = conflict_key
+        self.max_delay_s = max_delay_ms / 1e3
+        self.name = name
+        self.on_dispatch = on_dispatch
+        self._q: queue.Queue = queue.Queue()
+        self._stash: list = []  # conflict-deferred, ahead of the queue
+        self._closed = False
+        self.stats = {
+            "requests": 0, "dispatches": 0, "rows": 0, "pad_rows": 0,
+            "bucket_hits": 0, "rejected": 0, "max_queue_depth": 0,
+        }
+        self._thread = threading.Thread(
+            target=self._run, name=f"microbatch-{name}", daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def max_rows(self) -> int:
+        return self.buckets[-1]
+
+    def bucket_for(self, rows: int) -> int:
+        for b in self.buckets:
+            if rows <= b:
+                return b
+        raise RequestError(
+            f"{self.name}: request needs {rows} rows but the largest "
+            f"compiled bucket is {self.max_rows} — split the request or "
+            f"serve with a bigger bucket set"
+        )
+
+    def submit(self, req) -> None:
+        if self._closed:
+            raise ServingClosed(f"{self.name}: microbatcher is closed")
+        rows = self.rows_of(req)
+        if rows > self.max_rows:
+            self.stats["rejected"] += 1
+            raise RequestError(
+                f"{self.name}: request of {rows} rows exceeds the largest "
+                f"bucket ({self.max_rows})"
+            )
+        req._submit_t = time.monotonic()
+        self._q.put(req)
+
+    # -- dispatch thread -------------------------------------------------
+
+    def _collect(self, first) -> list:
+        """Admission: grow the batch from the queue until the largest bucket
+        is full or the FIRST request's max-delay budget runs out."""
+        batch = [first]
+        rows = self.rows_of(first)
+        keys = {self.conflict_key(first)} if self.conflict_key else set()
+        deadline = first._submit_t + self.max_delay_s
+        while rows < self.max_rows:
+            remaining = deadline - time.monotonic()
+            nxt = None
+            if self._stash:
+                # stashed requests (conflict- or overflow-deferred) re-enter
+                # ahead of the queue, but only if they don't conflict with
+                # this batch
+                for i, cand in enumerate(self._stash):
+                    if (self.conflict_key is None
+                            or self.conflict_key(cand) not in keys):
+                        nxt = self._stash.pop(i)
+                        break
+            if nxt is None:
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is None:  # close sentinel — finish this batch first
+                    self._q.put(None)
+                    break
+            if self.conflict_key is not None:
+                k = self.conflict_key(nxt)
+                if k in keys:
+                    self._stash.append(nxt)  # same session: next dispatch
+                    continue
+                keys.add(k)
+            if rows + self.rows_of(nxt) > self.max_rows:
+                self._stash.append(nxt)  # doesn't fit: keep order, defer
+                break
+            batch.append(nxt)
+            rows += self.rows_of(nxt)
+        return batch
+
+    def _run(self) -> None:
+        while True:
+            if self._stash:
+                first = self._stash.pop(0)
+            else:
+                first = self._q.get()
+                if first is None:
+                    if self._stash:  # drain conflict-deferred tail
+                        self._q.put(None)
+                        continue
+                    return
+            batch = self._collect(first)
+            rows = sum(self.rows_of(r) for r in batch)
+            try:
+                bucket = self.bucket_for(rows)
+                depth = self._q.qsize() + len(self._stash)
+                self.stats["max_queue_depth"] = max(
+                    self.stats["max_queue_depth"], depth
+                )
+                self.dispatch(batch, bucket)
+                self.stats["requests"] += len(batch)
+                self.stats["dispatches"] += 1
+                self.stats["rows"] += rows
+                self.stats["pad_rows"] += bucket - rows
+                self.stats["bucket_hits"] += int(rows == bucket)
+                if self.on_dispatch is not None:
+                    self.on_dispatch(self.name, batch, bucket, rows, depth)
+            except Exception as e:
+                # the dispatch thread must never die silently: every
+                # collected request's waiter gets the error, and the loop
+                # keeps serving the next batch
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+
+    def close(self, timeout: float = 10.0) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(None)
+        self._thread.join(timeout)

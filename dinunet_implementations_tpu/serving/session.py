@@ -1,0 +1,119 @@
+"""Session-slot cache — O(1) recurrent state for streaming inference.
+
+The serving twin of the elastic-rounds MembershipTable
+(robustness/membership.py): logical STREAMING SESSIONS float over a fixed
+``[slots]`` device-resident carry table, so the compiled streaming step has
+one shape for the life of the server and a returning stream ships only its
+NEW timesteps. Two halves:
+
+- :class:`SessionTable` — host-side bookkeeping (session id → slot, LRU
+  eviction, generation counters). NOT internally locked: the stream lane's
+  dispatch thread (resolve) and the caller's thread (close_session, the
+  summary rollup) both touch it, and the engine serializes every access
+  under its ``_session_lock``. Like the membership table it never touches
+  jax state — sessions reach the compiled program only as gathered slot
+  indices and a ``fresh`` reset gate (both traced inputs).
+- :func:`init_carry_table` — the device-resident ``[slots+1, …]`` pytree the
+  streaming executable gathers/scatters by slot index ON-DEVICE: per-session
+  ``(h, c)`` LSTM carry plus the scan-accumulated mean-pool state
+  (models/icalstm.py ICALstmStream). Row ``slots`` is the TRASH row: padded
+  request slots in a partially-filled batch point there, so their (identity)
+  scatter writes can never land on a live session.
+
+Generations mirror the membership pattern: every (re)assignment of a slot
+bumps its generation, and a fresh assignment zeroes the carry INSIDE the
+compiled step (the ``fresh`` gate) — a session resumed after eviction can
+never resurrect another session's (or its own stale) recurrent state. The
+generation in the result metadata is the client's signal that the server
+restarted its stream.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class SessionError(ValueError):
+    """An invalid session operation (unknown close, zero capacity)."""
+
+
+class SessionTable:
+    """Host-side session id → carry-table slot map with LRU eviction."""
+
+    def __init__(self, capacity: int):
+        if capacity < 1:
+            raise SessionError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self.slots: list = [None] * capacity  # session id | None
+        self.generations = [0] * capacity  # current occupant's generation
+        self._known: dict = {}  # session id -> last generation (join history)
+        self._last_used = [0] * capacity  # LRU tick per slot
+        self._tick = 0
+        self.evictions = 0
+
+    @property
+    def trash_slot(self) -> int:
+        """The carry-table row padded request slots scatter into — one past
+        the last real slot (:func:`init_carry_table` allocates it)."""
+        return self.capacity
+
+    @property
+    def occupied(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    def slot_of(self, session_id: str):
+        try:
+            return self.slots.index(session_id)
+        except ValueError:
+            return None
+
+    def resolve(self, session_id: str) -> tuple:
+        """``(slot, generation, fresh)`` for a session, assigning (and, at
+        capacity, LRU-evicting) as needed. ``fresh=True`` means the carry row
+        must be zeroed before use — the streaming executable's reset gate;
+        an evicted-then-returning session comes back fresh at a bumped
+        generation (its O(1) state was the thing evicted)."""
+        if not session_id or not isinstance(session_id, str):
+            raise SessionError("session id must be a non-empty string")
+        self._tick += 1
+        slot = self.slot_of(session_id)
+        if slot is not None:
+            self._last_used[slot] = self._tick
+            return slot, self.generations[slot], False
+        try:
+            slot = self.slots.index(None)
+        except ValueError:
+            # LRU eviction: the least recently touched session loses its slot
+            slot = min(range(self.capacity), key=lambda i: self._last_used[i])
+            self.evictions += 1
+        # per-SESSION generation (the membership pattern): a rejoin — after
+        # close or eviction — comes back at last + 1, the auditable record
+        # that its O(1) carry restarted from zero
+        gen = self._known.get(session_id, 0) + 1
+        self._known[session_id] = gen
+        self.slots[slot] = session_id
+        self.generations[slot] = gen
+        self._last_used[slot] = self._tick
+        return slot, gen, True
+
+    def close(self, session_id: str) -> int:
+        """Release a session's slot (its next resolve starts fresh)."""
+        slot = self.slot_of(session_id)
+        if slot is None:
+            raise SessionError(f"unknown session {session_id!r}")
+        self.slots[slot] = None
+        return slot
+
+
+def init_carry_table(capacity: int, hidden: int, dtype=np.float32) -> dict:
+    """Fresh device-shaped ``[capacity + 1, …]`` carry pytree (as numpy — the
+    engine device_puts it once at warmup): LSTM ``h``/``c``, the
+    scan-accumulated pooled hidden sum, and the valid-timestep ``count``.
+    The extra row is the trash slot (:attr:`SessionTable.trash_slot`)."""
+    rows = capacity + 1
+    return {
+        "h": np.zeros((rows, hidden), dtype),
+        "c": np.zeros((rows, hidden), dtype),
+        "pooled": np.zeros((rows, hidden), dtype),
+        "count": np.zeros((rows,), dtype),
+    }

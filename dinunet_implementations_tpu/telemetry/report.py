@@ -45,7 +45,7 @@ def fit_dirs(path: str) -> list[str]:
         return [path]
     subs = sorted(
         os.path.join(path, d) for d in os.listdir(path)
-        if d.startswith("fold_")
+        if (d.startswith("fold_") or d.startswith("serv"))
         and os.path.exists(os.path.join(path, d, MANIFEST_FILE))
     )
     if not subs:
@@ -152,6 +152,27 @@ def render_fit(dirpath: str) -> None:
             f"{round(float(last.get('payload_bytes', 0)) / rounds)} · "
             f"update‖·‖ last={_norm(last.get('update_sq_last', 0)):.5f} · "
             f"prefetch_stall_s={summary.get('prefetch_stall_s', 'n/a')}"
+        )
+    serve = next(
+        (r for r in rows if r.get("kind") == "serve_summary"), None
+    )
+    if serve:
+        def ms(key):
+            v = serve.get(key)
+            return "n/a" if v is None else format(float(v), ".2f")
+
+        print(
+            "-- serving: "
+            f"{serve.get('requests')} requests / "
+            f"{serve.get('samples')} samples in "
+            f"{serve.get('dispatches')} dispatches · latency ms "
+            f"p50={ms('latency_ms_p50')} p95={ms('latency_ms_p95')} "
+            f"p99={ms('latency_ms_p99')} · "
+            f"{serve.get('requests_per_s')} req/s · "
+            f"pad_waste={serve.get('pad_waste_pct')}% · "
+            f"bucket_hit_rate={serve.get('bucket_hit_rate')} · "
+            f"warmup={serve.get('warmup_seconds')}s · "
+            f"compiles_after_warmup={serve.get('compiles_after_warmup')}"
         )
     membership = summary.get("membership")
     if membership:

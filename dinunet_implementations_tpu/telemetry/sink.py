@@ -60,6 +60,20 @@ ROW_REQUIRED = {
         # itself is part of the schema contract
         "membership",
     }),
+    # serving path (r15, serving/engine.py): one row per microbatch dispatch
+    # (queue/padding visibility) ...
+    "dispatch": frozenset({
+        "kind", "lane", "bucket", "rows", "pad_rows", "queue_depth",
+    }),
+    # ... and the run's rollup. The latency percentiles are REQUIRED keys —
+    # the CI serving smoke gates on `report --validate`, so a serving run
+    # that lost its latency record cannot validate.
+    "serve_summary": frozenset({
+        "kind", "task_id", "requests", "samples", "dispatches",
+        "latency_ms_p50", "latency_ms_p95", "latency_ms_p99",
+        "requests_per_s", "samples_per_s", "pad_waste_pct",
+        "bucket_hit_rate", "warmup_seconds", "compiles_after_warmup",
+    }),
 }
 
 

@@ -1,6 +1,7 @@
 from .checkpoint import (
     CorruptCheckpointError,
     load_checkpoint,
+    load_inference_state,
     load_params,
     save_checkpoint,
 )
@@ -11,6 +12,7 @@ from .steps import (
     TrainState,
     compile_epoch_aot,
     epoch_program_artifacts,
+    eval_forward,
     init_train_state,
     make_eval_fn,
     make_optimizer,

@@ -301,6 +301,29 @@ def load_eval_state(path: str, like_params: Any, like_stats: Any):
     return params, stats, json.loads(meta)
 
 
+def load_inference_state(path: str):
+    """Template-free inference restore: ``(params, batch_stats, meta)`` with
+    the optimizer / engine / health / telemetry / buffer / overlap state
+    STRIPPED — the serving engine's checkpoint entry (serving/engine.py).
+
+    Unlike :func:`load_eval_state` no ``like`` structure is needed: the
+    serializer schema keys the payload by name, so params and batch_stats
+    restore as plain nested dicts (msgpack arrays), directly consumable by
+    ``model.apply``. The serving CLI builds the model from config and loads
+    whatever checkpoint the trainer saved — train-side state shapes (site
+    count, engine choice, staleness mode) can never block an inference
+    restore. Falls back to ``.prev`` like every other loader."""
+    raw = _load_raw(path)
+    meta = raw.get("meta_json") or "{}"
+    if isinstance(meta, bytes):
+        meta = meta.decode()
+    return (
+        raw.get("params", {}),
+        raw.get("batch_stats", {}) or {},
+        json.loads(meta),
+    )
+
+
 def checkpoint_meta(path: str) -> dict:
     mpath = path + ".meta.json"
     if os.path.exists(mpath):

@@ -1345,19 +1345,42 @@ def compile_epoch_aot(epoch_fn, state: TrainState, x, y, w, live=None):
     return comp, lambda xs: jax.device_put(xs, x_fmt)
 
 
+def eval_forward(task: FederatedTask, params, batch_stats, x, y=None, w=None):
+    """THE per-task inference forward — the single definition both the
+    trainer's eval path (:func:`make_eval_fn`) and the serving engine
+    (serving/engine.py) compile, so a served checkpoint reproduces the
+    trainer's recorded eval scores bit-for-bit on identical batches
+    (tests/test_serving.py; the S005 serving identity cell proves the two
+    programs lower identically).
+
+    ``x [B, ...]`` is one batch; ``w [B]`` is the per-example valid mask
+    (serving's request padding and eval's plan padding share these
+    semantics — for batch-stat models like MSANNet the mask also keeps pad
+    rows out of the BatchNorm statistics, exactly as in training). With
+    labels ``y`` also returns the per-example cross-entropy (the eval loss
+    path); ``y=None`` (serving) returns probs only — a trace-time branch,
+    so the serving program carries no label ops at all."""
+    logits, _ = task.apply(params, batch_stats, x, train=False, mask=w)
+    probs = jax.nn.softmax(logits, -1)
+    if y is None:
+        return probs
+    logp = jax.nn.log_softmax(logits, -1)
+    ce = -jnp.take_along_axis(logp, y[..., None].astype(jnp.int32), -1)[..., 0]
+    return probs, ce
+
+
 def make_eval_fn(task: FederatedTask, mesh=None):
     """Jitted full-pass eval: returns per-site ``probs [S, steps, B, C]``,
     ``loss_sum [S]``, ``weight_sum [S]`` — metric scalars are computed
     host-side (trainer/metrics.py). ``mesh=None`` folds sites via vmap, as in
-    :func:`make_train_epoch_fn`."""
+    :func:`make_train_epoch_fn`. The per-batch forward is
+    :func:`eval_forward` — shared verbatim with the serving engine."""
 
     def per_site_eval(params, batch_stats, x, y, w):
         def step(_, batch):
             xb, yb, wb = batch
-            logits, _ = task.apply(params, batch_stats, xb, train=False, mask=wb)
-            logp = jax.nn.log_softmax(logits, -1)
-            ce = -jnp.take_along_axis(logp, yb[..., None].astype(jnp.int32), -1)[..., 0]
-            return None, (jax.nn.softmax(logits, -1), (ce * wb).sum())
+            probs, ce = eval_forward(task, params, batch_stats, xb, yb, wb)
+            return None, (probs, (ce * wb).sum())
 
         _, (probs, loss_sums) = jax.lax.scan(step, None, (x, y, w))
         return probs, loss_sums.sum(), w.sum()
