@@ -85,8 +85,11 @@ CHECKPOINT_FILE = "trainer/checkpoint.py"
 CHECKPOINT_EXTRA_KEYS = {"meta_json"}
 
 #: R007 — telemetry API calls whose NAME argument (positional 0 or ``name=``)
-#: must be trace-stable (telemetry/tracer.py span/event/counter).
-TELEMETRY_NAME_CALLS = {"span", "event", "counter"}
+#: must be trace-stable (telemetry/tracer.py span/event/counter + the
+#: MetricsBus publishers gauge/observe — bus series names feed /metrics and
+#: must be as greppable as span names; ``counter`` already covers the bus's
+#: counter method).
+TELEMETRY_NAME_CALLS = {"span", "event", "counter", "gauge", "observe"}
 
 
 # -- registry ---------------------------------------------------------------

@@ -98,6 +98,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pinned inventory rows per slot (headroom for "
                         "bigger sites joining later; default: the first "
                         "admitted site's size)")
+    p.add_argument("--statusz-port", type=int, default=None, metavar="PORT",
+                   help="daemon mode: serve live observability endpoints on "
+                        "127.0.0.1:PORT — /metrics (Prometheus text), "
+                        "/healthz (per-subsystem readiness), /statusz "
+                        "(JSON snapshot incl. SLO burn), /tracez (recent "
+                        "spans). PORT 0 picks a free port (printed at "
+                        "startup). telemetry/exporter.py")
+    p.add_argument("--slo-p99-ms", type=float, default=None, metavar="MS",
+                   help="p99 target for the /statusz SLO error-budget burn, "
+                        "computed over the live epoch-latency histogram "
+                        "(daemon) — burn > 1.0 means the error budget is "
+                        "being spent faster than allowed")
     p.add_argument("--folds", type=int, nargs="*", default=None,
                    help="run only these fold indices")
     p.add_argument("--resume", action="store_true",
@@ -263,6 +275,33 @@ def main(argv: list[str] | None = None) -> int:
             resume=args.resume,
             verbose=verbose,
         )
+        # live observability plane (r16): /metrics /healthz /statusz
+        # /tracez over the process bus, and crash hooks so an unhandled
+        # exception dumps the flight ring (SIGTERM/SIGINT dump rides the
+        # daemon's cooperative PreemptionGuard path — signals=() here,
+        # the guard owns those handlers during serve())
+        daemon.flight.install(signals=())
+        exporter = None
+        if args.statusz_port is not None:
+            from ..telemetry.exporter import StatusExporter
+
+            exporter = StatusExporter(
+                daemon.bus, port=args.statusz_port,
+                tracer=daemon.trainer.tracer, flight=daemon.flight,
+                health=daemon.health_probes(), statusz=daemon.status,
+                slo=(
+                    {"histogram": "serve_epoch_ms",
+                     "p99_target_ms": args.slo_p99_ms}
+                    if args.slo_p99_ms is not None else None
+                ),
+            )
+            port = exporter.start()
+            if verbose:
+                print(json.dumps({
+                    "statusz": f"http://127.0.0.1:{port}",
+                    "endpoints": ["/metrics", "/healthz", "/statusz",
+                                  "/tracez"],
+                }))
         try:
             # DINUNET_SANITIZE / --sanitize: the one-epoch-compile guard
             # wraps the WHOLE service — any churn-induced retrace trips it
@@ -271,8 +310,16 @@ def main(argv: list[str] | None = None) -> int:
             with sanitized_fit(daemon.trainer, label="serve"):
                 summary = daemon.serve(max_epochs=args.serve_epochs)
         except SanitizerViolation as v:
+            daemon.flight.dump("sanitizer-violation")
             print(json.dumps({"sanitizer_violation": str(v)}), file=sys.stderr)
             return 70
+        finally:
+            # the excepthook stays installed on the failure path — an
+            # exception unwinding past here still dumps the flight ring
+            # at interpreter exit
+            if exporter is not None:
+                exporter.stop()
+        daemon.flight.uninstall()
         from ..telemetry.sink import _finite
 
         print(json.dumps(_finite(summary), default=str))

@@ -355,9 +355,13 @@ class FedDaemon:
         steps: int | None = None,
         resume: bool = False,
         verbose: bool = True,
+        bus=None,
+        flight=None,
         **overrides,
     ):
         from ..robustness.membership import MembershipTable
+        from ..telemetry.bus import global_bus
+        from ..telemetry.flight import FlightRecorder
 
         cfg = (cfg or TrainConfig()).with_overrides(overrides)
         if capacity < 1:
@@ -380,12 +384,21 @@ class FedDaemon:
             os.path.join(data_path, "output") if data_path else "output"
         )
         os.makedirs(self.spool_dir, exist_ok=True)
+        # live observability (r16): the daemon always publishes into a
+        # MetricsBus (the process-wide one unless injected) — host-side
+        # bookkeeping only, readable by the /statusz exporter — and always
+        # keeps a flight recorder ring so a crash/SIGTERM dumps the final
+        # seconds even when file telemetry is off
+        self.bus = bus if bus is not None else global_bus()
         if mesh == "auto":
             mesh = auto_site_mesh(self.cfg, capacity)
         self.mesh = mesh
         self.trainer = FederatedTrainer(
             self.cfg, get_task(self.cfg.task_id).build_model(self.cfg),
-            mesh, out_dir=self.out_dir, fault_plan=fault_plan,
+            mesh, out_dir=self.out_dir, fault_plan=fault_plan, bus=self.bus,
+        )
+        self.flight = flight if flight is not None else FlightRecorder(
+            self.out_dir, bus=self.bus, tracer=self.trainer.tracer,
         )
         self.trainer._num_sites = capacity
         self.table = MembershipTable(capacity)
@@ -401,6 +414,11 @@ class FedDaemon:
         # the tree's inputspec entry): JSON-able, checkpointed in meta so
         # resume re-admits each member under its own labels/data columns
         self._overrides: dict = {}
+        # site id -> trace id (a join event's "trace_id"): cross-process
+        # trace propagation — flows into the membership telemetry events
+        # and the checkpoint meta, so a served checkpoint can name the
+        # spool events whose data trained it
+        self._traces: dict = {}
         # ONE cached zero-row placeholder for free slots: _ensure_inventory's
         # content fingerprint is id()-keyed, and fresh placeholders per epoch
         # would silently re-stack + re-upload the whole inventory grid every
@@ -554,6 +572,7 @@ class FedDaemon:
             self._stop = True
             self._log("[serve] shutdown event received")
             return False
+        trace_id = str(ev.get("trace_id") or "") or None
         try:
             if kind == "join":
                 site = str(ev["site"])
@@ -561,18 +580,26 @@ class FedDaemon:
                 overrides = ev.get("config") or {}
                 arrays = self._admit(site, data_dir, overrides)
                 if arrays is None:
+                    self.bus.counter("serve_spool_events_total",
+                                     result="rejected")
                     return False
                 self.table, slot, gen = self.table.join(site)
                 self._data[site] = arrays
                 self._dirs[site] = data_dir
                 self._overrides[site] = overrides
+                if trace_id:
+                    self._traces[site] = trace_id
                 self._ensure_state()
                 self._reset_slot(slot, site=site, generation=gen)
                 self._log(
                     f"[serve] join {site!r} → slot {slot} (generation {gen})"
                 )
                 self._event("membership-join", site=site, slot=slot,
-                            generation=gen)
+                            generation=gen, trace=trace_id)
+                self.flight.note("membership-join", site=site, slot=slot,
+                                 trace=trace_id)
+                self.bus.counter("serve_spool_events_total", result="applied")
+                self.bus.gauge("serve_member_generation", gen, site=site)
                 return True
             if kind == "leave":
                 site = str(ev["site"])
@@ -580,14 +607,21 @@ class FedDaemon:
                 self._data.pop(site, None)
                 self._dirs.pop(site, None)
                 self._overrides.pop(site, None)
+                self._traces.pop(site, None)
                 self._log(f"[serve] leave {site!r} (slot {slot} freed)")
-                self._event("membership-leave", site=site, slot=slot)
+                self._event("membership-leave", site=site, slot=slot,
+                            trace=trace_id)
+                self.flight.note("membership-leave", site=site, slot=slot)
+                self.bus.counter("serve_spool_events_total", result="applied")
+                self.bus.clear_gauge("serve_member_generation", site=site)
                 return True
         except (MembershipError, KeyError) as e:
             log_warning(f"[serve] bad membership event {ev!r}: {e}")
             self._event("membership-error", reason=str(e))
+            self.bus.counter("serve_spool_events_total", result="rejected")
             return False
         log_warning(f"[serve] unknown spool event {ev!r} — ignored")
+        self.bus.counter("serve_spool_events_total", result="rejected")
         return False
 
     def _reset_slot(self, slot: int, site: str = "", generation: int = 0):
@@ -716,7 +750,32 @@ class FedDaemon:
                 release = False  # the hold may have lifted — back to strict
             if self._stop:
                 break
+        self.bus.gauge("serve_spool_ingest_lag_s", self._spool_lag())
         return changed
+
+    def _spool_lag(self) -> float:
+        """Age in seconds of the OLDEST spool file still pending after a
+        drain (scheduled events waiting their epoch mark, or backlog the
+        loop hasn't reached) — the bus gauge an operator watches to see
+        ingest falling behind. 0.0 with an empty spool."""
+        oldest = None
+        try:
+            for name in os.listdir(self.spool_dir):
+                if not name.endswith(".json"):
+                    continue
+                try:
+                    mtime = os.path.getmtime(
+                        os.path.join(self.spool_dir, name)
+                    )
+                except OSError:
+                    continue  # consumed/renamed mid-scan
+                if oldest is None or mtime < oldest:
+                    oldest = mtime
+        except OSError:
+            return 0.0
+        if oldest is None:
+            return 0.0
+        return round(max(time.time() - oldest, 0.0), 3)
 
     # -- training ----------------------------------------------------------
 
@@ -749,6 +808,7 @@ class FedDaemon:
             self.held_rounds += rounds
             self._event("round-hold", occupied=self.table.occupied,
                         quorum=self.quorum)
+            self._note_hold(rounds)
             return None
         if not any(
             len(self._data[s]) >= self.cfg.batch_size
@@ -760,6 +820,7 @@ class FedDaemon:
             self.held_rounds += rounds
             self._event("round-hold", occupied=self.table.occupied,
                         quorum=self.quorum, reason="no trainable batch")
+            self._note_hold(rounds)
             return None
         if self._steps is None:
             # pin the step grid on first contact with data (membership can
@@ -774,7 +835,7 @@ class FedDaemon:
         self.trainer.fixed_steps = self._steps
         self.trainer.fixed_inventory_rows = self._rows
         self.epochs_run += 1
-        t0 = time.time()
+        t0 = time.perf_counter()  # the tracer's clock (duration contract)
         with self.trainer.tracer.span("epoch", epoch=self.epochs_run):
             self.state, losses = self.trainer.run_epoch(
                 self.state, self._slot_sites(), self.epochs_run,
@@ -785,11 +846,27 @@ class FedDaemon:
         if self._sink is not None:
             self.trainer._fit_tel = self._sink
             self.trainer._epoch_row(0, self.epochs_run, loss, t0, self.state)
+        # live metrics + flight ring: values already on the host
+        self.bus.gauge("serve_epoch", self.epochs_run)
+        self.bus.gauge("serve_train_loss", loss)
+        self.bus.gauge("serve_members", self.table.occupied)
+        self.bus.counter("serve_epochs_total")
+        self.bus.observe(
+            "serve_epoch_ms", (time.perf_counter() - t0) * 1e3
+        )
+        self.flight.note("serve-epoch", epoch=self.epochs_run, loss=loss,
+                         occupied=self.table.occupied)
         self._log(
             f"[serve] epoch {self.epochs_run}: train_loss={loss:.4f} "
             f"({self.table.occupied}/{self.capacity} slots)"
         )
         return loss
+
+    def _note_hold(self, rounds: int) -> None:
+        self.bus.counter("serve_held_rounds_total", rounds)
+        self.bus.gauge("serve_members", self.table.occupied)
+        self.flight.note("round-hold", occupied=self.table.occupied,
+                         quorum=self.quorum)
 
     def checkpoint(self):
         """Rotating checkpoint with the membership table (and member data
@@ -809,9 +886,17 @@ class FedDaemon:
                     "membership": self.table.to_json(),
                     "data_dirs": dict(self._dirs),
                     "site_overrides": dict(self._overrides),
+                    # trace propagation: which spool joins' data trained
+                    # the published model — the serving engine surfaces
+                    # these from the checkpoint it loads
+                    "traces": dict(self._traces),
                 },
                 rotate=True,
             )
+        self._event("checkpoint-publish", epoch=self.epochs_run,
+                    traces=dict(self._traces))
+        self.flight.note("checkpoint-publish", epoch=self.epochs_run)
+        self.bus.counter("serve_checkpoints_total")
 
     def _resume(self) -> bool:
         """Restore the service from its last checkpoint: membership table +
@@ -843,6 +928,7 @@ class FedDaemon:
         self._rows = meta.get("rows") or self._rows
         self._dirs = dict(meta.get("data_dirs", {}))
         self._overrides = dict(meta.get("site_overrides", {}))
+        self._traces = dict(meta.get("traces", {}))
         for site, slot in sorted(
             self.table.members().items(), key=lambda kv: kv[1]
         ):
@@ -922,6 +1008,11 @@ class FedDaemon:
                         "shutting down"
                     )
                     self.checkpoint()
+                    # the guard owns the signal handlers here, so the
+                    # flight recorder dumps cooperatively: final spans +
+                    # bus snapshot land in flight_<pid>.json before exit
+                    self.flight.note("signal", signum=guard.requested)
+                    self.flight.dump(f"signal:{guard.requested}")
                     break
                 if max_epochs is not None and trained_here >= max_epochs:
                     break
@@ -931,6 +1022,49 @@ class FedDaemon:
                     # idle (held below quorum, empty spool): poll gently
                     time.sleep(self.poll_s)
         return self.close()
+
+    # -- live observability (exporter plumbing) ----------------------------
+
+    def health_probes(self) -> dict:
+        """Per-subsystem readiness for ``/healthz``: the service is ready
+        when it has a state template, meets quorum, and can reach its
+        spool."""
+        return {
+            "state": lambda: self.state is not None,
+            "quorum": lambda: self.table.occupied >= self.quorum,
+            "spool": lambda: os.path.isdir(self.spool_dir),
+        }
+
+    def status(self) -> dict:
+        """The live ``/statusz`` payload: what round the service is on,
+        who is a member (with generations and propagated trace ids), and
+        the hold/ingest state — everything an operator previously had to
+        infer from logs after the fact."""
+        return {
+            "mode": "daemon",
+            "task_id": self.cfg.task_id,
+            "epoch": self.epochs_run,
+            "held_rounds": self.held_rounds,
+            "capacity": self.capacity,
+            "quorum": self.quorum,
+            "occupied": self.table.occupied,
+            "holding": self._idle,
+            "members": {
+                site: {
+                    "slot": slot,
+                    "generation": self.table.generation_of(site),
+                    "samples": len(self._data.get(site, ())),
+                    "trace_id": self._traces.get(site),
+                }
+                for site, slot in sorted(self.table.members().items())
+            },
+            "membership_epoch": self.table.epoch,
+            "steps": self._steps,
+            "inventory_rows": self._rows,
+            "spool_dir": self.spool_dir,
+            "spool_lag_s": self._spool_lag(),
+            "preempted": self._preempted,
+        }
 
     def close(self) -> dict:
         """Final checkpoint + telemetry summary; returns the service

@@ -69,6 +69,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--compile-cache", default=None, metavar="DIR",
                    help="persistent XLA compile cache: warm restarts load "
                         "the bucket executables from disk")
+    p.add_argument("--statusz-port", type=int, default=None, metavar="PORT",
+                   help="serve live observability endpoints on "
+                        "127.0.0.1:PORT — /metrics (Prometheus), /healthz, "
+                        "/statusz (JSON snapshot incl. SLO burn over the "
+                        "request-latency histogram), /tracez. PORT 0 picks "
+                        "a free port (printed at startup)")
+    p.add_argument("--slo-p99-ms", type=float, default=50.0, metavar="MS",
+                   help="p99 latency target for the /statusz SLO "
+                        "error-budget burn (default 50 ms)")
+    p.add_argument("--linger-s", type=float, default=0.0, metavar="S",
+                   help="keep the process (and the --statusz-port "
+                        "endpoints) up this many seconds after the request "
+                        "script completes — a deterministic scrape window "
+                        "for live-observability smoke tests")
     p.add_argument("--sanitize", nargs="?", const="1", default=None,
                    metavar="FLAGS",
                    help="runtime sanitizer flags (checks/sanitize.py); the "
@@ -140,7 +154,10 @@ def run_script(engine, ops: list[dict], pool: _Pool, verbose: bool) -> int:
         kind = op.get("op")
         if kind == "infer":
             for _ in range(int(op.get("n", 1))):
-                futures.append(engine.submit(pool.take(int(op.get("rows", 1)))))
+                futures.append(engine.submit(
+                    pool.take(int(op.get("rows", 1))),
+                    trace_id=op.get("trace_id"),
+                ))
                 fired += 1
         elif kind == "stream":
             sid = str(op.get("session", "s0"))
@@ -151,7 +168,9 @@ def run_script(engine, ops: list[dict], pool: _Pool, verbose: bool) -> int:
                 seq, [(pos + j) % seq.shape[0] for j in range(t)], axis=0
             )
             stream_pos[sid] = pos + t
-            futures.append(engine.stream(sid, chunk))
+            futures.append(
+                engine.stream(sid, chunk, trace_id=op.get("trace_id"))
+            )
             fired += 1
         elif kind == "drain":
             drain()
@@ -215,15 +234,41 @@ def main(argv: list[str] | None = None) -> int:
         fold=0, tracer=tracer,
     )
     from ..checks.sanitize import SanitizerViolation
+    from ..telemetry.bus import global_bus
+    from ..telemetry.flight import FlightRecorder
     from .engine import InferenceEngine
+
+    # live observability plane (r16): process bus + flight recorder (dumps
+    # the final spans/bus snapshot on SIGTERM or an unhandled exception),
+    # and — with --statusz-port — the /metrics /healthz /statusz /tracez
+    # exporter
+    bus = global_bus()
+    flight = FlightRecorder(out_dir, bus=bus, tracer=tracer)
+    flight.install()  # no PreemptionGuard here: own SIGTERM + excepthook
 
     engine = InferenceEngine(
         cfg, checkpoint=ckpt,
         row_buckets=[int(b) for b in args.row_buckets.split(",")],
         stream_buckets=[int(b) for b in args.stream_buckets.split(",")],
         stream_chunk=args.stream_chunk, stream_slots=args.stream_slots,
-        max_delay_ms=args.max_delay_ms, tracer=tracer, sink=sink,
+        max_delay_ms=args.max_delay_ms, tracer=tracer, sink=sink, bus=bus,
     )
+    exporter = None
+    if args.statusz_port is not None:
+        from ..telemetry.exporter import StatusExporter
+
+        exporter = StatusExporter(
+            bus, port=args.statusz_port, tracer=tracer, flight=flight,
+            health=engine.health_probes(), statusz=engine.status,
+            slo={"histogram": "serving_request_latency_ms",
+                 "p99_target_ms": args.slo_p99_ms},
+        )
+        port = exporter.start()
+        if not args.quiet:
+            print(json.dumps({
+                "statusz": f"http://127.0.0.1:{port}",
+                "endpoints": ["/metrics", "/healthz", "/statusz", "/tracez"],
+            }))
     try:
         warm = engine.warmup()
         if not args.quiet:
@@ -239,10 +284,21 @@ def main(argv: list[str] | None = None) -> int:
         else:
             ops = smoke_script(args.smoke, engine.streaming)
         run_script(engine, ops, pool, verbose=not args.quiet)
+        if args.linger_s:
+            import time
+
+            time.sleep(args.linger_s)
         summary = engine.close()
     except SanitizerViolation as v:
+        flight.dump("sanitizer-violation")
         print(json.dumps({"sanitizer_violation": str(v)}), file=sys.stderr)
         return 70
+    finally:
+        # the crash hooks stay installed on the failure path — an
+        # exception unwinding past here still dumps at interpreter exit
+        if exporter is not None:
+            exporter.stop()
+    flight.uninstall()
     print(json.dumps(_finite(summary), default=str))
     return 0
 

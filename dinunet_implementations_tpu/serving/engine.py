@@ -62,9 +62,11 @@ class _Req:
     """One queued request (either lane)."""
 
     __slots__ = ("rows", "weights", "future", "session", "slot", "generation",
-                 "fresh", "step_valid", "_submit_t")
+                 "fresh", "step_valid", "trace_id", "_submit_t")
 
-    def __init__(self, rows, weights=None, session=None, step_valid=None):
+    def __init__(self, rows, weights=None, session=None, step_valid=None,
+                 trace_id=None):
+        from ..telemetry.tracer import new_trace_id
         from .microbatch import RequestFuture
 
         self.rows = rows
@@ -73,7 +75,13 @@ class _Req:
         self.step_valid = step_valid
         self.slot = self.generation = 0
         self.fresh = False
+        # cross-process trace propagation: a caller-supplied id (a client's
+        # request id, a spool event's trace) or a fresh one — it lands in
+        # the dispatch row and the serve span, so one request is followable
+        # across the telemetry artifacts
+        self.trace_id = trace_id or new_trace_id()
         self.future = RequestFuture()
+        self.future.trace_id = self.trace_id
         self._submit_t = 0.0
 
 
@@ -90,16 +98,19 @@ class InferenceEngine:
                  stream_slots: int = 32,
                  max_delay_ms: float = 2.0,
                  streaming: bool | None = None,
-                 tracer=None, sink=None):
+                 tracer=None, sink=None, bus=None):
         import jax
 
         from ..runner.registry import get_task
         from ..trainer.checkpoint import load_inference_state
         from ..trainer.steps import FederatedTask
 
+        from ..telemetry.bus import NULL_BUS
+
         self.cfg = cfg
         self.tracer = tracer or NULL_TRACER
         self.sink = sink
+        self.bus = bus if bus is not None else NULL_BUS
         self.spec = get_task(cfg.task_id)
         if self.spec.serving is None:
             raise ServingError(
@@ -310,7 +321,7 @@ class InferenceEngine:
         self._infer_lane = Microbatcher(
             self._dispatch_infer, self.row_buckets,
             max_delay_ms=self._max_delay_ms, name="infer",
-            on_dispatch=self._record_dispatch,
+            on_dispatch=self._record_dispatch, bus=self.bus,
         )
         self._stream_lane = None
         if self.streaming:
@@ -319,7 +330,7 @@ class InferenceEngine:
                 rows_of=lambda req: 1,
                 conflict_key=lambda req: req.session,
                 max_delay_ms=self._max_delay_ms, name="stream",
-                on_dispatch=self._record_dispatch,
+                on_dispatch=self._record_dispatch, bus=self.bus,
             )
 
     # -- request path (Compiled executables only) ------------------------
@@ -330,6 +341,7 @@ class InferenceEngine:
                 "kind": "dispatch", "lane": lane, "bucket": int(bucket),
                 "rows": int(rows), "pad_rows": int(bucket - rows),
                 "queue_depth": int(depth),
+                "trace_ids": [r.trace_id for r in batch],
             })
 
     def _finish(self, reqs, lane: str) -> None:
@@ -338,6 +350,12 @@ class InferenceEngine:
             for r in reqs:
                 self._latencies.append((lane, now - r._submit_t))
             self.stats["requests"] += len(reqs)
+        for r in reqs:
+            self.bus.observe(
+                "serving_request_latency_ms", (now - r._submit_t) * 1e3,
+                lane=lane,
+            )
+        self.bus.counter("serving_requests_total", len(reqs), lane=lane)
 
     def _dispatch_infer(self, reqs, bucket: int) -> None:
         """Pack collected requests into the bucket's padded batch and run its
@@ -354,7 +372,8 @@ class InferenceEngine:
             w[at:at + n] = 1.0 if r.weights is None else r.weights
             spans.append((r, at, n))
             at += n
-        with self.tracer.span("serve-infer", bucket=bucket, rows=at):
+        with self.tracer.span("serve-infer", bucket=bucket, rows=at,
+                              trace_ids=[r.trace_id for r in reqs]):
             probs = np.asarray(self._exec[("infer", bucket)](
                 self._params, self._stats, x, w
             ))
@@ -387,7 +406,8 @@ class InferenceEngine:
             x[i, :n] = r.rows
             sv[i, :n] = 1.0 if r.step_valid is None else r.step_valid
             valid[i] = 1.0
-        with self.tracer.span("serve-stream", bucket=bucket, rows=len(reqs)):
+        with self.tracer.span("serve-stream", bucket=bucket, rows=len(reqs),
+                              trace_ids=[r.trace_id for r in reqs]):
             probs, self._table = self._exec[("stream", bucket)](
                 self._params, self._stats, self._table,
                 slot_ix, fresh, x, sv, valid,
@@ -396,18 +416,26 @@ class InferenceEngine:
         for i, r in enumerate(reqs):
             r.future.set_result(
                 {"probs": probs[i], "session": r.session,
-                 "generation": r.generation, "restarted": bool(r.fresh)}
+                 "generation": r.generation, "restarted": bool(r.fresh),
+                 "trace_id": r.trace_id}
             )
         with self._lock:
             self.stats["samples"] += len(reqs)
             self.stats["stream_chunks"] += len(reqs)
+        with self._session_lock:
+            occupied, evictions = self.sessions.occupied, self.sessions.evictions
+        self.bus.gauge("serving_sessions_occupied", occupied)
+        self.bus.gauge("serving_session_evictions", evictions)
         self._finish(reqs, "stream")
 
     # -- public API ------------------------------------------------------
 
-    def submit(self, rows, weights=None):
+    def submit(self, rows, weights=None, trace_id=None):
         """Batched inference: ``rows [n, ...sample_shape]`` → future of
-        ``probs [n, C]``. ``weights`` masks rows (eval semantics)."""
+        ``probs [n, C]``. ``weights`` masks rows (eval semantics);
+        ``trace_id`` propagates a caller's request id into the dispatch
+        row + span (auto-minted when absent; readable on the returned
+        future's ``.trace_id``)."""
         self._ensure_warm()
         rows = np.asarray(rows, np.float32)
         if rows.shape[1:] != self.sample_shape:
@@ -415,16 +443,16 @@ class InferenceEngine:
                 f"request rows shaped {rows.shape[1:]} but task "
                 f"{self.cfg.task_id!r} serves {self.sample_shape}"
             )
-        req = _Req(rows, weights=weights)
+        req = _Req(rows, weights=weights, trace_id=trace_id)
         self._infer_lane.submit(req)
         return req.future
 
-    def stream(self, session_id: str, windows):
+    def stream(self, session_id: str, windows, trace_id=None):
         """Streaming inference: feed ``windows [t, C, W]`` (the session's NEW
         timesteps) and get a future of the classification over everything
         the session has seen. Runs longer than one chunk are split into
-        in-order chunk submissions; the returned future is the LAST chunk's
-        (the full-prefix answer)."""
+        in-order chunk submissions (all sharing one ``trace_id``); the
+        returned future is the LAST chunk's (the full-prefix answer)."""
         self._ensure_warm()
         if not self.streaming:
             raise ServingError(
@@ -446,17 +474,24 @@ class InferenceEngine:
                 "stream() needs at least one window (an empty chunk has "
                 "nothing to advance the session with)"
             )
+        from ..telemetry.tracer import new_trace_id
         from .microbatch import ChainedFuture
 
+        trace_id = trace_id or new_trace_id()
         links = []
         for lo in range(0, len(windows), self.stream_chunk):
-            req = _Req(windows[lo:lo + self.stream_chunk], session=session_id)
+            req = _Req(windows[lo:lo + self.stream_chunk], session=session_id,
+                       trace_id=trace_id)
             self._stream_lane.submit(req)
             links.append(req.future)
         # the chain surfaces ANY chunk's dispatch error — a failed middle
         # chunk must not be masked by a later chunk succeeding on a carry
         # that silently missed its windows
-        return links[0] if len(links) == 1 else ChainedFuture(links)
+        if len(links) == 1:
+            return links[0]
+        chain = ChainedFuture(links)
+        chain.trace_id = trace_id
+        return chain
 
     def close_session(self, session_id: str) -> None:
         with self._session_lock:
@@ -472,7 +507,7 @@ class InferenceEngine:
         deadline = time.monotonic() + timeout
         lanes = [L for L in (self._infer_lane, self._stream_lane) if L]
         while time.monotonic() < deadline:
-            if all(L._q.qsize() == 0 and not L._stash for L in lanes):
+            if all(L.depth() == 0 for L in lanes):
                 return
             time.sleep(0.002)
 
@@ -487,6 +522,47 @@ class InferenceEngine:
         entry compiled a program since warmup."""
         if self._guard is not None:
             self._guard.check(context="serving request path")
+
+    def health_probes(self) -> dict:
+        """Per-subsystem readiness probes for the ``/healthz`` endpoint."""
+        probes = {
+            "warm": lambda: self._warm,
+            "infer_lane": lambda: (
+                self._warm and self._infer_lane._thread.is_alive()
+            ),
+        }
+        if self.streaming:
+            probes["stream_lane"] = lambda: (
+                self._warm and self._stream_lane._thread.is_alive()
+            )
+        return probes
+
+    def status(self) -> dict:
+        """The live ``/statusz`` payload: a cheap subset of
+        :meth:`summary` plus the served checkpoint's provenance (including
+        any ``traces`` the daemon embedded in the checkpoint meta — the
+        serve end of cross-process trace propagation)."""
+        lanes = [
+            L for L in (getattr(self, "_infer_lane", None),
+                        getattr(self, "_stream_lane", None)) if L
+        ]
+        with self._session_lock:
+            occupied = self.sessions.occupied if self.sessions else 0
+        return {
+            "task_id": self.cfg.task_id,
+            "warm": self._warm,
+            "streaming": self.streaming,
+            "requests": self.stats["requests"],
+            "samples": self.stats["samples"],
+            "stream_sessions": occupied,
+            "queue_depth": sum(L.depth() for L in lanes),
+            "deferrals": sum(L.stats["deferrals"] for L in lanes),
+            "compiles_after_warmup": sum(
+                self.compiles_after_warmup().values()
+            ),
+            "checkpoint_epoch": self.meta.get("epoch"),
+            "checkpoint_traces": self.meta.get("traces") or {},
+        }
 
     def summary(self) -> dict:
         with self._lock:
@@ -528,6 +604,8 @@ class InferenceEngine:
             "max_queue_depth": max(
                 (L.stats["max_queue_depth"] for L in lanes), default=0
             ),
+            "deferrals": sum(L.stats["deferrals"] for L in lanes),
+            "checkpoint_traces": self.meta.get("traces") or {},
             "warmup_seconds": self.warmup_seconds,
             "buckets": {
                 "infer": list(self.row_buckets),

@@ -80,7 +80,9 @@ class Microbatcher:
 
     def __init__(self, dispatch, buckets, *, rows_of=None, conflict_key=None,
                  max_delay_ms: float = 2.0, name: str = "lane",
-                 on_dispatch=None):
+                 on_dispatch=None, bus=None):
+        from ..telemetry.bus import NULL_BUS
+
         if not buckets:
             raise ValueError("need at least one shape bucket")
         self.dispatch = dispatch
@@ -90,12 +92,15 @@ class Microbatcher:
         self.max_delay_s = max_delay_ms / 1e3
         self.name = name
         self.on_dispatch = on_dispatch
+        self.bus = bus if bus is not None else NULL_BUS
         self._q: queue.Queue = queue.Queue()
         self._stash: list = []  # conflict-deferred, ahead of the queue
         self._closed = False
+        self._stats_lock = threading.Lock()
         self.stats = {
             "requests": 0, "dispatches": 0, "rows": 0, "pad_rows": 0,
             "bucket_hits": 0, "rejected": 0, "max_queue_depth": 0,
+            "deferrals": 0,
         }
         self._thread = threading.Thread(
             target=self._run, name=f"microbatch-{name}", daemon=True
@@ -128,6 +133,23 @@ class Microbatcher:
             )
         req._submit_t = time.monotonic()
         self._q.put(req)
+        # peak depth must be sampled at ENQUEUE too: sampling only at
+        # dispatch time (the pre-r16 behavior) under-reported any burst that
+        # arrived and drained between two dispatches
+        self._note_depth()
+
+    def depth(self) -> int:
+        """Instantaneous queue depth (queued + stash-deferred requests) —
+        the ONE definition /statusz, drain() and the peak sampler share."""
+        return self._q.qsize() + len(self._stash)
+
+    def _note_depth(self) -> int:
+        depth = self.depth()
+        with self._stats_lock:
+            if depth > self.stats["max_queue_depth"]:
+                self.stats["max_queue_depth"] = depth
+        self.bus.gauge("serving_queue_depth", depth, lane=self.name)
+        return depth
 
     # -- dispatch thread -------------------------------------------------
 
@@ -164,14 +186,21 @@ class Microbatcher:
                 k = self.conflict_key(nxt)
                 if k in keys:
                     self._stash.append(nxt)  # same session: next dispatch
+                    self._note_deferral("conflict")
                     continue
                 keys.add(k)
             if rows + self.rows_of(nxt) > self.max_rows:
                 self._stash.append(nxt)  # doesn't fit: keep order, defer
+                self._note_deferral("overflow")
                 break
             batch.append(nxt)
             rows += self.rows_of(nxt)
         return batch
+
+    def _note_deferral(self, why: str) -> None:
+        with self._stats_lock:
+            self.stats["deferrals"] += 1
+        self.bus.counter("serving_deferrals_total", lane=self.name, why=why)
 
     def _run(self) -> None:
         while True:
@@ -188,16 +217,18 @@ class Microbatcher:
             rows = sum(self.rows_of(r) for r in batch)
             try:
                 bucket = self.bucket_for(rows)
-                depth = self._q.qsize() + len(self._stash)
-                self.stats["max_queue_depth"] = max(
-                    self.stats["max_queue_depth"], depth
-                )
+                depth = self._note_depth()
                 self.dispatch(batch, bucket)
                 self.stats["requests"] += len(batch)
                 self.stats["dispatches"] += 1
                 self.stats["rows"] += rows
                 self.stats["pad_rows"] += bucket - rows
                 self.stats["bucket_hits"] += int(rows == bucket)
+                self.bus.counter("serving_dispatches_total", lane=self.name)
+                self.bus.observe(
+                    "serving_batch_occupancy_pct", 100.0 * rows / bucket,
+                    lane=self.name,
+                )
                 if self.on_dispatch is not None:
                     self.on_dispatch(self.name, batch, bucket, rows, depth)
             except Exception as e:

@@ -28,18 +28,42 @@ scripts/profile_epoch.py's one-off attribution. Now:
   renders a run summary (phase time table, per-site rollup, compile/transfer
   counters) from those artifacts.
 
+The LIVE plane (r16) — everything above is post-hoc; these answer questions
+about a RUNNING process:
+
+- :mod:`.hist` — fixed log-spaced mergeable latency histograms (exact merge
+  associativity, bounded-error p50/p95/p99).
+- :mod:`.bus` — the process-wide MetricsBus of named counters/gauges/
+  histograms (snapshot-consistent reads; :data:`~.bus.NULL_BUS` keeps the
+  off path free).
+- :mod:`.exporter` — stdlib HTTP endpoints ``/metrics`` (Prometheus text),
+  ``/healthz``, ``/statusz`` (incl. SLO error-budget burn), ``/tracez``,
+  behind ``--statusz-port`` on the daemon and serving CLIs.
+- :mod:`.flight` — the crash-safe flight recorder: a bounded ring of recent
+  spans/events that dumps ``flight_<pid>.json`` (with a final bus snapshot)
+  on unhandled exception or SIGTERM.
+
 Distinct from ``DINUNET_SANITIZE`` (checks/sanitize.py): the sanitizer is a
 debug mode that FAILS a run violating invariants; telemetry OBSERVES healthy
 runs and writes artifacts. They compose — the sanitizer's compile counter is
 one of the counters telemetry exports.
 """
 
-from .tracer import NULL_TRACER, SpanTracer, duration
+from .bus import NULL_BUS, MetricsBus, global_bus
+from .hist import LogHistogram
+from .tracer import NULL_TRACER, SpanTracer, duration, new_trace_id
 
 __all__ = [
     "NULL_TRACER",
     "SpanTracer",
     "duration",
+    "new_trace_id",
+    "LogHistogram",
+    "MetricsBus",
+    "NULL_BUS",
+    "global_bus",
+    "StatusExporter",
+    "FlightRecorder",
     "FitTelemetry",
     "default_round_telemetry",
     "payload_bytes_of",
@@ -68,4 +92,12 @@ def __getattr__(name):
         from . import xprof
 
         return getattr(xprof, name)
+    if name == "StatusExporter":
+        from .exporter import StatusExporter
+
+        return StatusExporter
+    if name == "FlightRecorder":
+        from .flight import FlightRecorder
+
+        return FlightRecorder
     raise AttributeError(name)

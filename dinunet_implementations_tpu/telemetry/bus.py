@@ -1,0 +1,170 @@
+"""MetricsBus — the process-wide live-metrics registry.
+
+Everything post-hoc telemetry writes to files (metrics.jsonl, trace.jsonl),
+the bus holds LIVE: named counters, gauges and :class:`~.hist.LogHistogram`
+latency histograms that the trainer loop, the daemon's serve loop, the
+serving microbatcher and the session table publish into as they run, and
+that the ``/metrics`` / ``/statusz`` exporter (exporter.py) and the flight
+recorder (flight.py) read out.
+
+Contract:
+
+- **Publishing is host-side bookkeeping only.** Every value published comes
+  from data the caller already holds on the host (an epoch loss that was
+  already fetched, a queue length, a wall-clock delta) — publishing never
+  forces a device sync and never touches a traced program, so the bus's
+  existence cannot perturb the compiled epoch (the S005 lowering-identity
+  gate keeps proving it).
+- **Snapshot-consistent reads.** :meth:`snapshot` copies the whole registry
+  under ONE lock acquisition: a scrape never sees counter A from before a
+  dispatch and gauge B from after it.
+- **A NULL bus, not None-checks.** :data:`NULL_BUS` is a disabled instance
+  whose methods return immediately — call sites thread a bus object
+  unconditionally, exactly like :data:`~.tracer.NULL_TRACER`.
+- **Series names are literals** (jaxlint R007 covers ``counter``/``gauge``/
+  ``observe`` names); the variable part goes in label kwargs
+  (``bus.counter("serving_requests_total", lane="infer")``).
+
+One process-wide default lives behind :func:`global_bus` — the daemon CLI
+and serving CLI publish and scrape through it; tests build private
+instances.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .hist import DEFAULT_HI, DEFAULT_LO, DEFAULT_PER_DECADE, LogHistogram
+
+
+def _escape_label(value) -> str:
+    """Prometheus text-format label-value escaping (backslash, quote,
+    newline). Applied when the series key is BUILT, so arbitrary label
+    values — a site name with a quote in it — can never corrupt the
+    /metrics exposition (or tear the key apart in a snapshot)."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def series_key(name: str, labels: dict) -> str:
+    """The rendered series identity: ``name`` or ``name{k="v",...}`` with
+    labels sorted — the same (name, labels) always lands on the same key."""
+    if not labels:
+        return name
+    inner = ",".join(
+        f'{k}="{_escape_label(v)}"' for k, v in sorted(labels.items())
+    )
+    return f"{name}{{{inner}}}"
+
+
+class MetricsBus:
+    """See module docstring."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = {}
+        self._gauges: dict[str, object] = {}
+        self._hists: dict[str, LogHistogram] = {}
+
+    # -- publishing -------------------------------------------------------
+
+    def counter(self, name: str, n=1, **labels) -> None:
+        """Monotonic counter increment (``*_total`` naming convention)."""
+        if not self.enabled:
+            return
+        key = series_key(name, labels)
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + n
+
+    def gauge(self, name: str, value, **labels) -> None:
+        """Point-in-time value (queue depth, current epoch, occupancy)."""
+        if not self.enabled:
+            return
+        key = series_key(name, labels)
+        with self._lock:
+            self._gauges[key] = value
+
+    def clear_gauge(self, name: str, **labels) -> None:
+        """Drop a gauge series (a member left; its liveness gauge must not
+        linger at its last value)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._gauges.pop(series_key(name, labels), None)
+
+    def observe(self, name: str, value, *, lo: float = DEFAULT_LO,
+                hi: float = DEFAULT_HI,
+                per_decade: int = DEFAULT_PER_DECADE, **labels) -> None:
+        """One sample into the named log-histogram (created on first use
+        with the given shape; conventional unit: milliseconds)."""
+        if not self.enabled:
+            return
+        key = series_key(name, labels)
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = LogHistogram(lo, hi, per_decade)
+            h.record(value)
+
+    # -- reading ----------------------------------------------------------
+
+    def histogram(self, name: str, **labels) -> LogHistogram | None:
+        """A COPY of the named histogram (merge-safe to aggregate further),
+        or ``None`` when nothing has been observed into it."""
+        with self._lock:
+            h = self._hists.get(series_key(name, labels))
+            return h.copy() if h is not None else None
+
+    def merged_histogram(self, name: str) -> LogHistogram | None:
+        """All label variants of ``name`` merged into one histogram — the
+        cross-lane/cross-process rollup the SLO burn reads (merge order is
+        irrelevant by the hist's associativity guarantee)."""
+        with self._lock:
+            parts = [
+                h for key, h in self._hists.items()
+                if key == name or key.startswith(name + "{")
+            ]
+            if not parts:
+                return None
+            out = LogHistogram(
+                parts[0].lo, parts[0].hi, parts[0].per_decade
+            )
+            for h in parts:
+                out.merge(h)
+            return out
+
+    def snapshot(self) -> dict:
+        """Consistent point-in-time copy of every series, JSON-able:
+        ``{"counters": {...}, "gauges": {...}, "histograms": {key:
+        hist.to_dict()}}``."""
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {
+                    k: h.to_dict() for k, h in self._hists.items()
+                },
+            }
+
+    def reset(self) -> None:
+        """Drop every series (tests; a bench excluding warmup)."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+
+#: shared disabled instance — thread it where live metrics are off
+NULL_BUS = MetricsBus(enabled=False)
+
+#: the process-wide bus the CLIs publish and scrape through
+_GLOBAL_BUS = MetricsBus()
+
+
+def global_bus() -> MetricsBus:
+    return _GLOBAL_BUS

@@ -1,0 +1,197 @@
+"""Flight recorder — a crash-safe ring of the process's final seconds.
+
+A daemon that dies (unhandled exception, SIGTERM from a preempting
+scheduler, OOM-killer near-miss) used to leave nothing but whatever
+metrics.jsonl rows already flushed; the operator reconstructs its last
+moments from guesswork. The :class:`FlightRecorder` keeps a BOUNDED
+in-memory ring of the most recent spans/events (fed live by the span
+tracer via its listener hook) plus its own notes (epoch ticks, holds,
+ingest results), and on the way down writes one ``flight_<pid>.json``
+containing:
+
+- the ring (the last N spans/events, in order),
+- a final MetricsBus snapshot (counters/gauges/histograms at death),
+- reason, pid, argv, uptime, wall-clock timestamp.
+
+Dump triggers:
+
+- **cooperative** — the daemon's serve loop calls :meth:`dump` when its
+  PreemptionGuard latches SIGTERM/SIGINT (the guard owns the signal
+  handlers there; the recorder must not fight it);
+- **installed** — :meth:`install` chains ``sys.excepthook`` (and, where no
+  guard owns them, SIGTERM) so a crash anywhere still dumps. Previous
+  hooks/handlers are preserved and called after the dump.
+
+Dumps are atomic (tmp + rename), append a sequence suffix rather than
+overwrite (a crash DURING shutdown keeps both dumps), and never raise —
+a broken disk at crash time must not mask the original exception.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from collections import deque
+
+FLIGHT_PREFIX = "flight_"
+
+
+def flight_files(dirpath: str) -> list[str]:
+    """Recorded dumps under ``dirpath``, oldest first."""
+    try:
+        names = sorted(
+            n for n in os.listdir(dirpath)
+            if n.startswith(FLIGHT_PREFIX) and n.endswith(".json")
+        )
+    except OSError:
+        return []
+    return [os.path.join(dirpath, n) for n in names]
+
+
+class FlightRecorder:
+    """See module docstring."""
+
+    def __init__(self, out_dir: str = ".", *, capacity: int = 512,
+                 bus=None, tracer=None):
+        self.out_dir = out_dir
+        self.bus = bus
+        self._ring: deque = deque(maxlen=max(int(capacity), 1))
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self._seq = 0
+        self._installed = False
+        self._prev_excepthook = None
+        self._prev_handlers: dict = {}
+        self.dumps: list[str] = []  # paths written this process
+        if tracer is not None:
+            self.listen(tracer)
+
+    # -- feeding the ring -------------------------------------------------
+
+    def record(self, event: dict) -> None:
+        """One event into the ring (the tracer listener's target)."""
+        with self._lock:
+            self._ring.append(event)
+
+    def listen(self, tracer) -> None:
+        """Mirror every span/event/counter the tracer records into the
+        ring (bounded — the tracer's own buffer is the complete record,
+        the ring is the tail). A disabled tracer (the shared NULL_TRACER)
+        never records, so attaching to it would only pin this recorder on
+        a process-global listener list forever — skip it."""
+        if tracer.enabled:
+            tracer.add_listener(self.record)
+
+    def note(self, name: str, **attrs) -> None:
+        """A recorder-local instant event — the daemon's serve loop notes
+        epoch ticks/holds/ingests here so the ring has content even when
+        telemetry (and thus the tracer) is off."""
+        self.record({
+            "ph": "i", "name": name,
+            "ts": round((time.monotonic() - self._t0) * 1e6, 1),
+            "src": "flight", **attrs,
+        })
+
+    def recent(self, limit: int = 256) -> list[dict]:
+        """The newest ``limit`` ring events, oldest first (the ``/tracez``
+        payload)."""
+        with self._lock:
+            events = list(self._ring)
+        return events[-limit:]
+
+    # -- dumping ----------------------------------------------------------
+
+    def dump(self, reason: str) -> str | None:
+        """Write ``flight_<pid>[_<seq>].json``; returns the path, or None
+        when even best-effort writing failed. Never raises."""
+        try:
+            with self._lock:
+                events = list(self._ring)
+                self._seq += 1
+                seq = self._seq
+            payload = {
+                "reason": reason,
+                "pid": os.getpid(),
+                "argv": list(sys.argv),
+                "time_unix": time.time(),
+                "uptime_s": round(time.monotonic() - self._t0, 3),
+                "events": events,
+                "bus": self.bus.snapshot() if self.bus is not None else None,
+            }
+            name = (
+                f"{FLIGHT_PREFIX}{os.getpid()}.json" if seq == 1
+                else f"{FLIGHT_PREFIX}{os.getpid()}_{seq}.json"
+            )
+            os.makedirs(self.out_dir, exist_ok=True)
+            path = os.path.join(self.out_dir, name)
+            tmp = path + ".tmp"
+            from .sink import _finite  # strict-JSON: non-finite -> null
+
+            with open(tmp, "w") as fh:
+                json.dump(
+                    _finite(payload), fh, default=str, allow_nan=False
+                )
+            os.replace(tmp, path)
+            self.dumps.append(path)
+            return path
+        except Exception:
+            # the recorder must never mask the original failure
+            return None
+
+    # -- crash hooks -------------------------------------------------------
+
+    def install(self, signals=(signal.SIGTERM,)) -> None:
+        """Chain the dump into ``sys.excepthook`` and the given signals.
+        Signal chaining: after dumping, the PREVIOUS handler runs (or the
+        default disposition is restored and the signal re-raised, so a
+        plain SIGTERM still terminates). Skip signal installation wherever
+        a PreemptionGuard owns the handlers — pass ``signals=()`` and dump
+        cooperatively instead."""
+        if self._installed:
+            return
+        self._installed = True
+        self._prev_excepthook = sys.excepthook
+
+        def excepthook(exc_type, exc, tb):
+            self.note("unhandled-exception", error=repr(exc))
+            self.dump(f"crash:{exc_type.__name__}")
+            (self._prev_excepthook or sys.__excepthook__)(exc_type, exc, tb)
+
+        sys.excepthook = excepthook
+
+        def handler(signum, frame):
+            self.note("signal", signum=signum)
+            self.dump(f"signal:{signum}")
+            prev = self._prev_handlers.get(signum)
+            if callable(prev):
+                prev(signum, frame)
+            else:
+                # restore the default disposition and re-deliver, so the
+                # process still dies of the signal it was sent
+                signal.signal(signum, signal.SIG_DFL)
+                os.kill(os.getpid(), signum)
+
+        try:
+            for s in signals:
+                self._prev_handlers[s] = signal.signal(s, handler)
+        except ValueError:
+            # not the main thread: excepthook-only
+            self._prev_handlers = {}
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        self._installed = False
+        if self._prev_excepthook is not None:
+            sys.excepthook = self._prev_excepthook
+            self._prev_excepthook = None
+        for s, h in self._prev_handlers.items():
+            try:
+                signal.signal(s, h)
+            except (ValueError, TypeError):
+                pass
+        self._prev_handlers = {}

@@ -38,9 +38,24 @@ def duration(cache: dict, start: float, key: str):
     """Append elapsed seconds since ``start`` to ``cache[key]`` (reference
     ``coinstac_dinunet.utils.duration``, used at ``local.py:51-52``). The ONE
     reference-keyed duration-list helper — formerly trainer/logs.py, moved
-    here so every timing helper lives with the tracer."""
-    cache.setdefault(key, []).append(time.time() - start)
+    here so every timing helper lives with the tracer.
+
+    ``start`` MUST come from ``time.perf_counter()`` — the tracer's one
+    monotonic clock. (This helper read ``time.time()`` until r16 while every
+    span used ``perf_counter``: an NTP step or DST jump mid-fit corrupted
+    the checkpointed duration bookkeeping with negative or wildly wrong
+    entries that a resume then carried forward.)"""
+    cache.setdefault(key, []).append(time.perf_counter() - start)
     return cache[key][-1]
+
+
+def new_trace_id() -> str:
+    """A fresh 16-hex-char trace/request id for cross-process propagation:
+    spool membership events, serving requests and checkpoint metadata carry
+    these so one sample is followable from spool ingest through round
+    aggregation and checkpoint publish to serve (dispatch rows + spans
+    record them as ``trace_ids``)."""
+    return os.urandom(8).hex()
 
 
 class SpanTracer:
@@ -55,6 +70,7 @@ class SpanTracer:
         self.enabled = enabled
         self._lock = threading.Lock()
         self._events: list[dict] = []
+        self._listeners: list = []
         self._local = threading.local()
         self._t0 = time.perf_counter()
 
@@ -69,6 +85,17 @@ class SpanTracer:
     def _record(self, ev: dict) -> None:
         with self._lock:
             self._events.append(ev)
+            listeners = tuple(self._listeners)
+        for fn in listeners:
+            fn(ev)
+
+    def add_listener(self, fn) -> None:
+        """Mirror every recorded event into ``fn(event_dict)`` — the flight
+        recorder's bounded ring feeds from here. Listeners run outside the
+        tracer lock and must not raise; on a disabled tracer nothing is ever
+        recorded, so nothing is ever delivered."""
+        with self._lock:
+            self._listeners.append(fn)
 
     @contextmanager
     def span(self, name: str, **attrs):

@@ -69,7 +69,7 @@ from .steps import (
 
 class FederatedTrainer:
     def __init__(self, cfg: TrainConfig, model, mesh=None, out_dir: str | None = None,
-                 fault_plan=None):
+                 fault_plan=None, bus=None):
         """``mesh=None`` folds all sites onto the local device via vmap (one
         chip simulating N sites); a mesh with a ``site`` axis runs the sites
         across its members — one per device slice, or PACKED ``K = S /
@@ -121,6 +121,18 @@ class FederatedTrainer:
                 "active trace"
             )
         self.tracer = SpanTracer() if self._telemetry_on else NULL_TRACER
+        # live metrics (telemetry/bus.py): published into the process-wide
+        # bus when telemetry is on (the /statusz exporter's read side), the
+        # NULL bus otherwise. Publishing is host-side bookkeeping over
+        # values the loop already fetched — it never adds a device sync and
+        # never touches the traced program (bus=NULL keeps the epoch
+        # program bitwise-identical; the S005 identity gate covers it).
+        if bus is not None:
+            self.bus = bus
+        else:
+            from ..telemetry.bus import NULL_BUS, global_bus
+
+            self.bus = global_bus() if self._telemetry_on else NULL_BUS
         self.epoch_fn = make_train_epoch_fn(
             self.task, self.engine, self.optimizer, mesh, cfg.local_iterations,
             rounds_scan_xs=cfg.rounds_scan_xs,
@@ -486,7 +498,10 @@ class FederatedTrainer:
         resume: bool = False,
     ) -> dict:
         cfg = self.cfg
-        t_start = time.time()
+        # monotonic clock for every duration (the tracer's clock): wall
+        # time can step (NTP, DST) mid-fit and corrupt the checkpointed
+        # duration bookkeeping
+        t_start = time.perf_counter()
         self._num_sites = len(train_sites)
         if self.mesh is not None:
             from ..parallel.mesh import pack_factor
@@ -632,7 +647,7 @@ class FederatedTrainer:
             self._cache["cumulative_total_duration"] = cum
             # continue the cumulative wall-clock line from its stored total
             if cum:
-                t_start = time.time() - cum[-1]
+                t_start = time.perf_counter() - cum[-1]
             # snapshot either way: a load falling back to template leaves
             # (engine-structure change) would otherwise alias `state`
             best_state = self._snapshot(
@@ -691,7 +706,7 @@ class FederatedTrainer:
         try:
             with guard:
                 for epoch in range(start_epoch, cfg.epochs + 1):
-                    e_start = time.time()
+                    e_start = time.perf_counter()
                     if xprof is not None:
                         xprof.epoch_begin(epoch)
                     with self.tracer.span("epoch", epoch=epoch):
@@ -714,7 +729,15 @@ class FederatedTrainer:
                     # exist; the truthful equivalent is the epoch time amortized over
                     # its rounds.
                     rounds = max(len(losses), 1)
-                    iter_durations.extend([(time.time() - e_start) / rounds] * rounds)
+                    e_seconds = time.perf_counter() - e_start
+                    iter_durations.extend([e_seconds / rounds] * rounds)
+                    # live metrics: values already on the host (losses were
+                    # fetched above) — no extra device sync
+                    self.bus.gauge("train_epoch", epoch)
+                    self.bus.gauge("train_loss", epoch_loss)
+                    self.bus.counter("train_epochs_total")
+                    self.bus.counter("train_rounds_total", rounds)
+                    self.bus.observe("epoch_ms", e_seconds * 1e3)
                     if self._fit_tel is not None:
                         self._epoch_row(fold, epoch, epoch_loss, e_start,
                                         state)
@@ -955,7 +978,7 @@ class FederatedTrainer:
         row = {
             "kind": "epoch", "fold": fold, "epoch": epoch,
             "train_loss": epoch_loss,
-            "epoch_seconds": round(time.time() - e_start, 6),
+            "epoch_seconds": round(time.perf_counter() - e_start, 6),
             "transfer_bytes": self._last_transfer_bytes,
         }
         t = (

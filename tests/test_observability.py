@@ -1,0 +1,682 @@
+"""Live observability plane tests (r16): mergeable log-histograms, the
+MetricsBus, the /metrics /healthz /statusz /tracez exporter, the flight
+recorder, microbatch queue-depth sampling, and cross-process trace
+propagation (spool ingest → checkpoint publish → serve).
+"""
+
+import json
+import math
+import os
+import random
+import re
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dinunet_implementations_tpu import TrainConfig
+from dinunet_implementations_tpu.core.config import FSArgs
+from dinunet_implementations_tpu.data.demo import make_fs_demo_tree
+from dinunet_implementations_tpu.runner.fed_runner import FedDaemon
+from dinunet_implementations_tpu.telemetry.bus import (
+    NULL_BUS,
+    MetricsBus,
+    series_key,
+)
+from dinunet_implementations_tpu.telemetry.exporter import (
+    StatusExporter,
+    render_prometheus,
+    slo_burn,
+)
+from dinunet_implementations_tpu.telemetry.flight import (
+    FlightRecorder,
+    flight_files,
+)
+from dinunet_implementations_tpu.telemetry.hist import (
+    HistogramShapeError,
+    LogHistogram,
+    bucket_bounds,
+)
+from dinunet_implementations_tpu.telemetry.tracer import (
+    SpanTracer,
+    new_trace_id,
+)
+
+# ---------------------------------------------------------------------------
+# LogHistogram
+# ---------------------------------------------------------------------------
+
+
+def test_hist_bounds_shared_and_validated():
+    a, b = LogHistogram(), LogHistogram()
+    assert a.bounds is b.bounds  # one cached tuple per shape
+    assert bucket_bounds(1.0, 1000.0, 2) == pytest.approx(
+        (1.0, 10 ** 0.5, 10.0, 10 ** 1.5, 100.0, 10 ** 2.5, 1000.0)
+    )
+    with pytest.raises(ValueError):
+        LogHistogram(lo=0.0)
+    with pytest.raises(ValueError):
+        LogHistogram(lo=10.0, hi=1.0)
+    with pytest.raises(ValueError):
+        LogHistogram(per_decade=0)
+
+
+def test_hist_quantile_bound_guarantee():
+    """quantile(q) never understates the true empirical quantile and
+    overstates it by at most one bucket ratio (10**(1/per_decade)) for
+    in-range samples — the SLO math's conservative direction."""
+    rng = random.Random(7)
+    h = LogHistogram()
+    vals = [rng.lognormvariate(1.0, 2.0) for _ in range(2000)]
+    for v in vals:
+        h.record(v)
+    ranked = sorted(vals)
+    growth = 10 ** (1 / h.per_decade)
+    for q in (0.01, 0.25, 0.5, 0.9, 0.95, 0.99, 1.0):
+        true = ranked[max(math.ceil(q * len(ranked)), 1) - 1]
+        est = h.quantile(q)
+        assert true <= est <= true * growth * (1 + 1e-12), (q, true, est)
+    assert h.count == len(vals)
+    assert h.min == min(vals) and h.max == max(vals)
+    assert h.mean() == pytest.approx(sum(vals) / len(vals))
+
+
+def test_hist_merge_associativity_exact():
+    """Merging is exactly associative on the quantile-determining state:
+    any merge tree over the same shards lands on identical counts, count,
+    min/max — and therefore identical quantiles."""
+    rng = random.Random(11)
+    shards = [LogHistogram() for _ in range(3)]
+    whole = LogHistogram()
+    for i in range(999):
+        v = rng.lognormvariate(0.0, 3.0)
+        shards[i % 3].record(v)
+        whole.record(v)
+    a, b, c = shards
+    left = a.copy().merge(b).merge(c)          # (a + b) + c
+    right = a.copy().merge(b.copy().merge(c))  # a + (b + c)
+    assert left.counts == right.counts == whole.counts
+    assert left.count == right.count == whole.count
+    assert left.min == right.min == whole.min
+    assert left.max == right.max == whole.max
+    for q in (0.5, 0.95, 0.99):
+        assert left.quantile(q) == right.quantile(q) == whole.quantile(q)
+    # merged() is non-destructive
+    keep = a.count
+    m = a.merged(b)
+    assert a.count == keep and m.count == a.count + b.count
+    with pytest.raises(HistogramShapeError):
+        a.merge(LogHistogram(per_decade=3))
+
+
+def test_hist_out_of_range_and_serialization():
+    h = LogHistogram(lo=1.0, hi=100.0, per_decade=1)
+    for v in (1e-9, 0.5, 5.0, 1e6):
+        h.record(v)
+    h.record(float("nan"))  # dropped: carries no rank information
+    assert h.count == 4
+    assert h.quantile(0.25) == 1.0     # underflow reports the lo edge
+    assert h.quantile(1.0) == 1e6      # overflow reports the observed max
+    d = json.loads(json.dumps(h.to_dict()))
+    h2 = LogHistogram.from_dict(d)
+    assert h2.counts == h.counts and h2.count == h.count
+    assert h2.quantile(0.5) == h.quantile(0.5)
+    assert h2.min == h.min and h2.max == h.max
+    # cumulative exposition: monotone, ends at (+Inf, count)
+    cum = h.cumulative()
+    assert [c for _, c in cum] == sorted(c for _, c in cum)
+    assert cum[-1][0] == math.inf and cum[-1][1] == h.count
+
+
+# ---------------------------------------------------------------------------
+# MetricsBus
+# ---------------------------------------------------------------------------
+
+
+def test_bus_series_and_snapshot_consistency():
+    bus = MetricsBus()
+    bus.counter("requests_total", 2, lane="infer")
+    bus.counter("requests_total", lane="infer")
+    bus.counter("requests_total", lane="stream")
+    bus.gauge("epoch", 4)
+    bus.observe("latency_ms", 10.0, lane="infer")
+    bus.observe("latency_ms", 20.0, lane="stream")
+    snap = bus.snapshot()
+    assert snap["counters"][series_key("requests_total", {"lane": "infer"})] == 3
+    assert snap["gauges"]["epoch"] == 4
+    # snapshot is a copy: later publishes don't mutate it
+    bus.gauge("epoch", 5)
+    assert snap["gauges"]["epoch"] == 4
+    # merged histogram rolls all label variants up (associative, so order
+    # is irrelevant)
+    merged = bus.merged_histogram("latency_ms")
+    assert merged.count == 2
+    assert bus.histogram("latency_ms", lane="infer").count == 1
+    assert bus.histogram("latency_ms", lane="missing") is None
+    bus.clear_gauge("epoch")
+    assert "epoch" not in bus.snapshot()["gauges"]
+
+
+def test_bus_snapshot_consistent_under_concurrent_writers():
+    """A reader never sees a torn registry: writers bump two counters in
+    lockstep; every snapshot must see them equal (both reads happen under
+    the one snapshot lock)."""
+    bus = MetricsBus()
+    stop = threading.Event()
+
+    def writer():
+        while not stop.is_set():
+            # lockstep: one call increments both series atomically from the
+            # snapshot's point of view only if snapshot is lock-consistent
+            with bus._lock:
+                bus._counters["a_total"] = bus._counters.get("a_total", 0) + 1
+                bus._counters["b_total"] = bus._counters.get("b_total", 0) + 1
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        for _ in range(200):
+            snap = bus.snapshot()
+            assert snap["counters"].get("a_total", 0) == \
+                snap["counters"].get("b_total", 0)
+    finally:
+        stop.set()
+        t.join(5)
+
+
+def test_label_values_escaped_in_series_and_exposition():
+    """Arbitrary label values (a site name with quotes/backslashes/newlines
+    — spool events are operator input) must not corrupt the series key or
+    the /metrics exposition."""
+    bus = MetricsBus()
+    bus.gauge("serve_member_generation", 2, site='lab"1\\x\n')
+    key = series_key("serve_member_generation", {"site": 'lab"1\\x\n'})
+    assert bus.snapshot()["gauges"][key] == 2
+    text = render_prometheus(bus.snapshot())
+    _assert_valid_exposition(text)
+    assert 'site="lab\\"1\\\\x\\n"' in text
+
+
+def test_null_bus_is_inert():
+    NULL_BUS.counter("x_total")
+    NULL_BUS.gauge("g", 1)
+    NULL_BUS.observe("h_ms", 1.0)
+    snap = NULL_BUS.snapshot()
+    assert snap == {"counters": {}, "gauges": {}, "histograms": {}}
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition + SLO burn
+# ---------------------------------------------------------------------------
+
+#: exposition-format line shapes (text format 0.0.4)
+_TYPE_RE = re.compile(r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
+                      r"(counter|gauge|histogram)$")
+_LABEL_VAL = r'"(?:[^"\\]|\\.)*"'  # escaped \" \\ \n allowed inside
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"                        # metric name
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=" + _LABEL_VAL +        # first label
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=" + _LABEL_VAL + r")*\})? "  # more labels
+    r"(-?\d+(\.\d+)?([eE][+-]?\d+)?|\+Inf|-Inf|NaN)$"   # value
+)
+
+
+def _assert_valid_exposition(text: str) -> None:
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if not line:
+            continue
+        assert _TYPE_RE.match(line) or _SAMPLE_RE.match(line), line
+
+
+def test_prometheus_exposition_valid():
+    bus = MetricsBus()
+    bus.counter("serving_requests_total", 5, lane="infer")
+    bus.gauge("serve_epoch", 12)
+    bus.gauge("weird name-with.chars", 1.5)
+    for v in (0.5, 3.0, 3.0, 2e6):  # incl. one overflow sample
+        bus.observe("request_latency_ms", v)
+    text = render_prometheus(bus.snapshot())
+    _assert_valid_exposition(text)
+    assert 'dinunet_serving_requests_total{lane="infer"} 5' in text
+    assert "dinunet_serve_epoch 12" in text
+    assert "dinunet_weird_name_with_chars 1.5" in text  # sanitized
+    # histogram contract: le-labeled cumulative buckets, monotone, the +Inf
+    # bucket equals _count, and _sum is present
+    buckets = [
+        ln for ln in text.splitlines()
+        if ln.startswith("dinunet_request_latency_ms_bucket")
+    ]
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in buckets]
+    assert counts == sorted(counts)
+    assert buckets[-1].startswith(
+        'dinunet_request_latency_ms_bucket{le="+Inf"}'
+    )
+    assert counts[-1] == 4
+    assert "dinunet_request_latency_ms_count 4" in text
+    assert any(
+        ln.startswith("dinunet_request_latency_ms_sum")
+        for ln in text.splitlines()
+    )
+
+
+def test_slo_burn_math():
+    h = LogHistogram()
+    for _ in range(990):
+        h.record(10.0)   # well under target
+    for _ in range(10):
+        h.record(5000.0)  # violations
+    burn = slo_burn(h, p99_target=100.0)
+    assert burn["samples"] == 1000 and burn["violations"] == 10
+    assert burn["violation_rate"] == pytest.approx(0.01)
+    assert burn["burn"] == pytest.approx(1.0)  # exactly at budget
+    # conservative: a bucket straddling the target never counts
+    assert slo_burn(h, p99_target=5000.0)["violations"] == 0
+    empty = slo_burn(LogHistogram(), p99_target=100.0)
+    assert empty["burn"] is None and empty["samples"] == 0
+    assert slo_burn(None, p99_target=100.0)["burn"] is None
+
+
+# ---------------------------------------------------------------------------
+# exporter endpoints
+# ---------------------------------------------------------------------------
+
+
+def _get(url: str):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_exporter_endpoints_live():
+    bus = MetricsBus()
+    bus.gauge("serve_epoch", 3)
+    for v in (10.0, 20.0, 9000.0):
+        bus.observe("serve_epoch_ms", v)
+    tracer = SpanTracer()
+    flight = FlightRecorder("/tmp/unused-obs", bus=bus, tracer=tracer)
+    with tracer.span("epoch", epoch=3):
+        pass
+    ready = {"state": True}
+    ex = StatusExporter(
+        bus, port=0, tracer=tracer, flight=flight,
+        health={"state": lambda: ready["state"],
+                "broken": lambda: 1 / 0},
+        statusz=lambda: {"round": 3},
+        slo={"histogram": "serve_epoch_ms", "p99_target_ms": 100.0},
+    )
+    with ex:
+        port = ex.port
+        assert port > 0
+        code, text = _get(f"http://127.0.0.1:{port}/metrics")
+        assert code == 200
+        _assert_valid_exposition(text)
+        assert "dinunet_serve_epoch 3" in text
+        # /healthz: the broken probe's error is a per-subsystem finding
+        code, text = _get(f"http://127.0.0.1:{port}/healthz")
+        payload = json.loads(text)
+        assert code == 503 and payload["status"] == "unavailable"
+        assert payload["subsystems"]["state"]["ready"]
+        assert not payload["subsystems"]["broken"]["ready"]
+        assert "division" in payload["subsystems"]["broken"]["error"]
+        # /statusz: SLO burn from the real histogram + the caller's status
+        code, text = _get(f"http://127.0.0.1:{port}/statusz")
+        payload = json.loads(text)
+        assert code == 200
+        assert payload["status"]["round"] == 3
+        assert payload["slo"]["samples"] == 3
+        assert payload["slo"]["violations"] == 1  # the 9000ms epoch
+        assert payload["slo"]["burn"] == pytest.approx(
+            (1 / 3) / 0.01, rel=1e-3
+        )
+        assert payload["metrics"]["gauges"]["serve_epoch"] == 3
+        # /tracez: the span is visible without waiting for trace.jsonl
+        code, text = _get(f"http://127.0.0.1:{port}/tracez")
+        payload = json.loads(text)
+        assert code == 200 and payload["count"] >= 1
+        assert any(e.get("name") == "epoch" for e in payload["recent"])
+        code, _ = _get(f"http://127.0.0.1:{port}/nope")
+        assert code == 404
+    # stopped: connections refused
+    with pytest.raises(urllib.error.URLError):
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/statusz", timeout=1
+        )
+
+
+def test_exporter_healthz_all_ready():
+    ex = StatusExporter(MetricsBus(), health={"a": lambda: True})
+    code, payload = ex.healthz()
+    assert code == 200 and payload["status"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_ring_bounded_and_dump(tmp_path):
+    bus = MetricsBus()
+    bus.gauge("serve_epoch", 9)
+    tracer = SpanTracer()
+    flight = FlightRecorder(
+        str(tmp_path), capacity=8, bus=bus, tracer=tracer
+    )
+    for i in range(50):
+        with tracer.span("epoch", epoch=i):
+            pass
+    assert len(flight.recent(100)) == 8  # bounded ring, newest kept
+    assert flight.recent(100)[-1]["epoch"] == 49
+    flight.note("round-hold", occupied=0)
+    path = flight.dump("signal:15")
+    assert path is not None and os.path.exists(path)
+    with open(path) as fh:
+        payload = json.load(fh)
+    assert payload["reason"] == "signal:15"
+    assert payload["pid"] == os.getpid()
+    names = [e["name"] for e in payload["events"]]
+    assert "epoch" in names and "round-hold" in names
+    assert payload["bus"]["gauges"]["serve_epoch"] == 9
+    # a second dump doesn't clobber the first (crash during shutdown)
+    path2 = flight.dump("crash:RuntimeError")
+    assert path2 != path and os.path.exists(path) and os.path.exists(path2)
+    assert flight_files(str(tmp_path)) == sorted([path, path2])
+
+
+def test_flight_excepthook_chains_and_dumps(tmp_path):
+    flight = FlightRecorder(str(tmp_path))
+    prev_hook = sys.excepthook
+    seen = []
+    sys.excepthook = lambda *a: seen.append(a)
+    try:
+        flight.install(signals=())  # hooks only; no signal handlers
+        assert sys.excepthook is not prev_hook
+        try:
+            raise RuntimeError("boom")
+        except RuntimeError:
+            sys.excepthook(*sys.exc_info())
+        dumps = flight_files(str(tmp_path))
+        assert len(dumps) == 1
+        with open(dumps[0]) as fh:
+            payload = json.load(fh)
+        assert payload["reason"] == "crash:RuntimeError"
+        assert any(
+            e["name"] == "unhandled-exception" for e in payload["events"]
+        )
+        assert seen  # the previous hook still ran (chained)
+        flight.uninstall()
+    finally:
+        sys.excepthook = prev_hook
+
+
+def test_flight_dump_never_raises(tmp_path):
+    flight = FlightRecorder(os.path.join(str(tmp_path), "f"))
+    flight.record({"name": "x", "bad": object()})  # unserializable attr...
+    assert flight.dump("crash") is not None  # ...stringified by default=str
+    broken = FlightRecorder("/proc/definitely-unwritable/x")
+    assert broken.dump("crash") is None  # best-effort: no raise
+
+
+# ---------------------------------------------------------------------------
+# microbatch queue-depth sampling (r16 satellite)
+# ---------------------------------------------------------------------------
+
+
+class _FakeReq:
+    def __init__(self, n=1):
+        import concurrent.futures
+
+        self.rows = [0] * n
+        self.future = concurrent.futures.Future()
+        self._submit_t = 0.0
+
+
+def test_microbatch_peak_depth_sampled_on_enqueue():
+    """Regression: max_queue_depth was only sampled at dispatch time, so a
+    burst that arrived and drained between dispatches under-reported the
+    peak. With the dispatch thread wedged, enqueues alone must move the
+    peak figure (and the bus gauge)."""
+    from dinunet_implementations_tpu.serving.microbatch import Microbatcher
+
+    bus = MetricsBus()
+    release = threading.Event()
+
+    def blocking_dispatch(reqs, bucket):
+        release.wait(timeout=30)
+        for r in reqs:
+            r.future.set_result(None)
+
+    lane = Microbatcher(
+        blocking_dispatch, buckets=(1,), max_delay_ms=0.0, name="t",
+        bus=bus,
+    )
+    try:
+        lane.submit(_FakeReq())          # picked up, wedged in dispatch
+        time.sleep(0.05)
+        for _ in range(3):
+            lane.submit(_FakeReq())      # queue up behind the wedge
+        # the peak is visible BEFORE any further dispatch happens
+        assert lane.stats["max_queue_depth"] >= 3
+        snap = bus.snapshot()
+        assert snap["gauges"][series_key(
+            "serving_queue_depth", {"lane": "t"})] >= 3
+    finally:
+        release.set()
+        lane.close()
+    assert lane.stats["dispatches"] == 4
+    assert lane.stats["max_queue_depth"] >= 3
+
+
+def test_microbatch_deferral_counter():
+    """Overflow deferrals (a request that doesn't fit the in-flight batch)
+    are counted and published."""
+    from dinunet_implementations_tpu.serving.microbatch import Microbatcher
+
+    bus = MetricsBus()
+    entered = threading.Event()
+    release = threading.Event()
+
+    def blocking_dispatch(reqs, bucket):
+        entered.set()
+        release.wait(timeout=30)
+        for r in reqs:
+            r.future.set_result(None)
+
+    lane = Microbatcher(
+        blocking_dispatch, buckets=(2,), max_delay_ms=200.0, name="t",
+        bus=bus,
+    )
+    try:
+        lane.submit(_FakeReq(1))
+        lane.submit(_FakeReq(1))   # fills the bucket → dispatch fires
+        entered.wait(timeout=10)
+        lane.submit(_FakeReq(1))   # next collect starts with this one...
+        lane.submit(_FakeReq(2))   # ...and this one overflows it → deferred
+        release.set()
+        for _ in range(200):
+            if lane.stats["requests"] == 4:
+                break
+            time.sleep(0.01)
+    finally:
+        release.set()
+        lane.close()
+    assert lane.stats["requests"] == 4
+    assert lane.stats["deferrals"] >= 1
+    counters = bus.snapshot()["counters"]
+    assert sum(
+        v for k, v in counters.items()
+        if k.startswith("serving_deferrals_total")
+    ) >= 1
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: daemon → bus/statusz/flight + trace propagation to serving
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def obs_tree(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("obs_tree"))
+    make_fs_demo_tree(root, n_sites=2, subjects=20, n_features=8, seed=6)
+    return root
+
+
+def test_daemon_observability_and_trace_propagation(obs_tree, tmp_path):
+    """One sample's journey, live: a spool join carrying a trace_id is
+    followable through the daemon's /statusz membership, the bus series a
+    scrape would see, the checkpoint meta it publishes, the serving engine
+    that loads that checkpoint, and the dispatch row a request lands in —
+    plus a flight dump with the final spans and bus snapshot."""
+    bus = MetricsBus()
+    out = str(tmp_path / "out")
+    daemon = FedDaemon(
+        TrainConfig(
+            task_id="FS-Classification", batch_size=4, telemetry="on",
+            fs_args=FSArgs(input_size=8, hidden_sizes=(8,)),
+        ),
+        capacity=4, spool_dir=str(tmp_path / "spool"), out_dir=out,
+        data_path=obs_tree, quorum=1, poll_s=0.01, inventory_rows=32,
+        verbose=False, bus=bus,
+    )
+    tid = new_trace_id()
+    ev = {
+        "event": "join", "site": "late-site", "trace_id": tid,
+        "data_dir": os.path.join(
+            obs_tree, "input", "local1", "simulatorRun"
+        ),
+        "config": {"labels_file": "site2_Covariate.csv"},
+    }
+    with open(os.path.join(daemon.spool_dir, "ev000.json"), "w") as fh:
+        json.dump(ev, fh)
+    daemon.serve(max_epochs=2)
+
+    # -- live surfaces an exporter would serve (no HTTP needed: the
+    # payload builders are plain methods)
+    ex = StatusExporter(
+        bus, health=daemon.health_probes(), statusz=daemon.status,
+        slo={"histogram": "serve_epoch_ms", "p99_target_ms": 60_000.0},
+        tracer=daemon.trainer.tracer, flight=daemon.flight,
+    )
+    code, health = ex.healthz()
+    assert code == 200, health
+    status = ex.statusz_payload()
+    assert status["status"]["epoch"] == 2
+    assert status["status"]["occupied"] == 3
+    assert status["status"]["members"]["late-site"]["trace_id"] == tid
+    assert status["slo"]["samples"] == 2  # one epoch_ms sample per epoch
+    gauges = status["metrics"]["gauges"]
+    assert gauges["serve_epoch"] == 2 and gauges["serve_members"] == 3
+    assert gauges[series_key(
+        "serve_member_generation", {"site": "late-site"})] == 1
+    counters = status["metrics"]["counters"]
+    assert counters["serve_epochs_total"] == 2
+    # 2 pre-joined tree sites + the spooled join all count as applied
+    assert counters[series_key(
+        "serve_spool_events_total", {"result": "applied"})] == 3
+    assert counters["serve_checkpoints_total"] >= 2
+    assert "serve_spool_ingest_lag_s" in gauges
+    text = ex.metrics_text()
+    _assert_valid_exposition(text)
+    assert "dinunet_serve_epoch 2" in text
+    assert "dinunet_train_epoch 2" not in text  # daemon path, not fit()
+    tracez = ex.tracez_payload()
+    assert any(e.get("name") == "epoch" for e in tracez["recent"])
+
+    # -- the trace id reached the published checkpoint
+    from dinunet_implementations_tpu.trainer.checkpoint import load_meta
+
+    meta = load_meta(daemon.ckpt_path)
+    assert meta["traces"] == {"late-site": tid}
+
+    # -- a flight dump carries the final spans + bus snapshot
+    path = daemon.flight.dump("signal:15")
+    with open(path) as fh:
+        payload = json.load(fh)
+    assert payload["bus"]["gauges"]["serve_epoch"] == 2
+    names = {e["name"] for e in payload["events"]}
+    assert "serve-epoch" in names and "checkpoint-publish" in names
+    assert "epoch" in names  # tracer spans mirrored into the ring
+
+    # -- ...and the serving engine, loading that checkpoint, surfaces the
+    # provenance and stamps request trace ids into dispatch rows
+    from dinunet_implementations_tpu.serving.engine import InferenceEngine
+    from dinunet_implementations_tpu.telemetry.sink import FitTelemetry
+
+    serve_bus = MetricsBus()
+    sink = FitTelemetry.open(
+        str(tmp_path / "serve_tel"), daemon.cfg, fold=0
+    )
+    engine = InferenceEngine(
+        daemon.cfg, checkpoint=daemon.ckpt_path, row_buckets=(4,),
+        sink=sink, bus=serve_bus,
+    )
+    engine.warmup()
+    assert engine.status()["checkpoint_traces"] == {"late-site": tid}
+    req_tid = new_trace_id()
+    rows = daemon._data["late-site"].inputs[:2]
+    fut = engine.submit(rows, trace_id=req_tid)
+    assert fut.trace_id == req_tid
+    probs = fut.result()
+    assert probs.shape == (2, 2)
+    auto = engine.submit(rows[:1])
+    assert re.fullmatch(r"[0-9a-f]{16}", auto.trace_id)
+    auto.result()
+    engine.close()
+    rows_out = [
+        json.loads(ln)
+        for ln in open(str(tmp_path / "serve_tel" / "metrics.jsonl"))
+        if ln.strip()
+    ]
+    dispatches = [r for r in rows_out if r["kind"] == "dispatch"]
+    assert any(req_tid in r.get("trace_ids", []) for r in dispatches)
+    # serving bus series: per-request latency histogram + queue gauge
+    assert serve_bus.merged_histogram(
+        "serving_request_latency_ms").count == 2
+    lat = slo_burn(
+        serve_bus.merged_histogram("serving_request_latency_ms"), 60_000.0
+    )
+    assert lat["samples"] == 2 and lat["violations"] == 0
+
+
+def test_trainer_fit_publishes_bus(obs_tree, tmp_path):
+    """The batch trainer publishes live epoch series into an injected bus
+    when telemetry is on (and stays on the NULL bus when off)."""
+    from dinunet_implementations_tpu.runner.fed_runner import (
+        FedRunner,
+        load_site_splits,
+    )
+    from dinunet_implementations_tpu.runner.registry import get_task
+    from dinunet_implementations_tpu.trainer.loop import FederatedTrainer
+
+    cfg = TrainConfig(
+        task_id="FS-Classification", epochs=2, batch_size=4, patience=50,
+        telemetry="on", fs_args=FSArgs(input_size=8, hidden_sizes=(8,)),
+    )
+    runner = FedRunner(cfg, data_path=obs_tree, out_dir=str(tmp_path / "o"))
+    bus = MetricsBus()
+    trainer = FederatedTrainer(
+        cfg, get_task(cfg.task_id).build_model(cfg), runner.mesh,
+        out_dir=str(tmp_path / "o"), bus=bus,
+    )
+    fold = load_site_splits(cfg, runner.site_dirs, runner.site_cfgs)[0]
+    trainer.fit(
+        fold["train"], fold["validation"], fold["test"], fold=0,
+        verbose=False,
+    )
+    snap = bus.snapshot()
+    assert snap["gauges"]["train_epoch"] == 2
+    assert snap["counters"]["train_epochs_total"] == 2
+    assert snap["counters"]["train_rounds_total"] >= 2
+    assert "train_loss" in snap["gauges"]
+    assert bus.merged_histogram("epoch_ms").count == 2
+    # the off path stays on the NULL bus
+    off = FederatedTrainer(
+        cfg.replace(telemetry="off"),
+        get_task(cfg.task_id).build_model(cfg), runner.mesh,
+    )
+    assert off.bus is NULL_BUS

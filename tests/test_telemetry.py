@@ -118,15 +118,35 @@ def test_disabled_tracer_is_noop_and_duration_helper():
     with tracer.span("fit"):
         tracer.event("x")
     assert tracer.events() == []
-    # the ONE reference-keyed duration helper (moved from trainer/logs.py)
+    # the ONE reference-keyed duration helper (moved from trainer/logs.py):
+    # starts come from the tracer's monotonic clock (perf_counter)
     cache: dict = {}
     import time
 
-    t0 = time.time()
+    t0 = time.perf_counter()
     d1 = duration(cache, t0, "time_spent_on_computation")
     duration(cache, t0, "time_spent_on_computation")
     assert len(cache["time_spent_on_computation"]) == 2
     assert cache["time_spent_on_computation"][0] == d1 >= 0
+
+
+def test_duration_survives_stepped_wall_clock(monkeypatch):
+    """Regression (r16): ``duration`` read ``time.time()`` while every span
+    (and every caller's start) used the monotonic ``perf_counter`` clock —
+    an NTP/DST wall-clock step mid-fit corrupted the checkpointed duration
+    cache with wildly wrong (even negative) entries. Stepping the wall
+    clock by a day in either direction must not perturb the recorded
+    durations."""
+    import time
+
+    cache: dict = {}
+    t0 = time.perf_counter()
+    monkeypatch.setattr(time, "time", lambda: 1e9)  # wall clock steps back
+    d1 = duration(cache, t0, "time_spent_on_computation")
+    monkeypatch.setattr(time, "time", lambda: 4e9)  # ...and jumps forward
+    d2 = duration(cache, t0, "time_spent_on_computation")
+    assert 0 <= d1 <= d2 < 60  # monotonic, sane magnitudes
+    assert cache["time_spent_on_computation"] == [d1, d2]
 
 
 # ---------------------------------------------------------------------------
@@ -539,6 +559,38 @@ def test_schema_validators_reject_drift():
         "schema_version" in p
         for p in validate_manifest({"schema_version": 99})
     )
+
+
+def test_schema_validators_unknown_kind_and_serving_rows():
+    """An unknown ``kind`` is a finding, not a silent pass (a typo'd kind
+    would otherwise vanish from the report), and the serving row kinds'
+    required-key sets are enforced key by key (negative fixtures: each
+    missing key must be NAMED in a problem string)."""
+    problems = validate_metrics_rows([{"kind": "dsipatch"}])  # typo
+    assert problems and "unknown kind" in problems[0]
+    good_dispatch = {
+        "kind": "dispatch", "lane": "infer", "bucket": 4, "rows": 3,
+        "pad_rows": 1, "queue_depth": 0,
+    }
+    assert validate_metrics_rows([good_dispatch]) == []
+    for key in ("lane", "bucket", "rows", "pad_rows", "queue_depth"):
+        bad = {k: v for k, v in good_dispatch.items() if k != key}
+        problems = validate_metrics_rows([bad])
+        assert problems and key in problems[0], (key, problems)
+    good_summary = {
+        "kind": "serve_summary", "task_id": "FS-Classification",
+        "requests": 1, "samples": 1, "dispatches": 1,
+        "latency_ms_p50": 1.0, "latency_ms_p95": 1.0, "latency_ms_p99": 1.0,
+        "requests_per_s": 1.0, "samples_per_s": 1.0, "pad_waste_pct": 0.0,
+        "bucket_hit_rate": 1.0, "warmup_seconds": 0.1,
+        "compiles_after_warmup": 0,
+    }
+    assert validate_metrics_rows([good_summary]) == []
+    for key in ("latency_ms_p50", "latency_ms_p95", "latency_ms_p99",
+                "requests", "dispatches", "compiles_after_warmup"):
+        bad = {k: v for k, v in good_summary.items() if k != key}
+        problems = validate_metrics_rows([bad])
+        assert problems and key in problems[0], (key, problems)
 
 
 def test_report_cli_smoke(tmp_path, capsys):
