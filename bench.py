@@ -60,21 +60,29 @@ ENC_IN, ENC_OUT, HIDDEN = COMPS * WLEN, 256, 348
 V5E_BF16_PEAK_FLOPS = 197e12
 
 
-def chain_epochs(epoch_fn, state0, x, y, w, n: int, live=None) -> float:
+def chain_epochs(epoch_fn, state0, x, y, w, n: int, live=None,
+                 attack=None) -> float:
     """Run ``n`` chained epochs from ``state0`` and FULLY materialize the
     final state (np.asarray over every leaf) — the only synchronization the
     lazy tunneled backend honors. Returns wall-clock seconds. This is the
     shared measurement primitive for bench.py and bench_matrix.py; any
     methodology fix belongs here, once. ``live`` is the optional ``[S,
     rounds]`` liveness mask (``--faults``): the same device array feeds every
-    epoch (throughput of the masked program, not of a changing schedule)."""
+    epoch (throughput of the masked program, not of a changing schedule);
+    ``attack`` is the optional ``[S, rounds]`` attack-code mask
+    (``--attacks``, robustness/attacks.py) riding after it."""
     import jax
     import numpy as np
 
     s = state0
     t0 = time.time()
     for _ in range(n):
-        s, _ = epoch_fn(s, x, y, w) if live is None else epoch_fn(s, x, y, w, live)
+        if attack is not None:
+            s, _ = epoch_fn(s, x, y, w, live, attack)
+        elif live is not None:
+            s, _ = epoch_fn(s, x, y, w, live)
+        else:
+            s, _ = epoch_fn(s, x, y, w)
     jax.tree.map(np.asarray, s)
     return time.time() - t0
 
@@ -545,6 +553,128 @@ def measure_wirequant_ab(quants, obs: int = 5, n: int = TIMED_EPOCHS,
     )
 
 
+def measure_attacks_ab(attack_plan, robust: str = "trimmed_mean",
+                       obs: int = 5, n: int = TIMED_EPOCHS,
+                       dims: dict | None = None,
+                       engine_name: str = "dSGD") -> list[dict]:
+    """Hostile-site A/B (``--attacks``, r17): three paired interleaved arms
+    of the flagship federated round —
+
+    - ``clean``            : no attack, legacy aggregation (the baseline);
+    - ``attacked-open``    : the AttackPlan injected, defense OFF (the
+      documented-degradation arm);
+    - ``attacked-<robust>``: the same attack with the robust reducer + the
+      anomaly reputation layer ON (the defense-cost arm — the gather
+      reducers' wire/compute overhead is the throughput claim under test,
+      and the loss trajectory is the robustness claim).
+
+    Each record carries throughput stats, the final chained epoch's mean
+    train loss (the quality signal: defense-off diverges, defense-on
+    tracks clean), the plan JSON, and the robust-mode modeled per-device
+    wire bytes (the figure S002 proves against the traced program). The
+    AUC-level robustness gates live in tests/test_golden.py; this artifact
+    records the measured arms a claim can cite.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dinunet_implementations_tpu.checks.sanitize import (
+        CompileGuard,
+        sanitize_enabled,
+    )
+    from dinunet_implementations_tpu.robustness.attacks import attack_window
+    from dinunet_implementations_tpu.telemetry.metrics import payload_bytes_of
+    from dinunet_implementations_tpu.trainer import (
+        init_train_state,
+        make_train_epoch_fn,
+    )
+
+    arm_specs = {
+        "clean": (False, "none"),
+        "attacked-open": (True, "none"),
+        f"attacked-{robust}": (True, robust),
+    }
+    chains, states, fns, data, byte_model = {}, {}, {}, {}, {}
+    samples = None
+    for arm, (attacked, mode) in arm_specs.items():
+        d, task, engine, opt, np_x, np_y, np_w = _flagship_arm(
+            engine_name, dict(robust_agg=mode), dims
+        )
+        S, steps = d["sites"], d["steps"]
+        x = jnp.asarray(
+            np_x,
+            dtype=jnp.bfloat16 if d["compute_dtype"] == "bfloat16" else None,
+        )
+        y, w = jnp.asarray(np_y), jnp.asarray(np_w)
+        state0 = init_train_state(
+            task, engine, opt, jax.random.PRNGKey(0), x[0, 0], num_sites=S,
+            reputation=mode != "none",
+        )
+        fn = make_train_epoch_fn(
+            task, engine, opt, mesh=None, local_iterations=1,
+            attack_plan=attack_plan if attacked else None, robust_agg=mode,
+        )
+        am = (
+            jnp.asarray(attack_window(attack_plan, S, 0, steps))
+            if attacked else None
+        )
+        guard = (
+            CompileGuard({"epoch_fn": fn}, label=arm)
+            if sanitize_enabled() else None
+        )
+
+        def run_chain(k, fn=fn, state0=state0, x=x, y=y, w=w, am=am,
+                      guard=guard, arm=arm):
+            t = chain_epochs(fn, state0, x, y, w, k, live=None, attack=am)
+            if guard is not None:
+                guard.check(context=f"arm={arm}, chain={k} epochs")
+            return t
+
+        run_chain(1)  # compile + warm up before any timing starts
+        chains[arm] = run_chain
+        states[arm], fns[arm], data[arm] = state0, fn, (x, y, w, am)
+        byte_model[arm] = int(payload_bytes_of(engine, state0.params))
+        samples = S * steps * d["batch"]
+    dists = interleaved_ab(chains, n, obs=obs)
+    records = []
+    for arm, (attacked, mode) in arm_specs.items():
+        # quality probe: n chained TRAINING epochs, last epoch's mean loss —
+        # the measured defense-on-tracks-clean / defense-off-diverges signal
+        s = states[arm]
+        x, y, w, am = data[arm]
+        losses = None
+        for _ in range(max(n, 2)):
+            if am is not None:
+                s, losses = fns[arm](s, x, y, w, None, am)
+            else:
+                s, losses = fns[arm](s, x, y, w)
+        lv = np.asarray(losses)
+        lv = lv[np.isfinite(lv)]
+        rec = {
+            "metric": "samples/sec/chip (ICA-LSTM federated round, "
+                      "hostile-site A/B)",
+            "arm": arm,
+            "engine": engine_name,
+            "attacked": attacked,
+            "robust_agg": mode,
+            "attacks": attack_plan.to_json(),
+            "sites": (dims or {}).get("sites", NUM_SITES),
+            "backend": jax.default_backend(),
+            "chain_epochs": n,
+            "samples_per_sec": throughput_stats(dists[arm], samples),
+            "unit": "samples/sec/chip",
+            "final_epoch_loss": (
+                round(float(lv.mean()), 6) if lv.size else None
+            ),
+            "wire_bytes_per_device_round": byte_model[arm],
+        }
+        if dims:
+            rec["dims"] = dims
+        records.append(rec)
+    return records
+
+
 def _setup_pipeline_arm(arm: str, dims: dict | None = None,
                         donate: bool = True):
     """One input-pipeline A/B arm (``--pipeline``): unlike the steady-state
@@ -735,7 +865,8 @@ def _ensure_host_devices(want: int) -> None:
 def _setup_packed_epoch(S: int, K: int, engine_name: str = "dSGD",
                         engine_kw: dict | None = None,
                         dims: dict | None = None, fault_plan=None,
-                        staleness_bound: int = 0):
+                        staleness_bound: int = 0, attack_plan=None,
+                        robust_agg: str = "none"):
     """One sites-scaling arm: S virtual sites packed K per device on a real
     ``(site,)`` mesh — the full federated round as ONE compiled SPMD program
     with two-level aggregation (trainer/steps.py packed path). Epoch inputs
@@ -752,7 +883,9 @@ def _setup_packed_epoch(S: int, K: int, engine_name: str = "dSGD",
     smoke's arm; ``staleness_bound > 0`` additionally measures the
     staleness-bounded buffered-async round (trainer/steps.py, r13), where a
     straggling virtual site's buffered update keeps contributing at decayed
-    weight."""
+    weight. ``attack_plan`` + ``robust_agg`` (r17, robustness/attacks.py)
+    compose on top: the CI hostile-site smoke measures the byzantine-
+    attacked, robustly-aggregated packed round as one compiled program."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -769,6 +902,7 @@ def _setup_packed_epoch(S: int, K: int, engine_name: str = "dSGD",
     from dinunet_implementations_tpu.trainer.steps import _state_specs
 
     mesh = packed_site_mesh(S, K)
+    engine_kw = {**(engine_kw or {}), "robust_agg": robust_agg}
     d, task, engine, opt, np_x, np_y, np_w = _flagship_arm(
         engine_name, engine_kw, {**(dims or {}), "sites": S}
     )
@@ -780,11 +914,19 @@ def _setup_packed_epoch(S: int, K: int, engine_name: str = "dSGD",
     state0 = init_train_state(
         task, engine, opt, jax.random.PRNGKey(0), x[0, 0], num_sites=S,
         staleness_bound=staleness_bound,
+        reputation=robust_agg != "none",
     )
     live = None
     if fault_plan is not None and fault_plan.injects_faults():
         # rounds == steps at local_iterations=1; the first epoch's window
         live = jnp.asarray(fault_plan.liveness(S, 0, d["steps"]))
+    attack = None
+    if attack_plan is not None and attack_plan.injects_attacks():
+        from dinunet_implementations_tpu.robustness.attacks import (
+            attack_window,
+        )
+
+        attack = jnp.asarray(attack_window(attack_plan, S, 0, d["steps"]))
     info = {
         "mesh_devices": int(mesh.devices.size),
         "wire_bytes_per_device_round": int(
@@ -798,13 +940,19 @@ def _setup_packed_epoch(S: int, K: int, engine_name: str = "dSGD",
     x, y, w = (jax.device_put(a, site_sh) for a in (x, y, w))
     if live is not None:
         live = jax.device_put(live, site_sh)
+    if attack is not None:
+        # the attack mask rides after `live` positionally; live stays None
+        # for attack-only runs — the same program form the runner CLI
+        # compiles (chain_epochs passes live=None through)
+        attack = jax.device_put(attack, site_sh)
     state0 = jax.tree.map(
         lambda a, spec: jax.device_put(a, NamedSharding(mesh, spec)),
         state0, _state_specs(state0),
     )
     epoch_fn = make_train_epoch_fn(
         task, engine, opt, mesh=mesh, local_iterations=1,
-        staleness_bound=staleness_bound,
+        staleness_bound=staleness_bound, attack_plan=attack_plan,
+        robust_agg=robust_agg,
     )
 
     from dinunet_implementations_tpu.checks.sanitize import (
@@ -818,7 +966,8 @@ def _setup_packed_epoch(S: int, K: int, engine_name: str = "dSGD",
     )
 
     def run_chain(k: int) -> float:
-        t = chain_epochs(epoch_fn, state0, x, y, w, k, live=live)
+        t = chain_epochs(epoch_fn, state0, x, y, w, k, live=live,
+                         attack=attack)
         if guard is not None:
             guard.check(context=f"sites={S}, pack={K}, chain={k} epochs")
         return t
@@ -830,7 +979,8 @@ def measure_sites_scaling(sites_list, packs=None, obs: int = 3,
                           n: int = TIMED_EPOCHS, dims: dict | None = None,
                           engine_name: str = "dSGD",
                           engine_kw: dict | None = None, fault_plan=None,
-                          staleness_bound: int = 0) -> list[dict]:
+                          staleness_bound: int = 0, attack_plan=None,
+                          robust_agg: str = "none") -> list[dict]:
     """The sites-scaling sweep (``--sites``): for each virtual site count S,
     run the packed federated round on the available device mesh and emit one
     JSON record with ``sites`` / ``sites_per_chip`` / ``pack_factor`` — the
@@ -854,6 +1004,7 @@ def measure_sites_scaling(sites_list, packs=None, obs: int = 3,
         run_chain, samples, info = _setup_packed_epoch(
             S, K, engine_name=engine_name, engine_kw=engine_kw, dims=dims,
             fault_plan=fault_plan, staleness_bound=staleness_bound,
+            attack_plan=attack_plan, robust_agg=robust_agg,
         )
         run_chain(1)  # compile + warm up outside the timing
         pairs = [
@@ -887,6 +1038,10 @@ def measure_sites_scaling(sites_list, packs=None, obs: int = 3,
             )
         if staleness_bound:
             rec["staleness_bound"] = staleness_bound
+        if attack_plan is not None:
+            rec["attacks"] = attack_plan.to_json()
+        if robust_agg != "none":
+            rec["robust_agg"] = robust_agg
         records.append(rec)
     return records
 
@@ -1211,10 +1366,27 @@ def main():
             plan = parse_fault_plan(sys.argv[sys.argv.index("--faults") + 1])
         staleness = (int(sys.argv[sys.argv.index("--staleness") + 1])
                      if "--staleness" in sys.argv else 0)
+        # hostile-site composition (r17): `--attacks` threads the byzantine
+        # code mask through the packed round and `--robust-agg` switches the
+        # engines to robust aggregation — the CI hostile smoke's path; the
+        # CompileGuard asserts one compiled program for the attacked,
+        # defended, packed chain
+        attack = None
+        if "--attacks" in sys.argv:
+            from dinunet_implementations_tpu.robustness import (
+                parse_attack_plan,
+            )
+
+            attack = parse_attack_plan(
+                sys.argv[sys.argv.index("--attacks") + 1]
+            )
+        robust = (sys.argv[sys.argv.index("--robust-agg") + 1]
+                  if "--robust-agg" in sys.argv else "none")
         for rec in measure_sites_scaling(
             sites_list, packs=packs, obs=obs, n=n, dims=dims,
             engine_name=engine_name, engine_kw=engine_kw, fault_plan=plan,
-            staleness_bound=staleness,
+            staleness_bound=staleness, attack_plan=attack,
+            robust_agg=robust,
         ):
             print(json.dumps(rec), flush=True)
         return
@@ -1288,6 +1460,30 @@ def main():
         for rec in measure_pipeline_ab(
             mode=mode, obs=obs, n=n, dims=dims,
             donate="--no-donate" not in sys.argv,
+        ):
+            print(json.dumps(rec), flush=True)
+        return
+    if "--attacks" in sys.argv:
+        # hostile-site A/B (r17): clean vs attacked-undefended vs
+        # attacked-defended paired interleaved arms — throughput (the robust
+        # reducers' gather/compute overhead) plus the final-epoch-loss
+        # quality signal, one JSON line per arm
+        # (docs/bench_attacks_ab_r17.jsonl; regen on TPU with the same
+        # command). --robust-agg picks the defense (default trimmed_mean).
+        from dinunet_implementations_tpu.robustness import parse_attack_plan
+
+        plan = parse_attack_plan(sys.argv[sys.argv.index("--attacks") + 1])
+        robust = (sys.argv[sys.argv.index("--robust-agg") + 1]
+                  if "--robust-agg" in sys.argv else "trimmed_mean")
+        obs = int(sys.argv[sys.argv.index("--obs") + 1]) if "--obs" in sys.argv else 5
+        n = (int(sys.argv[sys.argv.index("--epochs") + 1])
+             if "--epochs" in sys.argv else TIMED_EPOCHS)
+        dims = SMALL_DIMS if "--small" in sys.argv else None
+        engine_name = (sys.argv[sys.argv.index("--engine") + 1]
+                       if "--engine" in sys.argv else "dSGD")
+        for rec in measure_attacks_ab(
+            plan, robust=robust, obs=obs, n=n, dims=dims,
+            engine_name=engine_name,
         ):
             print(json.dumps(rec), flush=True)
         return

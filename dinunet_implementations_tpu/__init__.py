@@ -26,7 +26,7 @@ from .core.config import (
 )
 from .parallel.mesh import MODEL_AXIS, SITE_AXIS, host_mesh, make_site_mesh
 
-__version__ = "0.11.0"
+__version__ = "0.12.0"
 
 
 def __getattr__(name):

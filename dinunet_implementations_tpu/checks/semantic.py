@@ -723,6 +723,13 @@ class TraceCell:
     # overlap cells verify the stash apply ships the SAME per-device wire
     # as the legacy round and keeps every collective inside the scan
     overlap: bool = False
+    # byzantine-robust aggregation mode (r17, parallel/collectives.py
+    # ROBUST_AGGS): robust cells verify the robust-mode wire models — the
+    # gather-based reducers' genuinely pack-scaling per-site payload
+    # gathers, and norm_clip's unchanged psum wire plus its two tiny
+    # bookkeeping gathers — against the traced program, plus S001 (the
+    # reputation layer's scalar psums stay inside the scan)
+    robust: str = "none"
     # free-form label suffix for cells distinguished only by engine_kw
     # (e.g. "+fused" for the Pallas power-iteration corner) — labels key
     # the semantic baseline, so they must stay unique per cell
@@ -741,6 +748,8 @@ class TraceCell:
             name += "+donate"
         if self.staleness:
             name += f"+async{self.staleness}"
+        if self.robust != "none":
+            name += f"+{self.robust}"
         name += self.tag
         return f"{name}/{self.topology}/{self.pipeline}"
 
@@ -793,7 +802,8 @@ def build_cell_inputs(cell: TraceCell, engine=None) -> tuple:
     if engine is None:
         engine = make_engine(
             cell.engine, precision_bits=cell.precision_bits,
-            wire_quant=cell.wire_quant, **dict(cell.engine_kw),
+            wire_quant=cell.wire_quant, robust_agg=cell.robust,
+            **dict(cell.engine_kw),
         )
     opt = make_optimizer("adam", 1e-2)
     mesh = host_mesh(2) if cell.topology in ("mesh", "fold", "fold4") else None
@@ -802,6 +812,7 @@ def build_cell_inputs(cell: TraceCell, engine=None) -> tuple:
         jnp.ones((B, D), jnp.float32), num_sites=S,
         staleness_bound=cell.staleness,
         overlap_rounds=cell.overlap,
+        reputation=cell.robust != "none",
     )
     rng = np.random.default_rng(0)
     if cell.pipeline == "device":
@@ -831,7 +842,7 @@ def trace_cell(cell: TraceCell, engine=None) -> CellProgram:
     fn = make_train_epoch_fn(
         task, engine, opt, mesh=mesh, pipeline=cell.pipeline,
         donate_state=cell.donate, staleness_bound=cell.staleness,
-        overlap_rounds=cell.overlap,
+        overlap_rounds=cell.overlap, robust_agg=cell.robust,
     )
     closed, _, comp = epoch_program_artifacts(fn, *args, compiled=cell.donate)
     S = args[1].shape[0]
@@ -953,6 +964,34 @@ def default_matrix() -> list:
         TraceCell("dSGD", "vmap", "device", donate=True, overlap=True,
                   tag="+overlap"),
     ]
+    # byzantine-robust aggregation (r17): the robust-mode wire models proved
+    # against the traced programs — the gather reducers' genuinely
+    # pack-scaling per-site payload gathers (S002 on packed AND unpacked
+    # cells: a pack-unaware robust model would be 4x wrong on fold4),
+    # norm_clip's unchanged psum wire + two tiny bookkeeping gathers
+    # (composing with the int8 codec), rankDAD's factor gather unchanged
+    # with only the dense half switching to gathers, and powerSGD's factor
+    # psums becoming factor gathers. The reputation layer's scalar psums
+    # must stay inside the rounds scan (S001) on every robust cell.
+    cells += [
+        TraceCell("dSGD", "mesh", "host", robust="trimmed_mean"),
+        TraceCell("dSGD", "fold4", "device", robust="trimmed_mean"),
+        TraceCell("dSGD", "mesh", "host", robust="norm_clip"),
+        TraceCell("dSGD", "mesh", "host", robust="norm_clip",
+                  wire_quant="int8"),
+        TraceCell(
+            "rankDAD", "mesh", "host", robust="coordinate_median",
+            engine_kw=(("dad_num_pow_iters", 2), ("dad_reduction_rank", 2)),
+        ),
+        TraceCell(
+            "rankDAD", "fold4", "host", robust="coordinate_median",
+            engine_kw=(("dad_num_pow_iters", 2), ("dad_reduction_rank", 2)),
+        ),
+        TraceCell(
+            "powerSGD", "mesh", "host", robust="trimmed_mean",
+            engine_kw=(("dad_reduction_rank", 2),),
+        ),
+    ]
     return cells
 
 
@@ -987,6 +1026,23 @@ IDENTITY_CASES = {
     # double-buffered stash apply genuinely in the program
     "overlap-off": (dict(overlap_rounds=False), True),
     "overlap-on": (dict(overlap_rounds=True), False),
+    # byzantine-robust aggregation (r17): robust_agg="none" must compile the
+    # EXACT legacy program (engine AND epoch builder both off — the
+    # acceptance gate), and each robust mode must genuinely change it (the
+    # inverse divergence gate: if the gather reducers / norm clip / the
+    # reputation layer stop appearing, "robust" has silently become a no-op)
+    "robust-off": (
+        dict(robust_agg="none", engine=dict(robust_agg="none")), True,
+    ),
+    "robust-trimmed": (
+        dict(robust_agg="trimmed_mean",
+             engine=dict(robust_agg="trimmed_mean")),
+        False,
+    ),
+    "robust-normclip": (
+        dict(robust_agg="norm_clip", engine=dict(robust_agg="norm_clip")),
+        False,
+    ),
 }
 
 #: the rankDAD corner's cases — the fused power-iteration kernel only

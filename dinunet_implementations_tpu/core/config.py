@@ -346,6 +346,30 @@ class TrainConfig:
     # program (exact pre-robustness program; liveness masks still work when a
     # FaultPlan is given).
     quarantine_rounds: int = 3
+    # byzantine-robust aggregation (r17, parallel/collectives.py
+    # ROBUST_AGGS): "none" (default) keeps the renormalizing weighted mean
+    # program-identically (S005-gated); "norm_clip" clips each site's
+    # gradient norm to robust_clip_mult × the live-weighted median site norm
+    # before the UNCHANGED weighted-mean wire (composes with wire_quant);
+    # "trimmed_mean" / "coordinate_median" swap the psum-shaped exchange for
+    # a cross-site gather + per-coordinate robust reduce (wire grows —
+    # S002-proven per engine). Any non-"none" mode also switches on the
+    # anomaly-scored reputation layer (robustness/health.py).
+    robust_agg: str = "none"
+    # fraction of total live weight trimmed from EACH tail by the
+    # trimmed-mean reducer; must exceed the hostile weight fraction for the
+    # defense to hold (f attackers of S equal sites need trim_frac > f/S)
+    robust_trim_frac: float = 0.2
+    # norm_clip threshold multiplier over the live-weighted median site norm
+    robust_clip_mult: float = 2.5
+    # reputation layer (robust_agg != "none"): a live site whose per-round
+    # anomaly z-score (max of distance-to-robust-aggregate and gradient-norm
+    # z across the live cohort) exceeds reputation_z for reputation_rounds
+    # CONSECUTIVE rounds trips the same sticky quarantine flag as a NaN
+    # streak. reputation_rounds=0 scores without quarantining. z-scores top
+    # out at (S_live-1)/sqrt(S_live), so small cohorts need a lower z.
+    reputation_z: float = 2.0
+    reputation_rounds: int = 8
 
     # -- helpers ---------------------------------------------------------
 

@@ -65,6 +65,23 @@ grid (scale per payload) before its collective and the wire models above
 follow the CODEC dtype, so an int8 wire models (and S002 proves) 1 byte per
 element. ``wire_quant="none"`` keeps the legacy ``precision_bits`` path
 program-identically (S005-gated).
+
+Byzantine-robust aggregation (r17, parallel/collectives.py ``ROBUST_AGGS``):
+engines take ``robust_agg`` (``none`` | ``norm_clip`` | ``trimmed_mean`` |
+``coordinate_median``) plus ``robust_trim_frac`` / ``robust_clip_mult``
+factory kwargs. ``none`` keeps the renormalizing weighted mean
+program-identically (S005-gated). ``norm_clip`` clips each site's gradient
+norm to ``clip_mult ×`` the live-weighted MEDIAN site norm before the
+UNCHANGED weighted-mean wire (two tiny ``[K]`` norm/weight gathers are the
+only extra traffic, so norm_clip composes with the quantized wire codecs).
+``trimmed_mean`` / ``coordinate_median`` replace the psum-shaped exchange
+with a cross-site GATHER and a per-coordinate robust reduce over the global
+site axis — dSGD gathers every dense payload leaf (wire ×S per device
+block), powerSGD gathers its two factors per leaf instead of psumming them,
+and rankDAD's factor gather ALREADY ships every site's payload (its robust
+mode costs only the weight gather plus per-site reconstruction compute).
+The robust-mode wire models branch accordingly and S002 proves them against
+the traced program on packed and unpacked cells.
 """
 
 from __future__ import annotations
@@ -163,6 +180,23 @@ class Engine:
     # the payload dtype this engine quantizes its wire to (numpy dtype);
     # audited by checks/semantic.py rule S004 on the traced aggregation path
     wire_dtype: Any = None
+
+
+def robust_gather_wire(pack: int, robust_agg: str) -> list:
+    """The robust-mode bookkeeping gathers every engine's wire model adds
+    (engines module docstring): ``norm_clip`` gathers the per-site norm AND
+    weight vectors (two ``[pack]`` f32 operands per device); the gather-based
+    reducers (``trimmed_mean`` / ``coordinate_median``) gather the weight
+    vector only — their payload gathers are modeled per engine. ``none``
+    adds nothing (the legacy program, S005-gated)."""
+    import numpy as np
+
+    f32 = np.dtype(np.float32)
+    if robust_agg == "norm_clip":
+        return [((pack,), f32), ((pack,), f32)]
+    if robust_agg in ("trimmed_mean", "coordinate_median"):
+        return [((pack,), f32)]
+    return []
 
 
 def dense_wire_bytes(grads, itemsize: int = 4) -> int:

@@ -15,11 +15,15 @@ import numpy as np
 import jax
 
 from ..parallel.collectives import (
+    ROBUST_AGGS,
     PackedAxis,
+    clip_site_gradients,
     payload_cast,
     payload_dtype,
     payload_uncast,
     resolve_wire_codec,
+    robust_site_reduce,
+    site_all_gather,
     site_weighted_mean,
 )
 from .base import (
@@ -28,11 +32,13 @@ from .base import (
     dense_wire_shapes,
     mask_dead_site,
     register_engine,
+    robust_gather_wire,
 )
 
 
 @register_engine("dSGD")
 def make_dsgd(precision_bits="32", wire_quant="none", wire_stochastic=False,
+              robust_agg="none", robust_trim_frac=0.2, robust_clip_mult=2.5,
               **_unused) -> Engine:
     # the wire codec (parallel/collectives.py, r14): "none" keeps the legacy
     # precision_bits payload cast byte-for-byte; int8/fp8 quantize each
@@ -41,6 +47,14 @@ def make_dsgd(precision_bits="32", wire_quant="none", wire_stochastic=False,
     codec = resolve_wire_codec(precision_bits, wire_quant, wire_stochastic)
     pdtype = np.dtype(codec.dtype)
     itemsize = pdtype.itemsize
+    if robust_agg not in ROBUST_AGGS:
+        raise ValueError(
+            f"robust_agg must be one of {ROBUST_AGGS}, got {robust_agg!r}"
+        )
+    # robust site-axis reduction (r17, engines/base.py module docstring):
+    # the gather-based reducers replace the psum wire with a cross-site
+    # gather of every dense payload leaf
+    gather_mode = robust_agg in ("trimmed_mean", "coordinate_median")
 
     def init(grads):
         return {}
@@ -49,14 +63,35 @@ def make_dsgd(precision_bits="32", wire_quant="none", wire_stochastic=False,
         # dSGD ships every gradient leaf whole, cast to the payload dtype.
         # Pack-INVARIANT: under site packing the K virtual sites' weighted
         # payloads reduce in-register before the wire (two_level_psum), so
-        # the device ships one dense partial regardless of K.
-        return dense_wire_bytes(grads, itemsize)
+        # the device ships one dense partial regardless of K. Robust gather
+        # modes instead ship the device's whole [pack, ...] per-site block
+        # per leaf (×pack) plus the bookkeeping gathers; norm_clip keeps the
+        # psum wire and adds only the two tiny norm/weight gathers.
+        import math
+
+        extras = sum(
+            math.prod(s) * d.itemsize
+            for s, d in robust_gather_wire(pack, robust_agg)
+        )
+        if gather_mode:
+            return pack * dense_wire_bytes(grads, itemsize) + extras
+        return dense_wire_bytes(grads, itemsize) + extras
 
     def wire_shapes(grads, pack: int = 1):
         # one psum per leaf; the operand is quantized to the payload dtype
         # before the f32-accumulating collective (parallel/collectives.py).
-        # Same shapes at every pack factor (see wire_bytes).
-        return dense_wire_shapes(grads, pdtype)
+        # Same shapes at every pack factor (see wire_bytes). Robust gather
+        # modes list one [pack, ...] gathered block per leaf instead, plus
+        # the bookkeeping gathers. Must sum to wire_bytes (S002).
+        extras = robust_gather_wire(pack, robust_agg)
+        if gather_mode:
+            import jax
+
+            return [
+                ((pack,) + tuple(g.shape), pdtype)
+                for g in jax.tree.leaves(grads)
+            ] + extras
+        return dense_wire_shapes(grads, pdtype) + extras
 
     def aggregate(grads, state, weight, axis_name, live=None):
         # dead/quarantined sites: payload zeroed, weight zeroed — the
@@ -72,6 +107,39 @@ def make_dsgd(precision_bits="32", wire_quant="none", wire_stochastic=False,
         # psum — the two-level reduction; the per-site payload cast below
         # keeps the reference's per-site quantization semantics either way.
         grads, weight = mask_dead_site(grads, weight, live)
+        packed_ax = isinstance(axis_name, PackedAxis)
+        if robust_agg == "norm_clip":
+            # byzantine defense (r17): clip each site's gradient norm to a
+            # robust (weighted-median) threshold BEFORE the unchanged
+            # weighted-mean wire — the quantized codecs compose untouched
+            grads = clip_site_gradients(
+                grads, weight, axis_name, robust_clip_mult
+            )
+        elif gather_mode:
+            # trimmed-mean / coordinate-median (r17): gather every site's
+            # payload (quantized per site exactly like the psum wire would
+            # be) and reduce robustly per coordinate over the global site
+            # axis with the gathered live weights — dead/quarantined sites
+            # arrive at weight 0 and never shift the trim band
+            import jax.numpy as jnp
+
+            w_all = site_all_gather(
+                jnp.asarray(weight, jnp.float32), axis_name
+            )
+            if codec.quant == "none":
+                payload = payload_cast(grads, precision_bits)
+            else:
+                payload = jax.tree.map(
+                    lambda g: codec.compress(g, batched=packed_ax), grads
+                )
+            agg = jax.tree.map(
+                lambda g: robust_site_reduce(
+                    site_all_gather(g, axis_name).astype(jnp.float32),
+                    w_all, robust_agg, robust_trim_frac,
+                ),
+                payload,
+            )
+            return payload_uncast(agg, grads), state
         if codec.quant == "none":
             # legacy precision_bits wire, program-identical to pre-r14
             # (S005-gated: the disabled codec must compile the exact legacy

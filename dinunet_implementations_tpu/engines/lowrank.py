@@ -41,7 +41,8 @@ def lowrank_rank_groups(grads, rank: int) -> tuple:
     return sorted(groups.items()), dense
 
 
-def lowrank_wire_bytes(grads, rank: int, itemsize: int, pack: int = 1) -> int:
+def lowrank_wire_bytes(grads, rank: int, itemsize: int, pack: int = 1,
+                       dense_pack: int = 1) -> int:
     """Modeled per-round per-DEVICE collective payload of a low-rank factor
     exchange (the shared ``Engine.wire_bytes`` body for rankDAD and
     powerSGD, telemetry/metrics.py): each compressible leaf ships two
@@ -51,9 +52,13 @@ def lowrank_wire_bytes(grads, rank: int, itemsize: int, pack: int = 1) -> int:
     exchange (rankDAD) ships every one of the device's K virtual sites'
     factors, so the factor half scales ×K, while the dense psum half reduces
     locally first and stays K-invariant (powerSGD's psum'd factors are
-    likewise K-invariant — it passes ``pack=1``). Pure shape arithmetic on
-    THIS module's compressibility criterion — safe on tracers, and a
-    criterion change here changes the payload model with it."""
+    likewise K-invariant — it passes ``pack=1``). ``dense_pack`` scales the
+    dense 1-D half instead: the robust gather modes (r17) GATHER the dense
+    leaves rather than psumming them, so their bytes genuinely scale with K
+    too (the legacy psum path keeps ``dense_pack=1``). Pure shape
+    arithmetic on THIS module's compressibility criterion — safe on
+    tracers, and a criterion change here changes the payload model with
+    it."""
     total = 0
     for g in jax.tree.leaves(grads):
         if is_compressible(g):
@@ -63,7 +68,7 @@ def lowrank_wire_bytes(grads, rank: int, itemsize: int, pack: int = 1) -> int:
             size = 1
             for d in g.shape:
                 size *= d
-            total += size * 4
+            total += size * 4 * dense_pack
     return total
 
 

@@ -22,15 +22,24 @@ import jax
 import jax.numpy as jnp
 
 from ..parallel.collectives import (
+    ROBUST_AGGS,
     PackedAxis,
+    clip_site_gradients,
     payload_dtype,
     resolve_wire_codec,
+    robust_site_reduce,
+    site_all_gather,
     site_weight_scale,
     two_level_psum,
     weighted_site_sum,
     wire_compress,
 )
-from .base import Engine, mask_dead_site, register_engine
+from .base import (
+    Engine,
+    mask_dead_site,
+    register_engine,
+    robust_gather_wire,
+)
 from .lowrank import (
     from_matrix,
     is_compressible,
@@ -49,8 +58,22 @@ def make_powersgd(
     seed: int = 0,
     wire_quant="none",
     wire_stochastic=False,
+    robust_agg="none",
+    robust_trim_frac=0.2,
+    robust_clip_mult=2.5,
     **_unused,
 ) -> Engine:
+    if robust_agg not in ROBUST_AGGS:
+        raise ValueError(
+            f"robust_agg must be one of {ROBUST_AGGS}, got {robust_agg!r}"
+        )
+    # robust gather modes (r17): the two factor exchanges switch from psum
+    # to per-site gather + robust reduce — P comes from a trimmed/median of
+    # the sites' M·q sketches instead of their weighted sum, so a byzantine
+    # site cannot steer the shared subspace, and its influence on Q' is
+    # capped the same way. The wire genuinely grows ×pack (per-site factors
+    # must reach every device); norm_clip keeps the psum wire.
+    gather_mode = robust_agg in ("trimmed_mean", "coordinate_median")
     pdtype = payload_dtype(precision_bits)
     # same mixed-precision playbook as rankDAD (engines/rankdad.py): a bf16
     # wire also runs the big M@q / MᵀP products as bf16×bf16→f32 MXU
@@ -70,6 +93,13 @@ def make_powersgd(
         if codec.quant == "none":
             return wire_compress(x, pdtype)  # the exact legacy program
         return codec.compress(x)
+
+    def _compress_rows(x):
+        # per-virtual-site payload compression on a [K, ...]-leading block
+        # (the robust gather mode's pre-gather quantization: scale per row)
+        if codec.quant == "none":
+            return wire_compress(x, pdtype)
+        return codec.compress(x, batched=True)
 
     # what two_level_psum quantizes the packed partial with (the legacy arm
     # must stay lowering-identical, so it keeps the plain-dtype spelling)
@@ -101,9 +131,22 @@ def make_powersgd(
         # both factor psums and the dense 1-D psums reduce over the packed
         # virtual-site axis in-register before the wire (two_level_psum), so
         # the device ships one partial per factor regardless of K.
+        import math
+
+        extras = sum(
+            math.prod(s) * d.itemsize
+            for s, d in robust_gather_wire(pack, robust_agg)
+        )
+        if gather_mode:
+            # gathered factor exchange: both the factor and dense halves
+            # ship every virtual site's payload (×pack)
+            return lowrank_wire_bytes(
+                grads, dad_reduction_rank, wdtype.itemsize, pack=pack,
+                dense_pack=pack,
+            ) + extras
         return lowrank_wire_bytes(
             grads, dad_reduction_rank, wdtype.itemsize
-        )
+        ) + extras
 
     def wire_shapes(grads, pack: int = 1):
         # per compressible leaf TWO psum'd factors — P [m, r] then Q' [n, r],
@@ -117,9 +160,21 @@ def make_powersgd(
         shapes = []
         for r, mns in groups:
             for m, n in mns:
-                shapes.append(((m, r), pd))
-                shapes.append(((n, r), pd))
-        return shapes + [(s, np.dtype(np.float32)) for s in dense]
+                if gather_mode:
+                    # robust gather mode (r17): the device's [pack, ...]
+                    # per-site factor blocks cross the wire whole
+                    shapes.append(((pack, m, r), pd))
+                    shapes.append(((pack, n, r), pd))
+                else:
+                    shapes.append(((m, r), pd))
+                    shapes.append(((n, r), pd))
+        if gather_mode:
+            shapes += [
+                ((pack,) + tuple(s), np.dtype(np.float32)) for s in dense
+            ]
+        else:
+            shapes += [(s, np.dtype(np.float32)) for s in dense]
+        return shapes + robust_gather_wire(pack, robust_agg)
 
     def aggregate(grads, state, weight, axis_name, live=None):
         # Dead-site round: G zeroed (NaN-safe where) and weight zeroed, so
@@ -133,8 +188,22 @@ def make_powersgd(
         # buffered gradient — the decayed scale flows through P/Q' exactly
         # like a fractional liveness weight; no engine-side change.
         grads, weight = mask_dead_site(grads, weight, live)
-        scale = site_weight_scale(weight, axis_name)
+        if robust_agg == "norm_clip":
+            # byzantine defense (r17): clip the incoming gradient's norm to
+            # the robust median threshold BEFORE error feedback — the
+            # residual e is the site's own honest state and stays unclipped
+            grads = clip_site_gradients(
+                grads, weight, axis_name, robust_clip_mult
+            )
         packed = isinstance(axis_name, PackedAxis)
+        w_all = None
+        if gather_mode:
+            w_all = site_all_gather(
+                jnp.asarray(weight, jnp.float32), axis_name
+            )
+            scale = None  # the robust reduce weighs sites itself
+        else:
+            scale = site_weight_scale(weight, axis_name)
 
         # Per leaf, NOT lockstep (unlike rankDAD): powerSGD's error-feedback
         # matrix M is a full fp32 gradient copy, and a cross-leaf
@@ -143,6 +212,17 @@ def make_powersgd(
         # orthonormalization itself is custom-call-free (lowrank's unrolled
         # Cholesky), so the per-leaf loop costs no LAPACK launches anyway.
         def agg_leaf(g, q, e):
+            if q is None and gather_mode:
+                # robust dense path: gather the per-site leaf and reduce
+                # robustly per coordinate (wire ×pack, modeled above)
+                return (
+                    robust_site_reduce(
+                        site_all_gather(g.astype(jnp.float32), axis_name),
+                        w_all, robust_agg, robust_trim_frac,
+                    ).astype(g.dtype),
+                    None,
+                    None,
+                )
             if q is None:
                 if packed:
                     # dense 1-D leaf: two-level weighted psum (K-invariant)
@@ -156,6 +236,61 @@ def make_powersgd(
                     None,
                     None,
                 )
+            if gather_mode and packed:
+                # robust gather round (r17): every site's M·q sketch is
+                # gathered and the shared subspace P comes from a robust
+                # per-coordinate reduce of the sketches — a hostile site
+                # contributes one trimmed/median vote, never a weighted-sum
+                # steer; Q' is reduced the same way. Quantization rides the
+                # per-site payload before the gather (batched rows), so the
+                # codec grid is what crosses the wire.
+                M = jax.vmap(to_matrix)(g).astype(jnp.float32) + e
+                Pg = site_all_gather(
+                    _compress_rows(lp_matmul(M, q, mm_dtype)), axis_name
+                )  # [S, m, r]
+                P = orthonormalize(robust_site_reduce(
+                    Pg.astype(jnp.float32), w_all, robust_agg,
+                    robust_trim_frac,
+                ))
+                Qg = site_all_gather(
+                    _compress_rows(
+                        lp_matmul(jnp.swapaxes(M, 1, 2), P, mm_dtype)
+                    ),
+                    axis_name,
+                )  # [S, n, r]
+                q_new = robust_site_reduce(
+                    Qg.astype(jnp.float32), w_all, robust_agg,
+                    robust_trim_frac,
+                )
+                G_hat = P @ q_new.T
+                e_new = M - G_hat[None]
+                like = jax.ShapeDtypeStruct(g.shape[1:], g.dtype)
+                return (
+                    from_matrix(G_hat, like),
+                    jnp.broadcast_to(q_new, q.shape),
+                    e_new,
+                )
+            if gather_mode:
+                # robust gather round, one site per member (the vmap fold):
+                # same semantics, unbatched local halves
+                M = to_matrix(g).astype(jnp.float32) + e
+                Pg = site_all_gather(
+                    _compress(lp_matmul(M, q, mm_dtype)), axis_name
+                )  # [S, m, r]
+                P = orthonormalize(robust_site_reduce(
+                    Pg.astype(jnp.float32), w_all, robust_agg,
+                    robust_trim_frac,
+                ))
+                Qg = site_all_gather(
+                    _compress(lp_matmul(M.T, P, mm_dtype)), axis_name
+                )  # [S, n, r]
+                q_new = robust_site_reduce(
+                    Qg.astype(jnp.float32), w_all, robust_agg,
+                    robust_trim_frac,
+                )
+                G_hat = P @ q_new.T
+                e_new = M - G_hat
+                return from_matrix(G_hat, g), q_new, e_new
             if packed:
                 # g [K, …], q [K, n, r], e [K, m, n] — the local halves are
                 # batched MXU contractions over the device's K virtual

@@ -41,14 +41,23 @@ import jax
 import jax.numpy as jnp
 
 from ..parallel.collectives import (
+    ROBUST_AGGS,
     PackedAxis,
+    clip_site_gradients,
     payload_dtype,
     resolve_wire_codec,
+    robust_site_reduce,
+    site_all_gather,
     site_all_gather_packed,
     site_weight_scale,
     weighted_site_sum,
 )
-from .base import Engine, mask_dead_site, register_engine
+from .base import (
+    Engine,
+    mask_dead_site,
+    register_engine,
+    robust_gather_wire,
+)
 from .lowrank import (
     default_omega,
     from_matrix,
@@ -70,8 +79,20 @@ def make_rankdad(
     wire_quant="none",
     wire_stochastic=False,
     fused_poweriter: bool | None = None,
+    robust_agg="none",
+    robust_trim_frac=0.2,
+    robust_clip_mult=2.5,
     **_unused,
 ) -> Engine:
+    if robust_agg not in ROBUST_AGGS:
+        raise ValueError(
+            f"robust_agg must be one of {ROBUST_AGGS}, got {robust_agg!r}"
+        )
+    # robust gather modes (r17): the factor gather ALREADY ships every
+    # virtual site's payload, so the robust reduce costs no factor-wire
+    # change — only the dense 1-D leaves switch from psum to gather and the
+    # weight vector is gathered for the weighted trim/median
+    gather_mode = robust_agg in ("trimmed_mean", "coordinate_median")
     pdtype = payload_dtype(precision_bits)
     # bf16 wire ⇒ bf16 power-iteration matmuls (see module docstring);
     # "16-ieee"/"32" keep f32 math.
@@ -129,9 +150,16 @@ def make_rankdad(
         # locally over the pack axis first and is K-invariant. Bytes follow
         # the WIRE dtype (codec grid), not the compute dtype — int8/fp8
         # wires model (and S002 proves) the 4x shrink.
-        return lowrank_wire_bytes(
-            grads, dad_reduction_rank, wdtype.itemsize, pack=pack
+        import math
+
+        extras = sum(
+            math.prod(s) * d.itemsize
+            for s, d in robust_gather_wire(pack, robust_agg)
         )
+        return lowrank_wire_bytes(
+            grads, dad_reduction_rank, wdtype.itemsize, pack=pack,
+            dense_pack=pack if gather_mode else 1,
+        ) + extras
 
     def wire_shapes(grads, pack: int = 1):
         # what `aggregate` actually launches per round per device: ONE packed
@@ -146,7 +174,16 @@ def make_rankdad(
             ((pack, sum(m + n for m, n in mns), r), wdtype)
             for r, mns in groups
         ]
-        return shapes + [(s, np.dtype(np.float32)) for s in dense]
+        if gather_mode:
+            # robust gather mode (r17): dense leaves are gathered per site
+            # ([pack, ...] blocks) instead of two-level psummed, plus the
+            # weight gather — the factor gather entries are unchanged
+            shapes += [
+                ((pack,) + tuple(s), np.dtype(np.float32)) for s in dense
+            ]
+        else:
+            shapes += [(s, np.dtype(np.float32)) for s in dense]
+        return shapes + robust_gather_wire(pack, robust_agg)
 
     def aggregate(grads, state, weight, axis_name, live=None):
         # Dead-site round: G zeroed (NaN-safe where) + weight zeroed — the
@@ -167,8 +204,26 @@ def make_rankdad(
         # the wire), and the dense 1-D leaves take the two-level psum (local
         # pack reduce first — K-invariant wire).
         grads, weight = mask_dead_site(grads, weight, live)
-        scale = site_weight_scale(weight, axis_name)
+        if robust_agg == "norm_clip":
+            # byzantine defense (r17): clip each site's gradient norm to the
+            # robust median threshold BEFORE factorization — a sign-flipped
+            # or scaled gradient still factorizes, but its reconstruction
+            # can pull the mean no further than an honest-sized update
+            grads = clip_site_gradients(
+                grads, weight, axis_name, robust_clip_mult
+            )
         packed = isinstance(axis_name, PackedAxis)
+        w_all = None
+        if gather_mode:
+            # robust gather mode (r17): the weighted trim/median needs every
+            # site's live weight on every device; the payload gathers below
+            # are the factor exchange the engine launches anyway
+            w_all = site_all_gather(
+                jnp.asarray(weight, jnp.float32), axis_name
+            )
+            scale = None  # the robust reduce weighs sites itself
+        else:
+            scale = site_weight_scale(weight, axis_name)
         leaves, treedef = jax.tree.flatten(grads)
         omegas = (
             treedef.flatten_up_to(state["omega"])
@@ -188,6 +243,14 @@ def make_rankdad(
             row = jax.ShapeDtypeStruct(g.shape[1:], g.dtype) if packed else g
             if is_compressible(row):
                 groups.setdefault(_effective_rank(row), []).append(i)
+            elif gather_mode:
+                # robust dense path: gather the per-site leaf and reduce
+                # robustly per coordinate (the dense half of the wire now
+                # genuinely scales with the pack factor — modeled above)
+                out[i] = robust_site_reduce(
+                    site_all_gather(g.astype(jnp.float32), axis_name),
+                    w_all, robust_agg, robust_trim_frac,
+                ).astype(g.dtype)
             elif packed:
                 # dense dSGD path for 1-D leaves: two-level weighted psum
                 out[i] = weighted_site_sum(g, scale, axis_name).astype(g.dtype)
@@ -231,7 +294,14 @@ def make_rankdad(
             # gather (P_0, Q_0, P_1, Q_1, ... interleaved)
             parts = []
             for P, Q in pqs:
-                qs = Q * (scale[:, None, None] if packed else scale)
+                # robust gather modes ship the UNWEIGHTED right factor (the
+                # robust reduce weighs the gathered per-site reconstructions
+                # itself); the legacy path pre-weights Q so the gathered
+                # reconstruction sums straight to the weighted mean
+                qs = (
+                    Q if gather_mode
+                    else Q * (scale[:, None, None] if packed else scale)
+                )
                 if codec.quant == "none":
                     # legacy precision_bits cast (program-identical pre-r14)
                     parts.append(P.astype(pdtype))
@@ -245,11 +315,26 @@ def make_rankdad(
                     parts.append(codec.compress(qs, batched=packed))
             gathered = site_all_gather_packed(parts, axis_name)
             for k, (i, (P, Q)) in enumerate(zip(idxs, pqs)):
-                G_hat = jnp.einsum(
-                    "smr,snr->mn",
-                    gathered[2 * k].astype(jnp.float32),      # [S, m, r]
-                    gathered[2 * k + 1].astype(jnp.float32),  # [S, n, r]
-                )
+                if gather_mode:
+                    # per-site rank-r reconstructions [S, m, n], robustly
+                    # reduced per coordinate — a byzantine site's factors
+                    # reach every device (they always did), but the trim /
+                    # median caps what they can do to the aggregate. Costs
+                    # one [S, m, n] temporary per leaf: compute, not wire.
+                    G_site = jnp.einsum(
+                        "smr,snr->smn",
+                        gathered[2 * k].astype(jnp.float32),      # [S, m, r]
+                        gathered[2 * k + 1].astype(jnp.float32),  # [S, n, r]
+                    )
+                    G_hat = robust_site_reduce(
+                        G_site, w_all, robust_agg, robust_trim_frac
+                    )
+                else:
+                    G_hat = jnp.einsum(
+                        "smr,snr->mn",
+                        gathered[2 * k].astype(jnp.float32),      # [S, m, r]
+                        gathered[2 * k + 1].astype(jnp.float32),  # [S, n, r]
+                    )
                 like = (
                     jax.ShapeDtypeStruct(leaves[i].shape[1:], leaves[i].dtype)
                     if packed else leaves[i]

@@ -374,6 +374,143 @@ def resolve_wire_codec(precision_bits="32", wire_quant: str = "none",
     )
 
 
+# ---------------------------------------------------------------------------
+# byzantine-robust site-axis reducers (r17)
+# ---------------------------------------------------------------------------
+
+#: accepted TrainConfig.robust_agg / engine robust_agg values. "none" keeps
+#: the legacy renormalizing weighted mean program-identically (S005-gated);
+#: "norm_clip" clips each site's gradient norm to a robust (weighted-median)
+#: threshold before the SAME weighted-mean wire (composes with quantized
+#: wires); "trimmed_mean" / "coordinate_median" replace the psum-shaped
+#: exchange with a cross-site gather and reduce per coordinate over the
+#: global site axis — the classic byzantine-robust estimators, at a
+#: genuinely larger wire (every site's payload must reach every device).
+ROBUST_AGGS = ("none", "norm_clip", "trimmed_mean", "coordinate_median")
+
+
+def _sorted_site_axis(vals, weight):
+    """Sort ``vals [S, ...]`` along the site axis per coordinate and carry
+    the per-site weights with each coordinate's permutation. Returns
+    ``(v_sorted, w_sorted, cum, total)`` where ``cum`` is the inclusive
+    cumulative weight in sorted order and ``total`` the (broadcastable)
+    weight total."""
+    order = jnp.argsort(vals, axis=0)
+    v_sorted = jnp.take_along_axis(vals, order, axis=0)
+    w = jnp.asarray(weight, jnp.float32).reshape(
+        (vals.shape[0],) + (1,) * (vals.ndim - 1)
+    )
+    w_sorted = jnp.take_along_axis(
+        jnp.broadcast_to(w, vals.shape), order, axis=0
+    )
+    cum = jnp.cumsum(w_sorted, axis=0)
+    return v_sorted, w_sorted, cum, cum[-1:]
+
+
+def weighted_trimmed_mean(vals, weight, trim_frac: float):
+    """Per-coordinate WEIGHTED trimmed mean over the leading site axis:
+    sort each coordinate's S values, drop ``trim_frac`` of the total live
+    weight from each tail, average what remains (each sorted entry
+    contributes the overlap of its weight interval with the kept band —
+    exact for fractional trims and for dead sites, whose weight is 0 and
+    who therefore never shift the band). ``trim_frac`` is a trace-time
+    static in [0, 0.5); an all-dead coordinate (total weight 0) reduces to
+    0, matching the weighted mean's zero-total guard."""
+    # factory kwarg, never a tracer: TrainConfig.robust_trim_frac is static
+    if not 0.0 <= float(trim_frac) < 0.5:  # jaxlint: disable=R005
+        raise ValueError(
+            f"trim_frac must be in [0, 0.5), got {trim_frac}"
+        )
+    v_sorted, w_sorted, cum, total = _sorted_site_axis(vals, weight)
+    lo = jnp.float32(trim_frac) * total
+    hi = (1.0 - jnp.float32(trim_frac)) * total
+    keep = jnp.clip(
+        jnp.minimum(cum, hi) - jnp.maximum(cum - w_sorted, lo), 0.0, None
+    )
+    denom = jnp.sum(keep, axis=0)
+    out = jnp.sum(keep * v_sorted, axis=0) / jnp.maximum(denom, 1e-12)
+    return jnp.where(total[0] > 0, out, jnp.zeros_like(out))
+
+
+def weighted_coordinate_median(vals, weight):
+    """Per-coordinate WEIGHTED (lower) median over the leading site axis:
+    the sorted value whose cumulative weight interval contains half the
+    total live weight. Dead sites (weight 0) never get selected; an
+    all-dead coordinate reduces to 0 like the weighted mean's zero-total
+    guard. Breakdown point 1/2 — the strongest of the robust reducers, at
+    the same gathered wire as the trimmed mean."""
+    v_sorted, w_sorted, cum, total = _sorted_site_axis(vals, weight)
+    mid = 0.5 * total
+    keep = (
+        (cum - w_sorted < mid) & (cum >= mid) & (w_sorted > 0)
+    ).astype(jnp.float32)
+    out = jnp.sum(keep * v_sorted, axis=0) / jnp.maximum(
+        jnp.sum(keep, axis=0), 1.0
+    )
+    return jnp.where(total[0] > 0, out, jnp.zeros_like(out))
+
+
+def robust_site_reduce(vals, weight, mode: str, trim_frac: float = 0.2):
+    """Dispatch one gathered ``[S, ...]`` payload through the configured
+    robust reducer (``mode`` is a trace-time static)."""
+    if mode == "trimmed_mean":
+        return weighted_trimmed_mean(vals, weight, trim_frac)
+    if mode == "coordinate_median":
+        return weighted_coordinate_median(vals, weight)
+    raise ValueError(f"unknown robust site reducer {mode!r}")
+
+
+def robust_clip_scales(nsq, weight, axis_name, clip_mult: float):
+    """Norm-clip defense: per-site multiplicative clip scales from a ROBUST
+    norm threshold.
+
+    ``nsq`` is each site's squared gradient norm (a scalar under the
+    classic vmapped axes, the ``[K]`` virtual-site vector under a
+    :class:`PackedAxis`); the threshold is ``clip_mult ×`` the live-weighted
+    MEDIAN site norm across the global site axis — an attacker scaling its
+    gradient cannot move a median it does not own, so the clip threshold
+    stays anchored to the honest cohort. The cross-site exchange is two
+    tiny gathers (the per-site norm and weight vectors — modeled in the
+    engines' robust-mode ``wire_shapes``); the gradient payload itself then
+    rides the engine's UNCHANGED weighted-mean wire, which is why norm_clip
+    composes with the quantized wire codecs.
+    """
+    ns_all = site_all_gather(jnp.asarray(nsq, jnp.float32), axis_name)
+    w_all = site_all_gather(jnp.asarray(weight, jnp.float32), axis_name)
+    med = weighted_coordinate_median(jnp.sqrt(ns_all), w_all)
+    tau = jnp.float32(clip_mult) * med
+    norm = jnp.sqrt(jnp.asarray(nsq, jnp.float32))
+    return jnp.where(norm > tau, tau / jnp.maximum(norm, 1e-30), 1.0)
+
+
+def clip_site_gradients(grads, weight, axis_name, clip_mult: float):
+    """Apply the norm-clip defense to a per-site gradient pytree (leaves
+    carry the leading ``[K]`` pack axis under a :class:`PackedAxis`,
+    are unbatched per vmapped member otherwise). Returns the clipped tree;
+    weights are untouched — clipping bounds a hostile site's INFLUENCE,
+    the weighted mean still renormalizes as usual."""
+    packed = isinstance(axis_name, PackedAxis)
+    if packed:
+        k = axis_name.pack
+        nsq = jnp.zeros((k,), jnp.float32)
+        for leaf in jax.tree.leaves(grads):
+            nsq = nsq + jnp.sum(
+                jnp.square(leaf.astype(jnp.float32)).reshape(k, -1), axis=1
+            )
+    else:
+        nsq = jnp.zeros((), jnp.float32)
+        for leaf in jax.tree.leaves(grads):
+            nsq = nsq + jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+    scale = robust_clip_scales(nsq, weight, axis_name, clip_mult)
+    return jax.tree.map(
+        lambda g: (
+            g.astype(jnp.float32)
+            * scale.reshape(scale.shape + (1,) * (g.ndim - scale.ndim))
+        ).astype(g.dtype),
+        grads,
+    )
+
+
 def site_index(axis_name=SITE_AXIS):
     if isinstance(axis_name, PackedAxis):
         # per-device block start: virtual site d*K + j lives at row j of the

@@ -290,11 +290,12 @@ def put_site_inventory(mesh, inventory, input_dtype=None):
     )
 
 
-def put_epoch_plan(mesh, positions, live=None, poison=None):
+def put_epoch_plan(mesh, positions, live=None, poison=None, attack=None):
     """Ship one epoch's compact plan — the ``[S, steps, B]`` int32 index
-    grid plus the optional ``[S, rounds]`` fault masks — to the mesh. This
-    is the ENTIRE per-epoch host→device traffic of the device pipeline:
-    index-plan bytes, not dataset bytes."""
+    grid plus the optional ``[S, rounds]`` fault masks and attack-code mask
+    (robustness/attacks.py, r17) — to the mesh. This is the ENTIRE
+    per-epoch host→device traffic of the device pipeline: index-plan bytes,
+    not dataset bytes."""
     import jax.numpy as jnp
 
     def put(a):
@@ -304,6 +305,7 @@ def put_epoch_plan(mesh, positions, live=None, poison=None):
         put(positions),
         None if live is None else put(live),
         None if poison is None else put(poison),
+        None if attack is None else put(attack),
     )
 
 

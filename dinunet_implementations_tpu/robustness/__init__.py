@@ -22,13 +22,25 @@ that contract:
 - :mod:`membership` — the elastic-rounds membership table (r13): logical
   sites mapped onto a fixed padded virtual-site axis, join/leave/rejoin as
   pure state transitions with generation counters and host-side slot-state
-  resets — churn never retraces the epoch program.
+  resets — churn never retraces the epoch program;
+- :mod:`attacks` — :class:`AttackPlan`, the hostile twin of FaultPlan (r17):
+  declarative byzantine-site attacks (sign-flip, gradient scaling, additive
+  noise, free-riding, colluding cliques) rendered into a traced ``[S,
+  rounds]`` code mask; defenses are the engines' ``robust_agg`` reducers
+  (parallel/collectives.py) plus the anomaly-scored reputation layer riding
+  :mod:`health`.
 
 The liveness-mask/quarantine math itself lives *inside* the compiled epoch
 (trainer/steps.py + the engines' ``live`` argument): masks are traced array
 inputs, so a different fault pattern never recompiles the program.
 """
 
+from .attacks import (
+    AttackPlan,
+    attack_window,
+    make_attack_fn,
+    parse_attack_plan,
+)
 from .faults import FaultPlan, fault_window, parse_fault_plan, poison_inputs
 from .health import default_health, health_summary
 from .membership import (
@@ -42,6 +54,10 @@ from .preemption import Preempted, PreemptionGuard
 from .retry import RetryTimeout, with_retry
 
 __all__ = [
+    "AttackPlan",
+    "attack_window",
+    "make_attack_fn",
+    "parse_attack_plan",
     "FaultPlan",
     "fault_window",
     "MembershipError",

@@ -1,0 +1,308 @@
+"""Declarative byzantine-site attack injection — the hostile twin of
+:mod:`.faults`.
+
+An :class:`AttackPlan` describes, in *global round* coordinates, which sites
+behave adversarially and how. Where a :class:`~.faults.FaultPlan` models
+sites that FAIL (drops, stragglers, data corruption), an AttackPlan models
+sites that LIE: their local training runs normally, but the gradient they
+hand the aggregation engine is adversarially transformed. Five attack
+families, each a list of ``(site, first_round, last_round)`` windows
+(inclusive; ``last_round = -1`` means "until the end of training"):
+
+- ``sign_flip`` — the classic model-destruction attack: the site ships
+  ``-g`` (steepest ASCENT) at full claimed example weight;
+- ``scale`` — gradient-scaling: ``scale_factor · g`` (default 10×), the
+  model-steering amplification attack;
+- ``noise`` — additive Gaussian noise ``g + noise_std · ε`` with ε drawn
+  per (site, round, leaf) from a counter-based key, so the attack replays
+  identically regardless of epoch chunking or resume point;
+- ``free_rider`` — the site ships an all-zero gradient while still claiming
+  its example weight (diluting the honest mean without training);
+- ``collude`` — a colluding clique: every attacking site ships the SAME
+  pseudo-random direction (keyed by round only, identical across clique
+  members) scaled to ``collude_scale ×`` its own gradient norm — the
+  coordinated attack that defeats per-site outlier tests and stresses the
+  trimmed-mean breakdown point.
+
+Execution model (trainer/steps.py): :func:`attack_window` renders the plan
+into an ``[S, rounds]`` int32 CODE mask for the epoch's global round window
+— one attack code per (site, round) cell — fed to the compiled epoch as a
+TRACED input exactly like the FaultPlan liveness mask. The static transform
+parameters (``scale_factor``, ``noise_std``, seeds) are closed over at trace
+time (:func:`make_attack_fn`), so ONE program per fit covers every
+(site, round) pattern of the plan — CompileGuard-asserted in the bench/CI
+smokes — and the plan composes freely with FaultPlan drops/delays/NaN
+poisoning and with site packing (``site`` ids are VIRTUAL site ids; the
+``[S, rounds]`` mask shards ``P(site)`` into per-device ``[K, rounds]``
+blocks like every other per-site input).
+
+Attacks are applied to the site's ROUND GRADIENT, before the engine's
+aggregation (and before compression for rankDAD/powerSGD) — the attacker
+controls what it ships, not what the honest sites compute. Defense lives in
+the engines' ``robust_agg`` reducers (engines/, parallel/collectives.py)
+and the anomaly-scored reputation layer (health.py, trainer/steps.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+# attack codes in the [S, rounds] mask (0 = honest). Order is the overlap
+# precedence: a (site, round) cell may carry ONE attack; overlapping windows
+# are rejected at plan construction so the declared plan is unambiguous.
+ATTACK_NONE = 0
+ATTACK_SIGN_FLIP = 1
+ATTACK_SCALE = 2
+ATTACK_NOISE = 3
+ATTACK_FREE_RIDER = 4
+ATTACK_COLLUDE = 5
+
+#: field name -> code, in declaration order (the JSON surface)
+ATTACK_FIELDS = {
+    "sign_flip": ATTACK_SIGN_FLIP,
+    "scale": ATTACK_SCALE,
+    "noise": ATTACK_NOISE,
+    "free_rider": ATTACK_FREE_RIDER,
+    "collude": ATTACK_COLLUDE,
+}
+
+
+def _windows(rows, name: str) -> tuple:
+    out = []
+    for row in rows:
+        row = tuple(int(v) for v in row)
+        if len(row) != 3:
+            raise ValueError(
+                f"AttackPlan.{name} entries need (site, first_round, "
+                f"last_round) triples, got {row!r}"
+            )
+        site, first, last = row
+        if site < 0 or first < 0 or (last != -1 and last < first):
+            raise ValueError(f"bad AttackPlan.{name} entry {row}")
+        out.append(row)
+    return tuple(out)
+
+
+@dataclass(frozen=True)
+class AttackPlan:
+    """Deterministic byzantine-attack schedule in global-round coordinates."""
+
+    sign_flip: tuple = ()  # (site, first_round, last_round) triples; -1 = forever
+    scale: tuple = ()
+    scale_factor: float = 10.0
+    noise: tuple = ()
+    noise_std: float = 1.0
+    noise_seed: int = 0
+    free_rider: tuple = ()
+    collude: tuple = ()
+    collude_seed: int = 0
+    collude_scale: float = 5.0
+
+    def __post_init__(self):
+        for name in ATTACK_FIELDS:
+            object.__setattr__(self, name, _windows(getattr(self, name), name))
+        if float(self.noise_std) < 0.0:
+            raise ValueError(f"AttackPlan.noise_std must be >= 0, got {self.noise_std}")
+        # one attack per (site, round) cell: overlapping windows on the same
+        # site would make the rendered code mask depend on field order —
+        # reject them so the declared plan is unambiguous
+        spans = []
+        for name in ATTACK_FIELDS:
+            for site, first, last in getattr(self, name):
+                spans.append((site, first, last, name))
+        for i, (s, f, l, n) in enumerate(spans):
+            for s2, f2, l2, n2 in spans[i + 1:]:
+                if s != s2:
+                    continue
+                hi, hi2 = (np.inf if l == -1 else l), (np.inf if l2 == -1 else l2)
+                if f <= hi2 and f2 <= hi:
+                    raise ValueError(
+                        f"AttackPlan windows overlap on site {s}: "
+                        f"{n}[{f}, {l}] vs {n2}[{f2}, {l2}] — one attack "
+                        "per (site, round) cell"
+                    )
+
+    # -- round-window mask generation ------------------------------------
+
+    def codes(self, num_sites: int, round_start: int, num_rounds: int) -> np.ndarray:
+        """``[num_sites, num_rounds]`` int32 attack-code mask for the round
+        window ``[round_start, round_start + num_rounds)`` (0 = honest)."""
+        mask = np.zeros((num_sites, num_rounds), np.int32)
+        for name, code in ATTACK_FIELDS.items():
+            for site, first, last in getattr(self, name):
+                if site >= num_sites:
+                    continue
+                lo = max(first - round_start, 0)
+                hi = num_rounds if last == -1 else min(
+                    last + 1 - round_start, num_rounds
+                )
+                if lo < hi:
+                    mask[site, lo:hi] = code
+        return mask
+
+    def attacker_sites(self) -> tuple:
+        """Sorted distinct site ids the plan ever attacks from."""
+        sites = set()
+        for name in ATTACK_FIELDS:
+            sites.update(site for site, _, _ in getattr(self, name))
+        return tuple(sorted(sites))
+
+    def injects_attacks(self) -> bool:
+        return any(getattr(self, name) for name in ATTACK_FIELDS)
+
+    # -- JSON round-trip (CLI / bench surface) ---------------------------
+
+    def to_json(self) -> dict:
+        return {
+            "sign_flip": [list(t) for t in self.sign_flip],
+            "scale": [list(t) for t in self.scale],
+            "scale_factor": self.scale_factor,
+            "noise": [list(t) for t in self.noise],
+            "noise_std": self.noise_std,
+            "noise_seed": self.noise_seed,
+            "free_rider": [list(t) for t in self.free_rider],
+            "collude": [list(t) for t in self.collude],
+            "collude_seed": self.collude_seed,
+            "collude_scale": self.collude_scale,
+        }
+
+    @classmethod
+    def from_json(cls, spec) -> "AttackPlan":
+        """Build from a dict or a JSON string (the CLI/bench flag payload)."""
+        if isinstance(spec, (str, bytes)):
+            spec = json.loads(spec)
+        if not isinstance(spec, dict):
+            raise ValueError(f"AttackPlan spec must be a JSON object, got {type(spec)}")
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(spec) - known
+        if unknown:
+            raise ValueError(
+                f"unknown AttackPlan keys {sorted(unknown)} (have {sorted(known)})"
+            )
+        return cls(**spec)
+
+
+def parse_attack_plan(arg: str | None) -> AttackPlan | None:
+    """Parse the ``--attacks`` flag: inline JSON, or ``@path`` to a JSON file."""
+    if not arg:
+        return None
+    if arg.startswith("@"):
+        with open(arg[1:]) as fh:
+            return AttackPlan.from_json(fh.read())
+    if os.path.exists(arg):  # a bare path also works
+        with open(arg) as fh:
+            return AttackPlan.from_json(fh.read())
+    return AttackPlan.from_json(arg)
+
+
+def attack_window(plan: AttackPlan | None, num_sites: int, round0: int,
+                  rounds: int):
+    """The per-epoch ``[S, rounds]`` attack-code mask for the global round
+    window ``[round0, round0 + rounds)``, or ``None`` when the plan attacks
+    nothing — the one place both input pipelines derive the window math from
+    (the :func:`~.faults.fault_window` pattern)."""
+    if plan is None or not plan.injects_attacks():
+        return None
+    return plan.codes(num_sites, round0, rounds)
+
+
+def make_attack_fn(plan: AttackPlan):
+    """Build the traced per-site gradient transform for ``plan``.
+
+    Returns ``attack(site_grad, code, rnd, site_ix) -> site_grad`` operating
+    on ONE site's (unbatched) gradient pytree: ``code`` is the site's int32
+    attack code for this round (a traced value from the ``[S, rounds]``
+    mask), ``rnd`` the global round counter, ``site_ix`` the global virtual
+    site id (``jax.lax.axis_index`` over the bound site axes — identical
+    under packing and the vmap fold, so attacks replay bit-identically
+    across topologies). The transform parameters are trace-time statics
+    closed over from the plan; noise/collusion directions come from
+    counter-based keys ``(seed, site, round)`` / ``(seed, round)``, so the
+    attack pattern is independent of epoch chunking and resume point.
+
+    All branches are ``jnp.where`` selects on the traced code — one compiled
+    program per plan SHAPE (which attack families are present), never per
+    pattern. NaN-safe by construction only in the sense that an attacked
+    gradient that was already non-finite (FaultPlan NaN poisoning on the
+    same cell) stays non-finite and is caught by the liveness gate.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    has_noise = bool(plan.noise)
+    has_collude = bool(plan.collude)
+    has_scalework = bool(plan.sign_flip or plan.scale or plan.free_rider)
+    scale_factor = float(plan.scale_factor)
+    noise_std = float(plan.noise_std)
+    collude_scale = float(plan.collude_scale)
+
+    def attack(site_grad, code, rnd, site_ix):
+        leaves, treedef = jax.tree.flatten(site_grad)
+        out = list(leaves)
+        if has_scalework:
+            # sign_flip / scale / free_rider are all one multiplicative gate
+            mult = jnp.where(
+                code == ATTACK_SIGN_FLIP, jnp.float32(-1.0),
+                jnp.where(
+                    code == ATTACK_SCALE, jnp.float32(scale_factor),
+                    jnp.where(
+                        code == ATTACK_FREE_RIDER, jnp.float32(0.0),
+                        jnp.float32(1.0),
+                    ),
+                ),
+            )
+            out = [
+                (g.astype(jnp.float32) * mult).astype(g.dtype) for g in out
+            ]
+        if has_noise:
+            key = jax.random.fold_in(
+                jax.random.fold_in(
+                    jax.random.PRNGKey(plan.noise_seed), site_ix
+                ),
+                rnd,
+            )
+            noisy = code == ATTACK_NOISE
+            out = [
+                jnp.where(
+                    noisy,
+                    g + (noise_std * jax.random.normal(
+                        jax.random.fold_in(key, i), g.shape, jnp.float32
+                    )).astype(g.dtype),
+                    g,
+                )
+                for i, g in enumerate(out)
+            ]
+        if has_collude:
+            # the whole clique ships ONE shared direction per round (keyed by
+            # round only), scaled to collude_scale × this site's own gradient
+            # norm — coordinated, magnitude-plausible, outlier-test-resistant
+            ckey = jax.random.fold_in(
+                jax.random.PRNGKey(plan.collude_seed), rnd
+            )
+            dirs = [
+                jax.random.normal(
+                    jax.random.fold_in(ckey, i), g.shape, jnp.float32
+                )
+                for i, g in enumerate(leaves)
+            ]
+            gsq = jnp.zeros((), jnp.float32)
+            dsq = jnp.zeros((), jnp.float32)
+            for g, d in zip(leaves, dirs):
+                gsq = gsq + jnp.sum(jnp.square(g.astype(jnp.float32)))
+                dsq = dsq + jnp.sum(jnp.square(d))
+            mag = collude_scale * jnp.sqrt(gsq) / jnp.maximum(
+                jnp.sqrt(dsq), 1e-30
+            )
+            colluding = code == ATTACK_COLLUDE
+            out = [
+                jnp.where(colluding, (d * mag).astype(g.dtype), g)
+                for g, d in zip(out, dirs)
+            ]
+        return jax.tree.unflatten(treedef, out)
+
+    return attack

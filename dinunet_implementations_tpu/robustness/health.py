@@ -18,30 +18,73 @@ whole device:
   site is zero-weighted for the rest of the fit; params keep advancing on the
   live sites' aggregate.
 
+Reputation layer (r17 — hostile sites; present only when a robust
+aggregation mode is active, ``TrainConfig.robust_agg != "none"``, so the
+legacy program stays lowering-identical otherwise):
+
+- ``suspect_streak`` — consecutive rounds this site's anomaly z-score (the
+  max of its distance-to-robust-aggregate z and gradient-norm z across the
+  live cohort, computed on-device in the rounds scan — trainer/steps.py)
+  exceeded ``TrainConfig.reputation_z``; resets the round it drops back;
+- ``anomaly`` — exponential moving average of the positive part of that
+  z-score (decay 0.9 per live round; held across rounds the site sat out) —
+  the per-site reputation score surfaced in ``logs.json``, the telemetry
+  sink and the live ``/statusz`` bus.
+
+``suspect_streak`` feeds the SAME sticky-quarantine machinery as the
+non-finite streak: once it reaches ``TrainConfig.reputation_rounds`` the
+``quarantined`` flag latches and the site is zero-weighted for the rest of
+the fit — a persistent byzantine site is excluded exactly like a NaN site.
+
 The counters ride the checkpoint payload, so a resumed run keeps its
-quarantine decisions.
+quarantine decisions; a rejoining site's slot is zeroed wholesale
+(robustness/membership.py ``reset_slot_state`` tree-maps over every health
+leaf, the reputation fields included), so a new generation starts with a
+clean reputation.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
+#: health keys added by the reputation layer (robust_agg != "none")
+REPUTATION_KEYS = ("suspect_streak", "anomaly")
 
-def default_health(num_sites: int) -> dict:
-    """Fresh all-healthy counters with the per-site leading axis."""
+
+def default_health(num_sites: int, reputation: bool = False) -> dict:
+    """Fresh all-healthy counters with the per-site leading axis.
+    ``reputation=True`` adds the anomaly-scoring fields (robust-aggregation
+    runs only — the extra carried arrays must not exist in the legacy
+    program)."""
     # jax deferred to the call (trainer paths): robustness/__init__ is
     # imported by the otherwise jax-free data layer (native_io's retry), and
     # an eager jax import here would lock in backend config before scripts
     # like tests/dcn_worker.py get to set platform/device-count knobs
     import jax.numpy as jnp
 
-    # three DISTINCT arrays, not one shared buffer: the epoch program donates
+    # DISTINCT arrays, not one shared buffer: the epoch program donates
     # the carried state (trainer/steps.py donate_state), and XLA rejects the
     # same buffer appearing twice in a donated argument list
-    return {
+    out = {
         "streak": jnp.zeros((num_sites,), jnp.int32),
         "skips": jnp.zeros((num_sites,), jnp.int32),
         "quarantined": jnp.zeros((num_sites,), jnp.int32),
+    }
+    if reputation:
+        out.update(reputation_fields(num_sites))
+    return out
+
+
+def reputation_fields(num_sites: int) -> dict:
+    """Fresh zero reputation-layer health fields (:data:`REPUTATION_KEYS`) —
+    the ONE place their names/dtypes are defined; default_health and the
+    trainer's jit-boundary structure normalization
+    (trainer/steps.py ``_ensure_health``) both build from here."""
+    import jax.numpy as jnp
+
+    return {
+        "suspect_streak": jnp.zeros((num_sites,), jnp.int32),
+        "anomaly": jnp.zeros((num_sites,), jnp.float32),
     }
 
 
@@ -50,8 +93,16 @@ def health_summary(health) -> dict | None:
     with the log-facing key names."""
     if health is None:
         return None
-    return {
+    out = {
         "site_skipped_rounds": [int(v) for v in np.asarray(health["skips"])],
         "site_quarantined": [int(v) for v in np.asarray(health["quarantined"])],
         "site_nonfinite_streak": [int(v) for v in np.asarray(health["streak"])],
     }
+    if all(k in health for k in REPUTATION_KEYS):  # reputation layer (r17)
+        out["site_anomaly_score"] = [
+            float(v) for v in np.asarray(health["anomaly"])
+        ]
+        out["site_suspect_streak"] = [
+            int(v) for v in np.asarray(health["suspect_streak"])
+        ]
+    return out

@@ -159,6 +159,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--process-id", type=int, default=None,
                    help="multi-host runs: this process's rank")
     p.add_argument("--quiet", action="store_true")
+    p.add_argument("--attacks", default=None, metavar="JSON|@FILE",
+                   help="declarative byzantine-site attack injection "
+                        "(robustness/attacks.py AttackPlan): inline JSON or "
+                        '@path — e.g. \'{"sign_flip": [[2, 0, -1]], '
+                        '"scale": [[5, 10, 20]], "scale_factor": 10}\'. '
+                        "Sign-flip / gradient-scaling / additive-noise / "
+                        "free-rider / colluding-clique attacks replay "
+                        "identically run to run and compose with --faults; "
+                        "pair with --robust-agg for the defense")
+    p.add_argument("--robust-agg", default=None,
+                   choices=["none", "norm_clip", "trimmed_mean",
+                            "coordinate_median"],
+                   help="byzantine-robust site-axis aggregation "
+                        "(parallel/collectives.py): norm_clip bounds each "
+                        "site's gradient norm at the robust median "
+                        "(psum wire unchanged); trimmed_mean / "
+                        "coordinate_median reduce per coordinate over a "
+                        "cross-site gather. Non-none also enables the "
+                        "anomaly-scored reputation quarantine "
+                        "(robustness/health.py)")
     p.add_argument("--wire-quant", default=None,
                    choices=["none", "bf16", "int8", "fp8"],
                    help="quantize collective payloads to this wire grid "
@@ -196,6 +216,7 @@ def main(argv: list[str] | None = None) -> int:
         ("pipeline", args.pipeline),
         ("compile_cache_dir", args.compile_cache),
         ("wire_quant", args.wire_quant),
+        ("robust_agg", args.robust_agg),
         ("overlap_rounds", args.overlap_rounds),
         ("fused_poweriter", (
             None if args.fused_poweriter in (None, "auto")
@@ -252,6 +273,15 @@ def main(argv: list[str] | None = None) -> int:
         except (ValueError, OSError, TypeError) as e:
             raise SystemExit(f"--faults: {e}")
 
+    attack_plan = None
+    if args.attacks:
+        from ..robustness.attacks import parse_attack_plan
+
+        try:
+            attack_plan = parse_attack_plan(args.attacks)
+        except (ValueError, OSError, TypeError) as e:
+            raise SystemExit(f"--attacks: {e}")
+
     if args.serve:
         if args.site is not None or args.folds is not None:
             raise SystemExit(
@@ -271,6 +301,7 @@ def main(argv: list[str] | None = None) -> int:
             quorum=args.serve_quorum,
             poll_s=args.serve_poll,
             fault_plan=fault_plan,
+            attack_plan=attack_plan,
             inventory_rows=args.serve_rows,
             resume=args.resume,
             verbose=verbose,
@@ -335,6 +366,11 @@ def main(argv: list[str] | None = None) -> int:
             raise SystemExit(
                 "--faults targets federated rounds; not supported with --site"
             )
+        if attack_plan is not None:
+            raise SystemExit(
+                "--attacks targets federated rounds; not supported with "
+                "--site"
+            )
         from .fed_runner import SiteRunner
 
         from ..checks.sanitize import SanitizerViolation
@@ -358,7 +394,7 @@ def main(argv: list[str] | None = None) -> int:
         from .fed_runner import FedRunner
 
         runner = FedRunner(cfg, data_path=args.data_path, out_dir=args.out_dir,
-                           fault_plan=fault_plan)
+                           fault_plan=fault_plan, attack_plan=attack_plan)
         try:
             results = runner.run(
                 folds=args.folds, verbose=verbose, resume=args.resume

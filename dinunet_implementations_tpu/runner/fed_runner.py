@@ -162,13 +162,16 @@ class FedRunner:
         out_dir: str | None = None,
         mesh="auto",
         fault_plan=None,
+        attack_plan=None,
         **overrides,
     ):
         cfg = (cfg or TrainConfig()).with_overrides(overrides)
         self.data_path = data_path
         # deterministic chaos injection (robustness/faults.py), threaded into
-        # every fold's trainer; None = no faults
+        # every fold's trainer; None = no faults. attack_plan is the hostile
+        # twin (robustness/attacks.py, r17) — byzantine gradient transforms.
         self.fault_plan = fault_plan
+        self.attack_plan = attack_plan
         self.site_dirs = discover_site_dirs(data_path)
         self.site_cfgs = resolve_site_configs(cfg, data_path, num_sites=len(self.site_dirs))
         # owner-scoped fields come from site 0 (the reference GUI sends one
@@ -195,6 +198,7 @@ class FedRunner:
             trainer = FederatedTrainer(
                 self.cfg, get_task(self.cfg.task_id).build_model(self.cfg),
                 self.mesh, out_dir=self.out_dir, fault_plan=self.fault_plan,
+                attack_plan=self.attack_plan,
             )
             # DINUNET_SANITIZE=1 (or CLI --sanitize): compile-counter guard +
             # leak/NaN checking around the fit — each fold's trainer is one
@@ -350,6 +354,7 @@ class FedDaemon:
         poll_s: float = 0.5,
         mesh="auto",
         fault_plan=None,
+        attack_plan=None,
         admission_deadline_s: float = 10.0,
         inventory_rows: int | None = None,
         steps: int | None = None,
@@ -375,6 +380,7 @@ class FedDaemon:
         self.quorum = quorum
         self.poll_s = poll_s
         self.fault_plan = fault_plan
+        self.attack_plan = attack_plan
         self.admission_deadline_s = admission_deadline_s
         self.verbose = verbose
         self.spool_dir = spool_dir or (
@@ -396,6 +402,7 @@ class FedDaemon:
         self.trainer = FederatedTrainer(
             self.cfg, get_task(self.cfg.task_id).build_model(self.cfg),
             mesh, out_dir=self.out_dir, fault_plan=fault_plan, bus=self.bus,
+            attack_plan=attack_plan,
         )
         self.flight = flight if flight is not None else FlightRecorder(
             self.out_dir, bus=self.bus, tracer=self.trainer.tracer,
@@ -441,6 +448,7 @@ class FedDaemon:
                     "serve",
                 ),
                 self.cfg, mesh=self.mesh, fold=0, tracer=self.trainer.tracer,
+                fault_plan=fault_plan, attack_plan=attack_plan,
             )
         resumed = self._resume() if resume else False
         if not resumed and data_path:

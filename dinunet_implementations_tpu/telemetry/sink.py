@@ -38,11 +38,15 @@ METRICS_FILE = "metrics.jsonl"
 TRACE_JSONL_FILE = "trace.jsonl"
 TRACE_CHROME_FILE = "trace.chrome.json"
 
-#: manifest keys every consumer may rely on
+#: manifest keys every consumer may rely on. fault_plan / attack_plan are
+#: REQUIRED (null when no chaos/attack was injected): a fault- or
+#: attack-arm's artifact must be reproducible from the manifest alone —
+#: before r17 only the config hash landed there and the active plan JSON
+#: lived in the shell history.
 MANIFEST_REQUIRED = frozenset({
     "schema_version", "config_hash", "task_id", "agg_engine", "num_sites",
     "pipeline", "fold", "jax_version", "jaxlib_version", "backend", "mesh",
-    "package_version", "git_rev",
+    "package_version", "git_rev", "fault_plan", "attack_plan",
 })
 
 #: required metrics.jsonl keys by row kind
@@ -123,7 +127,8 @@ def mesh_topology(mesh) -> dict | None:
     return {str(k): int(v) for k, v in dict(mesh.shape).items()}
 
 
-def build_manifest(cfg, mesh=None, fold: int = 0) -> dict:
+def build_manifest(cfg, mesh=None, fold: int = 0, fault_plan=None,
+                   attack_plan=None) -> dict:
     import jax
     import jaxlib
 
@@ -143,6 +148,12 @@ def build_manifest(cfg, mesh=None, fold: int = 0) -> dict:
         "mesh": mesh_topology(mesh),
         "package_version": __version__,
         "git_rev": _git_rev(),
+        # the active chaos/attack plans, verbatim (null = none): a fault or
+        # attack arm is reproducible from the artifact alone (r17)
+        "fault_plan": fault_plan.to_json() if fault_plan is not None else None,
+        "attack_plan": (
+            attack_plan.to_json() if attack_plan is not None else None
+        ),
         "config": cfg.to_dict(),
     }
 
@@ -161,9 +172,13 @@ class FitTelemetry:
 
     @classmethod
     def open(cls, dirpath: str, cfg, mesh=None, fold: int = 0,
-             tracer: SpanTracer | None = None) -> "FitTelemetry":
+             tracer: SpanTracer | None = None, fault_plan=None,
+             attack_plan=None) -> "FitTelemetry":
         sink = cls(dirpath, tracer or SpanTracer())
-        manifest = build_manifest(cfg, mesh=mesh, fold=fold)
+        manifest = build_manifest(
+            cfg, mesh=mesh, fold=fold, fault_plan=fault_plan,
+            attack_plan=attack_plan,
+        )
         with open(os.path.join(dirpath, MANIFEST_FILE), "w") as fh:
             json.dump(manifest, fh, indent=2, default=str)
         # truncate any stale rows from a previous run of this fold — rows

@@ -115,14 +115,28 @@ def health_log_fields(site_health: dict | None, site_index: int | None = None) -
     if not site_health:
         return {}
     if site_index is None:
-        return {
+        out = {
             "site_skipped_rounds": list(site_health["site_skipped_rounds"]),
             "site_quarantined": list(site_health["site_quarantined"]),
         }
-    return {
+        if "site_anomaly_score" in site_health:  # reputation layer (r17)
+            out["site_anomaly_score"] = [
+                round(v, 6) for v in site_health["site_anomaly_score"]
+            ]
+            out["site_suspect_streak"] = list(
+                site_health["site_suspect_streak"]
+            )
+        return out
+    out = {
         "skipped_rounds": site_health["site_skipped_rounds"][site_index],
         "quarantined": site_health["site_quarantined"][site_index],
     }
+    if "site_anomaly_score" in site_health:
+        out["anomaly_score"] = round(
+            site_health["site_anomaly_score"][site_index], 6
+        )
+        out["suspect_streak"] = site_health["site_suspect_streak"][site_index]
+    return out
 
 
 def telemetry_log_fields(summary: dict | None, site_index: int | None = None) -> dict:

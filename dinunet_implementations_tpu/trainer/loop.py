@@ -69,7 +69,7 @@ from .steps import (
 
 class FederatedTrainer:
     def __init__(self, cfg: TrainConfig, model, mesh=None, out_dir: str | None = None,
-                 fault_plan=None, bus=None):
+                 fault_plan=None, bus=None, attack_plan=None):
         """``mesh=None`` folds all sites onto the local device via vmap (one
         chip simulating N sites); a mesh with a ``site`` axis runs the sites
         across its members — one per device slice, or PACKED ``K = S /
@@ -79,17 +79,26 @@ class FederatedTrainer:
         optional :class:`~..robustness.faults.FaultPlan` — deterministic
         chaos injection (site drops / NaN poisoning / kill-at-round) threaded
         through the data layer and epoch inputs; masks are traced arrays, so
-        injecting faults never changes the compiled program."""
+        injecting faults never changes the compiled program. ``attack_plan``
+        is the hostile twin (robustness/attacks.py AttackPlan, r17):
+        byzantine gradient transforms injected as a traced ``[S, rounds]``
+        code mask — composes with the fault plan; defenses ride
+        ``cfg.robust_agg``."""
         self.cfg = cfg
         self.mesh = mesh
         self.out_dir = out_dir
         self.fault_plan = fault_plan
+        self.attack_plan = attack_plan
         self.task = FederatedTask(model)
         task_args = dataclasses.asdict(cfg.task_args())
         self.engine = make_engine(
             cfg.agg_engine, precision_bits=cfg.precision_bits, seed=cfg.seed,
             wire_quant=cfg.wire_quant, wire_stochastic=cfg.wire_stochastic,
-            fused_poweriter=cfg.fused_poweriter, **task_args
+            fused_poweriter=cfg.fused_poweriter,
+            robust_agg=cfg.robust_agg,
+            robust_trim_frac=cfg.robust_trim_frac,
+            robust_clip_mult=cfg.robust_clip_mult,
+            **task_args
         )
         self.optimizer = make_optimizer(cfg.optimizer, cfg.learning_rate)
         if cfg.pipeline not in ("device", "host"):
@@ -143,6 +152,10 @@ class FederatedTrainer:
             staleness_bound=cfg.staleness_bound,
             staleness_decay=cfg.staleness_decay,
             overlap_rounds=cfg.overlap_rounds,
+            attack_plan=attack_plan,
+            robust_agg=cfg.robust_agg,
+            reputation_z=cfg.reputation_z,
+            reputation_rounds=cfg.reputation_rounds,
         )
         self.eval_fn = make_eval_fn(self.task, mesh)
         self._inventory = None  # device-resident site inventory, one per fit
@@ -204,6 +217,7 @@ class FederatedTrainer:
             telemetry=self._telemetry_on,
             staleness_bound=self.cfg.staleness_bound,
             overlap_rounds=self.cfg.overlap_rounds,
+            reputation=self.cfg.robust_agg != "none",
         )
         return self._place_state(state)
 
@@ -299,9 +313,19 @@ class FederatedTrainer:
                 nan_mask.astype(np.float32)
                 if nan_mask is not None and self.fault_plan.nan_at else None
             )
+            # hostile-site attack codes for this window (r17,
+            # robustness/attacks.py) — fed whenever the plan attacks at all
+            # (fit-static), same one-program reasoning as the NaN gate
+            from ..robustness.attacks import attack_window
+
+            attack = attack_window(
+                self.attack_plan, plan.num_sites, round0, rounds
+            )
             from ..parallel.distributed import put_epoch_plan
 
-            return put_epoch_plan(self.mesh, plan.positions, live, poison)
+            return put_epoch_plan(
+                self.mesh, plan.positions, live, poison, attack
+            )
 
     def _membership_live(self, live, num_sites: int, rounds: int):
         """Fold the membership occupancy mask (FedDaemon, r13) into an
@@ -328,13 +352,16 @@ class FederatedTrainer:
                     train_sites, epoch, batch_size or self.cfg.batch_size,
                     round0=int(state.round),
                 )
-            idx, live, poison = plan
+            idx, live, poison, attack = plan
             inv_x, inv_y = self._ensure_inventory(train_sites)
             # the device pipeline's ENTIRE per-epoch host→device traffic
             self._last_transfer_bytes = int(sum(
-                a.nbytes for a in (idx, live, poison) if a is not None
+                a.nbytes for a in (idx, live, poison, attack)
+                if a is not None
             ))
-            state, losses = self.epoch_fn(state, inv_x, inv_y, idx, live, poison)
+            state, losses = self.epoch_fn(
+                state, inv_x, inv_y, idx, live, poison, attack
+            )
             return state, np.asarray(losses)
         fb = plan_epoch(
             train_sites,
@@ -370,13 +397,24 @@ class FederatedTrainer:
                     fb.inputs, nan_mask, self.cfg.local_iterations
                 ),
             )
+        # hostile-site attack codes (r17): a traced [S, rounds] input like
+        # the liveness mask, windowed on the same global round counter
+        attack = None
+        if self.attack_plan is not None and self.attack_plan.injects_attacks():
+            from ..robustness.attacks import attack_window
+
+            attack = attack_window(
+                self.attack_plan, fb.num_sites, int(state.round),
+                fb.steps // max(self.cfg.local_iterations, 1),
+            )
         batch = self._put_batch(fb)
         live_dev = self._put_live(live)
+        attack_dev = self._put_live(attack)
         self._last_transfer_bytes = int(
             sum(a.nbytes for a in batch)
-            + (live_dev.nbytes if live_dev is not None else 0)
+            + sum(a.nbytes for a in (live_dev, attack_dev) if a is not None)
         )
-        state, losses = self.epoch_fn(state, *batch, live_dev)
+        state, losses = self.epoch_fn(state, *batch, live_dev, attack_dev)
         return state, np.asarray(losses)
 
     @staticmethod
@@ -588,6 +626,7 @@ class FederatedTrainer:
                 self._fit_tel = FitTelemetry.open(
                     os.path.join(tel_root, f"fold_{fold}"), cfg,
                     mesh=self.mesh, fold=fold, tracer=self.tracer,
+                    fault_plan=self.fault_plan, attack_plan=self.attack_plan,
                 )
                 self._fit_summary = {
                     "kind": "summary", "fold": fold, "epochs_run": 0,
@@ -738,6 +777,30 @@ class FederatedTrainer:
                     self.bus.counter("train_epochs_total")
                     self.bus.counter("train_rounds_total", rounds)
                     self.bus.observe("epoch_ms", e_seconds * 1e3)
+                    if (
+                        self._telemetry_on and state.health is not None
+                        and "anomaly" in state.health
+                    ):
+                        # reputation scores onto the live bus (r17): the
+                        # /statusz surface for "is a site drifting hostile".
+                        # The losses fetch above already synchronized the
+                        # epoch, so these tiny [S] reads add no extra
+                        # device round trip of consequence.
+                        from ..parallel.distributed import fetch_site_outputs
+
+                        anom = fetch_site_outputs(
+                            state.health["anomaly"], self.mesh
+                        )
+                        quar = fetch_site_outputs(
+                            state.health["quarantined"], self.mesh
+                        )
+                        self.bus.gauge(
+                            "train_anomaly_max", float(np.max(anom))
+                        )
+                        self.bus.gauge(
+                            "train_quarantined_sites",
+                            int(np.sum(np.asarray(quar) > 0)),
+                        )
                     if self._fit_tel is not None:
                         self._epoch_row(fold, epoch, epoch_loss, e_start,
                                         state)

@@ -190,6 +190,7 @@ def init_train_state(
     telemetry: bool = False,
     staleness_bound: int = 0,
     overlap_rounds: bool = False,
+    reputation: bool = False,
 ) -> TrainState:
     params, batch_stats = task.init_variables(rng, sample_x)
     site_state = engine.init(params)
@@ -203,7 +204,10 @@ def init_train_state(
         ),
         rng=rng,
         round=jnp.zeros((), jnp.int32),
-        health=default_health(num_sites),
+        # reputation=True adds the r17 anomaly-score fields so the robust
+        # epoch program's carry structure matches from the first call (the
+        # _ensure_health fill would otherwise cost one extra compile)
+        health=default_health(num_sites, reputation=reputation),
         # telemetry accumulators only when the epoch fn will maintain them —
         # a telemetry-carrying state fed to a telemetry-off program would
         # force a structure change (and a recompile) at the jit boundary
@@ -282,6 +286,10 @@ def make_train_epoch_fn(
     staleness_bound: int = 0,
     staleness_decay: float = 0.5,
     overlap_rounds: bool = False,
+    attack_plan=None,
+    robust_agg: str = "none",
+    reputation_z: float = 2.0,
+    reputation_rounds: int = 8,
 ):
     """Build the jitted epoch function.
 
@@ -381,6 +389,31 @@ def make_train_epoch_fn(
     carries ``state.telemetry=None``: the exact pre-telemetry program, same
     pattern as ``quarantine_rounds=-1``.
 
+    Hostile sites (r17 — robustness/attacks.py, parallel/collectives.py):
+    ``attack_plan`` is an optional :class:`~..robustness.attacks.AttackPlan`
+    whose STATIC transform parameters (scale factor, noise σ, seeds) are
+    closed over at trace time; the per-(site, round) attack pattern arrives
+    as ``attack [S, rounds]`` — an int32 CODE mask fed as a traced input
+    exactly like ``live``, so one compiled program per fit covers every
+    pattern of the plan. The transform applies to each site's ROUND
+    GRADIENT inside the per-site phase (before engine compression), and
+    composes freely with FaultPlan drops/delays/NaN poisoning and packing.
+    ``robust_agg`` selects the engines' byzantine-robust site reducer (the
+    engine must be built with the SAME mode — engines/base.py); any value
+    other than ``"none"`` also switches on the anomaly-scored REPUTATION
+    layer: per round, each live site's distance-to-robust-aggregate and
+    gradient-norm z-scores (across the live cohort, on-device scalar psums
+    only) drive ``health.suspect_streak``/``health.anomaly``, and a site
+    whose score exceeds ``reputation_z`` for ``reputation_rounds``
+    CONSECUTIVE rounds trips the same sticky ``quarantined`` flag as a NaN
+    site (``reputation_rounds=0`` scores without quarantining). z-scores
+    need a cohort to stand out from: the threshold must be below
+    ``(S_live - 1)/sqrt(S_live)`` to be reachable at all, so small-S runs
+    lower ``reputation_z`` or rely on the robust reducer alone.
+    ``robust_agg="none"`` (default) compiles ALL of it out — the exact
+    legacy program (S005 "robust-off"); the mask input is rejected unless
+    an attack plan was given.
+
     Site-axis realization (all forms run the *same* per-site program):
 
     - ``mesh`` given → ``shard_map`` over the mesh's ``site`` axis, with
@@ -414,6 +447,27 @@ def make_train_epoch_fn(
     buffered = staleness_bound > 0
     # builder kwarg, never a tracer: the static TrainConfig.overlap_rounds
     overlap = bool(overlap_rounds)  # jaxlint: disable=R005
+    from ..parallel.collectives import ROBUST_AGGS
+
+    if robust_agg not in ROBUST_AGGS:
+        raise ValueError(
+            f"robust_agg must be one of {ROBUST_AGGS}, got {robust_agg!r}"
+        )
+    # trace-time static: the reputation layer exists iff a robust reducer is
+    # active — robust_agg="none" compiles the exact legacy program
+    reputation = robust_agg != "none"
+    if reputation_rounds < 0:
+        raise ValueError(
+            f"reputation_rounds must be >= 0, got {reputation_rounds}"
+        )
+    # the attack transform's static parameters, closed over at trace time
+    # (robustness/attacks.py); the per-(site, round) pattern is a traced
+    # mask, so changing WHO attacks WHEN never recompiles
+    atk = None
+    if attack_plan is not None and attack_plan.injects_attacks():
+        from ..robustness.attacks import make_attack_fn
+
+        atk = make_attack_fn(attack_plan)
     if overlap and buffered:
         raise ValueError(
             "overlap_rounds and staleness_bound > 0 are mutually exclusive: "
@@ -442,7 +496,8 @@ def make_train_epoch_fn(
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
     def epoch_over_sites(state: TrainState, x, y, w, live, site_axes,
-                         inner_axis, inventory=None, poison=None):
+                         inner_axis, inventory=None, poison=None,
+                         attack=None):
         """Run one epoch for the k in-device sites in ``x [k, steps, B, ...]``.
 
         Device pipeline (``inventory`` given): ``x`` is the ``[k, steps, B]``
@@ -512,6 +567,21 @@ def make_train_epoch_fn(
         live_rounds = (
             None if live is None else live[:, :rounds].astype(jnp.float32)
         )
+        # hostile-site attack codes, [k, rounds] int32 (robustness/attacks.py
+        # — 0 = honest; a traced input like `live`, trace-time presence
+        # branch). The mask only works with the plan's static transform
+        # params closed over above.
+        if attack is not None and atk is None:
+            raise ValueError(
+                "an attack mask was fed but no attack_plan was given to "
+                "make_train_epoch_fn (the plan carries the static transform "
+                "parameters)"
+            )
+        attack_rounds = (
+            None if (attack is None or atk is None)
+            else attack[:, :rounds].astype(jnp.int32)
+        )
+        attack_on = attack_rounds is not None
         # trace-time static gate: the fault machinery (isfinite reduction over
         # the gradient tree, where-freezes/selects on engine state, params,
         # opt state, BN stats) compiles in only when quarantine is enabled OR
@@ -520,8 +590,11 @@ def make_train_epoch_fn(
         # buffered-async mode needs the arrival gates, so it implies guard;
         # so does the overlapped-rounds mode (its empty-stash first round is
         # a zero-live-weight round, which only the guarded form holds).
+        # the reputation layer needs the health-updating guarded round; so
+        # does an attack mask (an attacked round must be skippable/scorable)
         guard = (
             quarantine_rounds >= 0 or live is not None or buffered or overlap
+            or reputation or attack_on
         )
         health = state.health  # filled by epoch_fn before any shard_map
         # trace-time static: telemetry accumulators exist iff the epoch was
@@ -579,6 +652,7 @@ def make_train_epoch_fn(
                     parts.pop(0) if live_rounds is not None
                     else jnp.ones((k,), jnp.float32)
                 )
+                ab = parts.pop(0) if attack_on else None
             else:
                 xb, yb, wb = (
                     jax.lax.dynamic_index_in_dim(a, xs, axis=1, keepdims=False)
@@ -589,6 +663,11 @@ def make_train_epoch_fn(
                     else jax.lax.dynamic_index_in_dim(
                         live_rounds, xs, axis=1, keepdims=False
                     )
+                )
+                ab = (
+                    jax.lax.dynamic_index_in_dim(
+                        attack_rounds, xs, axis=1, keepdims=False
+                    ) if attack_on else None
                 )
             if overlap:
                 # overlapped rounds: tie the stashed (previous-round) payload
@@ -615,12 +694,16 @@ def make_train_epoch_fn(
                     xb, yb, wb = jax.vmap(_gather_batch)(inv_x, inv_y, ib, pz)
             rng, sub = jax.random.split(rng)
 
-            def site_micro(xs, ys, ws):
+            def site_micro(xs, ys, ws, ab_site=None):
                 """One site's micro-batch gradient phase — shared by the
                 packed and classic forms (always under the inner vmap;
                 ``axis_index`` linearizes to the global, device-major site id
                 for the dropout-RNG fold, so packed and unpacked runs draw
-                identical keys)."""
+                identical keys). ``ab_site`` is this site's attack code for
+                the round (robustness/attacks.py) — the byzantine transform
+                applies to the finished round gradient, before any engine
+                compression, keyed by the GLOBAL site id and round so the
+                attack replays bit-identically across topologies."""
                 site_ix = jax.lax.axis_index(site_axes)
 
                 def micro(acc, mb):
@@ -646,6 +729,8 @@ def make_train_epoch_fn(
                 site_grad = jax.tree.map(
                     lambda g: g / jnp.maximum(n_sum, 1.0), g_sum
                 )
+                if attack_on:
+                    site_grad = atk(site_grad, ab_site, rnd, site_ix)
                 return site_grad, n_sum, new_stats, loss_sums.sum()
 
             def _ts_round_site(ts, site_grad, agg):
@@ -765,6 +850,58 @@ def make_train_epoch_fn(
                     "quarantined": quarantined,
                 }
 
+            def _reputation_round(hs_prev, hs_new, dsq, nsq, contribute, rsum):
+                """Anomaly-scored reputation (r17): z-scores of this round's
+                distance-to-(robust)-aggregate and gradient norm across the
+                LIVE cohort — cross-site exchange is four scalar psums, so
+                the engines' wire models are untouched. A live site whose
+                max z exceeds ``reputation_z`` extends its suspect streak;
+                ``reputation_rounds`` CONSECUTIVE suspect rounds latch the
+                same sticky quarantine flag a NaN streak does. A site
+                sitting the round out (drop, straggle, quarantine) holds
+                both its streak and its EMA score — absence is not
+                evidence either way. Elementwise over the scalar and
+                [k]-vector forms like :func:`_health_round`."""
+                livef = (contribute > 0).astype(jnp.float32)
+                n_live = jnp.maximum(rsum(livef), 1.0)
+
+                def z_of(x):
+                    xf = jnp.where(livef > 0, x, 0.0)
+                    m1 = rsum(xf) / n_live
+                    m2 = rsum(xf * xf) / n_live
+                    std = jnp.sqrt(jnp.maximum(m2 - m1 * m1, 0.0))
+                    return (x - m1) / jnp.maximum(std, 1e-12)
+
+                # norms, not squares: closer to Gaussian, so the z threshold
+                # means the same thing across engines and models. A
+                # non-finite site's NaN score propagates to z = NaN, which
+                # fails every comparison — it is scored by the NaN streak
+                # machinery, not the reputation layer.
+                z = jnp.maximum(
+                    z_of(jnp.sqrt(jnp.maximum(dsq, 0.0))),
+                    z_of(jnp.sqrt(jnp.maximum(nsq, 0.0))),
+                )
+                suspect = (z > reputation_z) & (contribute > 0)
+                streak = jnp.where(
+                    suspect, hs_prev["suspect_streak"] + 1,
+                    jnp.where(contribute > 0, 0, hs_prev["suspect_streak"]),
+                )
+                quarantined = hs_new["quarantined"]
+                if reputation_rounds > 0:
+                    quarantined = jnp.maximum(
+                        quarantined,
+                        (streak >= reputation_rounds).astype(jnp.int32),
+                    )
+                anomaly = jnp.where(
+                    contribute > 0,
+                    0.9 * hs_prev["anomaly"] + 0.1 * jnp.maximum(z, 0.0),
+                    hs_prev["anomaly"],
+                )
+                return {
+                    **hs_new, "suspect_streak": streak,
+                    "quarantined": quarantined, "anomaly": anomaly,
+                }
+
             def packed_apply(hs, ts, bf, ls, es, site_grad, n_sum, stats_k,
                              loss_site):
                 """The communicate/apply half of the two-level round, on an
@@ -863,14 +1000,21 @@ def make_train_epoch_fn(
                     lambda v: two_level_psum(v, pax),
                 )
                 hs_new = _health_round(hs, finite, contribute)
-                ts_new = (
-                    None if ts is None
-                    else _ts_round(
-                        ts, gsq,
-                        _rows_sq_sum(jax.tree.map(
-                            lambda g, a: g - a[None], site_grad, agg
-                        )),
+                # ONE distance-to-aggregate figure serves both consumers:
+                # the reputation z-score and the telemetry residual
+                res_sq = (
+                    _rows_sq_sum(jax.tree.map(
+                        lambda g, a: g - a[None], site_grad, agg
+                    ))
+                    if (reputation or ts is not None) else None
+                )
+                if reputation:
+                    hs_new = _reputation_round(
+                        hs, hs_new, res_sq, _rows_sq_sum(site_grad),
+                        contribute, lambda v: two_level_psum(v, pax),
                     )
+                ts_new = (
+                    None if ts is None else _ts_round(ts, gsq, res_sq)
                 )
                 return (agg, es_new, hs_new, ts_new, bf, stats_out, loss_round,
                         total_live)
@@ -880,7 +1024,7 @@ def make_train_epoch_fn(
                 then :func:`packed_apply` on this round's fresh payload."""
                 site_grad, n_sum, stats_k, loss_site = jax.vmap(
                     site_micro, axis_name=inner_axis
-                )(xb, yb, wb)
+                )(xb, yb, wb, *(() if ab is None else (ab,)))
                 return packed_apply(
                     hs, ts, bf, ls, es, site_grad, n_sum, stats_k, loss_site
                 )
@@ -962,11 +1106,23 @@ def make_train_epoch_fn(
                     lambda v: jax.lax.psum(v, site_axes),
                 )
                 hs_new = _health_round(hs, finite, contribute)
+                if reputation:
+                    hs_new = _reputation_round(
+                        hs, hs_new,
+                        tree_sq_sum(
+                            jax.tree.map(lambda g, a: g - a, site_grad, agg)
+                        ),
+                        tree_sq_sum(site_grad),
+                        contribute,
+                        lambda v: jax.lax.psum(v, site_axes),
+                    )
                 return (agg, es_new, hs_new, _ts_round_site(ts, site_grad, agg),
                         bf, new_stats, loss_round, total_live)
 
-            def site_part(es, hs, ts, bf, ls, xs, ys, ws):
-                site_grad, n_sum, new_stats, loss_sum = site_micro(xs, ys, ws)
+            def site_part(es, hs, ts, bf, ls, xs, ys, ws, ab_site=None):
+                site_grad, n_sum, new_stats, loss_sum = site_micro(
+                    xs, ys, ws, ab_site
+                )
                 return site_apply(
                     es, hs, ts, bf, ls, site_grad, n_sum, new_stats, loss_sum
                 )
@@ -981,7 +1137,7 @@ def make_train_epoch_fn(
                 # first round must not count skips or accumulate rounds.
                 fresh_grad, fresh_n, fresh_stats, fresh_loss = jax.vmap(
                     site_micro, axis_name=inner_axis
-                )(xb, yb, wb)
+                )(xb, yb, wb, *(() if ab is None else (ab,)))
                 ls_prev = ov["live"] * ov["valid"]
                 if packed:
                     (agg, es_new, hs_new, ts_new, buffers, batch_stats,
@@ -1033,11 +1189,13 @@ def make_train_epoch_fn(
                     health, telem_st, buffers, lb, engine_state
                 )
             else:
+                n_in = 8 + (0 if ab is None else 1)
                 (agg, engine_state, health, telem_k, buffers, stats_k, loss_k,
                  tl_k) = jax.vmap(
-                    site_part, in_axes=(0, 0, 0, 0, 0, 0, 0, 0),
+                    site_part, in_axes=(0,) * n_in,
                     out_axes=(0, 0, 0, 0, 0, 0, 0, 0), axis_name=inner_axis,
-                )(engine_state, health, telem_st, buffers, lb, xb, yb, wb)
+                )(engine_state, health, telem_st, buffers, lb, xb, yb, wb,
+                  *(() if ab is None else (ab,)))
                 # agg/stats/loss are psum'd over site_axes → identical across
                 # the k in-device rows; collapse to one copy and update once
                 agg = jax.tree.map(lambda a: a[0], agg)
@@ -1117,6 +1275,8 @@ def make_train_epoch_fn(
                 )
             if live_rounds is not None:
                 xs = xs + (jnp.moveaxis(live_rounds, 1, 0),)
+            if attack_rounds is not None:
+                xs = xs + (jnp.moveaxis(attack_rounds, 1, 0),)
         else:
             xs = jnp.arange(rounds)
         (params, stats, opt_state, engine_state, health, telem_out, buf_out,
@@ -1145,7 +1305,29 @@ def make_train_epoch_fn(
             state.health is None
             or state.health["streak"].shape[0] != inputs.shape[0]
         ):
-            state = state.replace(health=default_health(inputs.shape[0]))
+            state = state.replace(
+                health=default_health(inputs.shape[0], reputation=reputation)
+            )
+        # the reputation fields (r17) mirror the robust_agg flag this epoch
+        # was built with, same trace-time normalization as telemetry: a
+        # robust run resumed from a legacy checkpoint gains fresh zero
+        # scores (the 3 legacy counters survive), a legacy run resumed from
+        # a robust checkpoint drops them — the program form is stable per
+        # flag either way
+        elif reputation and "suspect_streak" not in state.health:
+            from ..robustness.health import reputation_fields
+
+            state = state.replace(health={
+                **state.health,
+                **reputation_fields(state.health["streak"].shape[0]),
+            })
+        elif not reputation and "suspect_streak" in state.health:
+            from ..robustness.health import REPUTATION_KEYS
+
+            state = state.replace(health={
+                k: v for k, v in state.health.items()
+                if k not in REPUTATION_KEYS
+            })
         # telemetry accumulators mirror the flag this epoch was built with:
         # off drops any carried accumulators (a checkpoint from a telemetry
         # run resumed with telemetry off — the program stays the legacy
@@ -1202,22 +1384,26 @@ def make_train_epoch_fn(
     if pipeline == "device" and mesh is not None:
 
         def epoch_fn_impl(state: TrainState, inv_x, inv_y, idx, live=None,
-                          poison=None):
+                          poison=None, attack=None):
             state = _ensure_health(state, idx)
             specs = _state_specs(state)
-            # optional traced inputs (liveness / NaN gate): trace-time
-            # presence branches, one compiled program per form — a fit feeds
-            # a fixed form, so the compile counter still sees one program
-            extras = [a for a in (live, poison) if a is not None]
+            # optional traced inputs (liveness / NaN gate / attack codes):
+            # trace-time presence branches, one compiled program per form —
+            # a fit feeds a fixed form, so the compile counter still sees
+            # one program
+            extras = [a for a in (live, poison, attack) if a is not None]
             has_live, has_poison = live is not None, poison is not None
+            has_attack = attack is not None
 
             def wrapped(st, ex, ey, ix, *opt):
                 opt = list(opt)
                 lv = opt.pop(0) if has_live else None
                 pz = opt.pop(0) if has_poison else None
+                ak = opt.pop(0) if has_attack else None
                 return epoch_over_sites(
                     st, ix, None, None, lv, site_axes=(SITE_AXIS, FOLD_AXIS),
                     inner_axis=FOLD_AXIS, inventory=(ex, ey), poison=pz,
+                    attack=ak,
                 )
 
             return shard_map(
@@ -1234,55 +1420,63 @@ def make_train_epoch_fn(
     elif pipeline == "device":
 
         def epoch_fn_impl(state: TrainState, inv_x, inv_y, idx, live=None,
-                          poison=None):
+                          poison=None, attack=None):
             # all S sites fold onto the local device: the inner vmap IS the
             # site axis; the gather vmaps over the same leading site dim
             return epoch_over_sites(
                 _ensure_health(state, idx), idx, None, None, live,
                 site_axes=SITE_AXIS, inner_axis=SITE_AXIS,
-                inventory=(inv_x, inv_y), poison=poison,
+                inventory=(inv_x, inv_y), poison=poison, attack=attack,
             )
 
         epoch_fn = jax.jit(epoch_fn_impl, **jit_kw)
 
     elif mesh is not None:
 
-        def shard_wrapped(st, x, y, w, lv=None):
-            # x: [k, steps, B, ...] — this device's block of k sites. k > 1 is
-            # the folded case (cfg.sites_per_device: more simulated sites than
-            # devices); cross-site collectives span the (mesh site, fold)
-            # axis pair. k == 1 is the one-site-per-device case, same program.
-            return epoch_over_sites(
-                st, x, y, w, lv, site_axes=(SITE_AXIS, FOLD_AXIS),
-                inner_axis=FOLD_AXIS,
-            )
-
-        def epoch_fn_impl(state: TrainState, inputs, labels, weights, live=None):
+        def epoch_fn_impl(state: TrainState, inputs, labels, weights,
+                          live=None, attack=None):
             state = _ensure_health(state, inputs)
             specs = _state_specs(state)
-            in_specs = (specs, P(SITE_AXIS), P(SITE_AXIS), P(SITE_AXIS))
-            args = (state, inputs, labels, weights)
-            if live is not None:  # trace-time branch: one program per form
-                in_specs += (P(SITE_AXIS),)
-                args += (live,)
+            has_live, has_attack = live is not None, attack is not None
+
+            def shard_wrapped(st, x, y, w, *opt):
+                # x: [k, steps, B, ...] — this device's block of k sites.
+                # k > 1 is the folded case (cfg.sites_per_device: more
+                # simulated sites than devices); cross-site collectives span
+                # the (mesh site, fold) axis pair. k == 1 is the
+                # one-site-per-device case, same program.
+                opt = list(opt)
+                lv = opt.pop(0) if has_live else None
+                ak = opt.pop(0) if has_attack else None
+                return epoch_over_sites(
+                    st, x, y, w, lv, site_axes=(SITE_AXIS, FOLD_AXIS),
+                    inner_axis=FOLD_AXIS, attack=ak,
+                )
+
+            extras = [a for a in (live, attack) if a is not None]
+            in_specs = (
+                (specs, P(SITE_AXIS), P(SITE_AXIS), P(SITE_AXIS))
+                + (P(SITE_AXIS),) * len(extras)
+            )
             return shard_map(
                 shard_wrapped,
                 mesh=mesh,
                 in_specs=in_specs,
                 out_specs=(specs, P()),
                 check_vma=False,
-            )(*args)
+            )(state, inputs, labels, weights, *extras)
 
         epoch_fn = jax.jit(epoch_fn_impl, **jit_kw)
 
     else:
 
-        def epoch_fn_impl(state: TrainState, inputs, labels, weights, live=None):
+        def epoch_fn_impl(state: TrainState, inputs, labels, weights,
+                          live=None, attack=None):
             # all S sites fold onto the local device: the inner vmap IS the
             # site axis
             return epoch_over_sites(
                 _ensure_health(state, inputs), inputs, labels, weights, live,
-                site_axes=SITE_AXIS, inner_axis=SITE_AXIS,
+                site_axes=SITE_AXIS, inner_axis=SITE_AXIS, attack=attack,
             )
 
         epoch_fn = jax.jit(epoch_fn_impl, **jit_kw)
@@ -1315,7 +1509,8 @@ def epoch_program_artifacts(epoch_fn, *args, lowered: bool = False,
     return closed, low, comp
 
 
-def compile_epoch_aot(epoch_fn, state: TrainState, x, y, w, live=None):
+def compile_epoch_aot(epoch_fn, state: TrainState, x, y, w, live=None,
+                      attack=None):
     """AOT-compile an epoch function letting XLA choose the INPUT layout for
     the (large, resident) epoch inputs.
 
@@ -1332,14 +1527,21 @@ def compile_epoch_aot(epoch_fn, state: TrainState, x, y, w, live=None):
     distributes inputs instead of keeping them resident. Pass ``live``
     (``[S, rounds]``) to compile the fault-injected program (bench
     ``--faults``); the compiled callable then takes it as a fifth argument.
+    ``attack`` (``[S, rounds]`` int32, robustness/attacks.py) likewise
+    compiles the attack-injected program (bench ``--attacks``) — it rides
+    after ``live`` in the positional order, so an attack-only build passes
+    ``live=None`` explicitly at call time.
     """
     from ..core.jaxcompat import auto_input_format, input_formats_of
 
     in_sh = (jax.tree.map(lambda _: None, state), auto_input_format(), None, None)
     args = (state, x, y, w)
-    if live is not None:
+    if live is not None or attack is not None:
         in_sh = in_sh + (None,)
         args = args + (live,)
+    if attack is not None:
+        in_sh = in_sh + (None,)
+        args = args + (attack,)
     comp = jax.jit(epoch_fn, in_shardings=in_sh).lower(*args).compile()
     x_fmt = input_formats_of(comp)[0][1]
     return comp, lambda xs: jax.device_put(xs, x_fmt)

@@ -1,0 +1,421 @@
+"""Hostile-site tests (r17): AttackPlan semantics, the traced byzantine
+transforms, robust aggregation defending the round, the anomaly-scored
+reputation quarantine, the FaultPlan delay×NaN interaction, rejoin-after-
+quarantine state resets, and the 512-packed-site attack×churn acceptance
+gate.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dinunet_implementations_tpu import TrainConfig
+from dinunet_implementations_tpu.checks.sanitize import jit_cache_size
+from dinunet_implementations_tpu.core.config import FSArgs
+from dinunet_implementations_tpu.engines import make_engine
+from dinunet_implementations_tpu.models import MSANNet
+from dinunet_implementations_tpu.parallel import host_mesh
+from dinunet_implementations_tpu.robustness import (
+    AttackPlan,
+    FaultPlan,
+    attack_window,
+    make_attack_fn,
+    parse_attack_plan,
+    reset_slot_state,
+)
+from dinunet_implementations_tpu.robustness.attacks import (
+    ATTACK_COLLUDE,
+    ATTACK_FREE_RIDER,
+    ATTACK_NOISE,
+    ATTACK_SCALE,
+    ATTACK_SIGN_FLIP,
+)
+from dinunet_implementations_tpu.robustness.faults import poison_inputs
+from dinunet_implementations_tpu.trainer.steps import (
+    FederatedTask,
+    init_train_state,
+    make_optimizer,
+    make_train_epoch_fn,
+)
+
+
+# ---------------------------------------------------------------------------
+# AttackPlan: declarative semantics, JSON round-trip, window math
+# ---------------------------------------------------------------------------
+
+
+def test_attack_plan_json_roundtrip(tmp_path):
+    plan = AttackPlan(
+        sign_flip=((2, 0, -1),), scale=((3, 5, 9),), scale_factor=7.5,
+        noise=((4, 0, 3),), noise_std=0.5, free_rider=((5, 2, -1),),
+        collude=((6, 0, -1), (7, 0, -1)), collude_scale=3.0,
+    )
+    assert AttackPlan.from_json(plan.to_json()) == plan
+    assert AttackPlan.from_json(json.dumps(plan.to_json())) == plan
+    p = tmp_path / "plan.json"
+    p.write_text(json.dumps(plan.to_json()))
+    assert parse_attack_plan(f"@{p}") == plan
+    assert parse_attack_plan(str(p)) == plan
+    assert parse_attack_plan('{"sign_flip": [[1, 0, -1]]}') == AttackPlan(
+        sign_flip=((1, 0, -1),)
+    )
+    assert parse_attack_plan(None) is None
+
+
+def test_attack_plan_rejects_malformed():
+    with pytest.raises(ValueError, match="triples"):
+        AttackPlan(sign_flip=((1, 2),))
+    with pytest.raises(ValueError, match="bad AttackPlan"):
+        AttackPlan(scale=((-1, 0, 2),))
+    with pytest.raises(ValueError, match="bad AttackPlan"):
+        AttackPlan(noise=((0, 5, 2),))  # last < first
+    with pytest.raises(ValueError, match="unknown AttackPlan keys"):
+        AttackPlan.from_json({"sign_flop": []})
+    # one attack per (site, round) cell: overlapping windows are ambiguous
+    with pytest.raises(ValueError, match="overlap"):
+        AttackPlan(sign_flip=((1, 0, 10),), scale=((1, 5, -1),))
+    # same site, disjoint windows: fine
+    AttackPlan(sign_flip=((1, 0, 4),), scale=((1, 5, -1),))
+
+
+def test_attack_window_codes_and_chunk_independence():
+    plan = AttackPlan(
+        sign_flip=((0, 2, 4),), scale=((1, 0, -1),), free_rider=((2, 3, 3),),
+    )
+    full = plan.codes(4, 0, 8)
+    assert full[0, 1] == 0 and (full[0, 2:5] == ATTACK_SIGN_FLIP).all()
+    assert (full[1] == ATTACK_SCALE).all()  # -1 = forever
+    assert full[2, 3] == ATTACK_FREE_RIDER and full[2, 4] == 0
+    assert (full[3] == 0).all()
+    # chunk independence: any window split reproduces the same codes
+    chunked = np.concatenate(
+        [plan.codes(4, r0, 2) for r0 in (0, 2, 4, 6)], axis=1
+    )
+    np.testing.assert_array_equal(full, chunked)
+    assert attack_window(AttackPlan(), 4, 0, 8) is None
+    assert attack_window(None, 4, 0, 8) is None
+    assert plan.attacker_sites() == (0, 1, 2)
+
+
+def test_attack_transforms_per_family():
+    plan = AttackPlan(
+        sign_flip=((0, 0, -1),), scale=((1, 0, -1),), scale_factor=10.0,
+        noise=((2, 0, -1),), noise_std=0.1,
+        free_rider=((3, 0, -1),), collude=((4, 0, -1), (5, 0, -1)),
+        collude_scale=5.0,
+    )
+    atk = jax.jit(make_attack_fn(plan), static_argnums=())
+    g = {"k": jnp.ones((3, 2)), "b": jnp.full((2,), 2.0)}
+    rnd = jnp.zeros((), jnp.int32)
+
+    honest = atk(g, jnp.int32(0), rnd, jnp.int32(9))
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b), honest, g)
+
+    flipped = atk(g, jnp.int32(ATTACK_SIGN_FLIP), rnd, jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(flipped["k"]), -1.0)
+    scaled = atk(g, jnp.int32(ATTACK_SCALE), rnd, jnp.int32(1))
+    np.testing.assert_allclose(np.asarray(scaled["b"]), 20.0)
+    rider = atk(g, jnp.int32(ATTACK_FREE_RIDER), rnd, jnp.int32(3))
+    assert float(sum(jnp.abs(v).sum() for v in jax.tree.leaves(rider))) == 0.0
+
+    # noise: deterministic per (site, round), different across them
+    n1 = atk(g, jnp.int32(ATTACK_NOISE), rnd, jnp.int32(2))
+    n2 = atk(g, jnp.int32(ATTACK_NOISE), rnd, jnp.int32(2))
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b), n1, n2)
+    n3 = atk(g, jnp.int32(ATTACK_NOISE), rnd + 1, jnp.int32(2))
+    assert not np.allclose(np.asarray(n1["k"]), np.asarray(n3["k"]))
+    assert not np.allclose(np.asarray(n1["k"]), np.asarray(g["k"]))
+
+    # collusion: the whole clique ships ONE direction per round, scaled to
+    # collude_scale × the member's own gradient norm
+    c4 = atk(g, jnp.int32(ATTACK_COLLUDE), rnd, jnp.int32(4))
+    c5 = atk(g, jnp.int32(ATTACK_COLLUDE), rnd, jnp.int32(5))
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b), c4, c5)
+    gn = float(jnp.sqrt(sum(
+        jnp.square(v).sum() for v in jax.tree.leaves(g)
+    )))
+    cn = float(jnp.sqrt(sum(
+        jnp.square(v).sum() for v in jax.tree.leaves(c4)
+    )))
+    np.testing.assert_allclose(cn, 5.0 * gn, rtol=1e-5)
+    # and the direction changes per round
+    c4r1 = atk(g, jnp.int32(ATTACK_COLLUDE), rnd + 1, jnp.int32(4))
+    assert not np.allclose(np.asarray(c4["k"]), np.asarray(c4r1["k"]))
+
+
+# ---------------------------------------------------------------------------
+# the attacked epoch: defense, reputation, compile stability
+# ---------------------------------------------------------------------------
+
+
+def _epoch_corner(num_sites=8, identical=True, seed=0):
+    model = MSANNet(in_size=6, hidden_sizes=(8,), out_size=2)
+    task = FederatedTask(model)
+    opt = make_optimizer("adam", 1e-2)
+    S, steps, B, D = num_sites, 4, 4, model.in_size
+    rng = np.random.default_rng(seed)
+    if identical:
+        one = rng.normal(size=(1, steps, B, D)).astype(np.float32)
+        x = jnp.asarray(np.repeat(one, S, axis=0))
+    else:
+        x = jnp.asarray(rng.normal(size=(S, steps, B, D)).astype(np.float32))
+    y = jnp.asarray((np.arange(S * steps * B).reshape(S, steps, B) % 2)
+                    .astype(np.int32))
+    w = jnp.ones((S, steps, B), jnp.float32)
+    return task, opt, x, y, w
+
+
+@pytest.mark.parametrize("mesh_fn", [lambda: None, lambda: host_mesh(2)],
+                         ids=["vmap", "packed-mesh"])
+def test_sign_flip_defended_round_matches_clean_round(mesh_fn):
+    """With identical sites, the coordinate median of 7 honest gradients +
+     1 sign-flipped one IS the honest gradient — the defended attacked run
+    reproduces the clean run's parameters (up to fp noise of the differing
+    reduction), on the vmap fold AND the packed two-level mesh path. The
+    undefended attacked run diverges (the mean is steered by -g). SGD
+    optimizer: with identical sites a sign-flip SCALES the honest mean
+    without turning it, and Adam's per-coordinate normalization would hide
+    exactly that dilution."""
+    task, _, x, y, w = _epoch_corner()
+    opt = make_optimizer("sgd", 1e-1)
+    S = x.shape[0]
+    plan = AttackPlan(sign_flip=((3, 0, -1),))
+    am = jnp.asarray(attack_window(plan, S, 0, x.shape[1]))
+
+    def run(robust, attacked):
+        eng = make_engine("dSGD", robust_agg=robust)
+        state = init_train_state(
+            task, eng, opt, jax.random.PRNGKey(0), x[0, 0], num_sites=S,
+            reputation=robust != "none",
+        )
+        fn = make_train_epoch_fn(
+            task, eng, opt, mesh=mesh_fn(), attack_plan=plan,
+            robust_agg=robust, reputation_rounds=0,
+        )
+        s, _ = fn(state, x, y, w, None, am if attacked else None)
+        return s
+
+    clean = run("none", attacked=False)
+    defended = run("coordinate_median", attacked=True)
+    undefended = run("none", attacked=True)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5),
+        clean.params, defended.params,
+    )
+    diffs = [
+        float(np.abs(np.asarray(a) - np.asarray(b)).max())
+        for a, b in zip(jax.tree.leaves(clean.params),
+                        jax.tree.leaves(undefended.params))
+    ]
+    assert max(diffs) > 1e-3, "the undefended attack did not even steer"
+
+
+def test_reputation_quarantines_persistent_attacker():
+    """An 8-site cohort with one gradient-scaling attacker: the anomaly
+    z-score flags exactly the attacker, its suspect streak reaches the
+    threshold, and the SAME sticky quarantine flag a NaN streak uses
+    latches — honest sites stay clean."""
+    task, opt, x, y, w = _epoch_corner(identical=False)
+    S = x.shape[0]
+    plan = AttackPlan(scale=((2, 0, -1),), scale_factor=50.0)
+    am = jnp.asarray(attack_window(plan, S, 0, x.shape[1]))
+    eng = make_engine("dSGD", robust_agg="trimmed_mean")
+    state = init_train_state(
+        task, eng, opt, jax.random.PRNGKey(0), x[0, 0], num_sites=S,
+        reputation=True,
+    )
+    fn = make_train_epoch_fn(
+        task, eng, opt, mesh=None, attack_plan=plan,
+        robust_agg="trimmed_mean", reputation_z=2.0, reputation_rounds=3,
+    )
+    for _ in range(2):
+        state, losses = fn(state, x, y, w, None, am)
+    h = jax.tree.map(np.asarray, state.health)
+    assert h["quarantined"].tolist() == [0, 0, 1, 0, 0, 0, 0, 0]
+    assert h["anomaly"][2] == h["anomaly"].max() and h["anomaly"][2] > 0.3
+    assert h["suspect_streak"][2] >= 3
+    # once quarantined the attacker is zero-weighted like a NaN site
+    assert h["skips"][2] > 0 and (h["skips"][np.arange(S) != 2] == 0).all()
+    assert np.isfinite(np.asarray(losses)).all()
+
+
+def test_attack_pattern_change_never_recompiles():
+    """The [S, rounds] code mask is a traced input: flipping WHO attacks
+    WHEN between epochs reuses the one compiled program (the FaultPlan
+    one-program contract, extended to attacks)."""
+    task, opt, x, y, w = _epoch_corner(num_sites=4)
+    S, steps = x.shape[0], x.shape[1]
+    plan = AttackPlan(sign_flip=((0, 0, -1),), scale=((1, 0, -1),))
+    eng = make_engine("dSGD", robust_agg="norm_clip")
+    state = init_train_state(
+        task, eng, opt, jax.random.PRNGKey(0), x[0, 0], num_sites=S,
+        reputation=True,
+    )
+    fn = make_train_epoch_fn(
+        task, eng, opt, mesh=None, attack_plan=plan, robust_agg="norm_clip",
+    )
+    rng = np.random.default_rng(0)
+    for _ in range(3):
+        am = jnp.asarray(
+            rng.integers(0, 3, size=(S, steps)).astype(np.int32)
+        )
+        state, _ = fn(state, x, y, w, None, am)
+    assert jit_cache_size(fn) == 1
+
+
+def test_attack_mask_without_plan_rejected():
+    task, opt, x, y, w = _epoch_corner(num_sites=2)
+    eng = make_engine("dSGD")
+    state = init_train_state(
+        task, eng, opt, jax.random.PRNGKey(0), x[0, 0], num_sites=2
+    )
+    fn = make_train_epoch_fn(task, eng, opt, mesh=None)
+    with pytest.raises(ValueError, match="attack_plan"):
+        fn(state, x, y, w, None, jnp.zeros((2, x.shape[1]), jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan delay_at × NaN poison on the same (site, round)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("staleness", [0, 2], ids=["bulk-sync", "async"])
+def test_delayed_then_poisoned_update_is_masked_not_applied_late(staleness):
+    """A site that is both STRAGGLING (delay_at) and NaN-POISONED on the
+    same round must contribute nothing from that round — in the buffered-
+    async mode especially, the poisoned update must never be deposited and
+    served late at decayed weight. The delayed+poisoned run is bit-identical
+    to the delayed-only run (the poison lands in a round block the site
+    never ships), buffers stay NaN-free, and the site's non-finite streak
+    stays 0 (it never ARRIVED non-finite)."""
+    task, opt, x, y, w = _epoch_corner(num_sites=4, identical=False)
+    S, steps = x.shape[0], x.shape[1]
+    fault = FaultPlan(delay_at=((1, 1, 2),), nan_at=((1, 1),))
+    live = jnp.asarray(fault.liveness(S, 0, steps))
+    nan_mask = fault.nan_mask(S, 0, steps)
+    assert nan_mask[1, 1] and live[1, 1] == 0  # same (site, round) cell
+    x_poisoned = jnp.asarray(poison_inputs(np.asarray(x), nan_mask, 1))
+
+    eng = make_engine("dSGD")
+    state = init_train_state(
+        task, eng, opt, jax.random.PRNGKey(0), x[0, 0], num_sites=S,
+        staleness_bound=staleness,
+    )
+    fn = make_train_epoch_fn(
+        task, eng, opt, mesh=None, staleness_bound=staleness,
+    )
+    s_poisoned, l_poisoned = fn(state, x_poisoned, y, w, live)
+    s_delay_only, l_delay = fn(state, x, y, w, live)
+    np.testing.assert_array_equal(
+        np.asarray(l_poisoned), np.asarray(l_delay)
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        s_poisoned.params, s_delay_only.params,
+    )
+    h = jax.tree.map(np.asarray, s_poisoned.health)
+    assert h["streak"][1] == 0  # never arrived non-finite
+    assert h["quarantined"].sum() == 0
+    if staleness:
+        for leaf in jax.tree.leaves(s_poisoned.buffers["grads"]):
+            assert np.isfinite(np.asarray(leaf)).all(), (
+                "a poisoned update was deposited into the staleness buffer"
+            )
+
+
+# ---------------------------------------------------------------------------
+# rejoin-after-quarantine: reputation state resets with the slot
+# ---------------------------------------------------------------------------
+
+
+def test_reset_slot_state_clears_reputation_fields():
+    """FedDaemon rejoin semantics (r17 satellite): a site rejoining at a new
+    generation must start with a clean reputation — reset_slot_state zeroes
+    the anomaly score and suspect streak along with the legacy counters,
+    and leaves other slots untouched."""
+    task, opt, x, y, w = _epoch_corner(identical=False)
+    S = x.shape[0]
+    plan = AttackPlan(scale=((2, 0, -1),), scale_factor=50.0)
+    am = jnp.asarray(attack_window(plan, S, 0, x.shape[1]))
+    eng = make_engine("dSGD", robust_agg="trimmed_mean")
+    state = init_train_state(
+        task, eng, opt, jax.random.PRNGKey(0), x[0, 0], num_sites=S,
+        reputation=True,
+    )
+    fn = make_train_epoch_fn(
+        task, eng, opt, mesh=None, attack_plan=plan,
+        robust_agg="trimmed_mean", reputation_z=2.0, reputation_rounds=3,
+    )
+    state, _ = fn(state, x, y, w, None, am)
+    h = jax.tree.map(np.asarray, state.health)
+    assert h["quarantined"][2] == 1 and h["anomaly"][2] > 0
+    before_other = {k: v.copy() for k, v in h.items()}
+
+    reset = reset_slot_state(state, 2, engine=eng)
+    hr = jax.tree.map(np.asarray, reset.health)
+    for key in ("streak", "skips", "quarantined", "suspect_streak",
+                "anomaly"):
+        assert hr[key][2] == 0, key
+    mask = np.arange(S) != 2
+    for key, old in before_other.items():
+        np.testing.assert_array_equal(hr[key][mask], old[mask])
+
+
+# ---------------------------------------------------------------------------
+# attacks × membership churn at 512 packed sites — one compiled program
+# ---------------------------------------------------------------------------
+
+
+def test_attack_churn_512_packed_sites_one_compile(tmp_path):
+    """The r17 packed acceptance scenario: 512 virtual sites packed
+    64/device on the 8-device CPU mesh, trimmed-mean robust aggregation, a
+    sign-flip + free-rider AttackPlan composed with straggler faults, and a
+    join → leave → rejoin churn sequence — ONE epoch compilation for the
+    whole lifetime, finite training throughout."""
+    from test_membership import _SyntheticDaemon
+
+    cfg = TrainConfig(
+        task_id="FS-Classification", batch_size=4, sites_per_device=64,
+        staleness_bound=2, staleness_decay=0.5,
+        robust_agg="trimmed_mean", robust_trim_frac=0.1,
+        reputation_z=3.0, reputation_rounds=6,
+        fs_args=FSArgs(input_size=12, hidden_sizes=(16,)),
+    )
+    fault = FaultPlan(delay_at=((7, 1, 2),))
+    attack = AttackPlan(
+        sign_flip=((3, 0, -1), (130, 0, -1)), free_rider=((200, 0, -1),),
+    )
+    d = _SyntheticDaemon(
+        cfg, capacity=512, spool_dir=str(tmp_path / "spool"),
+        out_dir=str(tmp_path / "out"), quorum=1, poll_s=0.0,
+        fault_plan=fault, attack_plan=attack, verbose=False,
+    )
+    assert dict(d.mesh.shape)["site"] == 8  # 512 packed 64 per device
+    for i in range(500):
+        assert d.apply_event(
+            {"event": "join", "site": f"s{i}", "data_dir": f"mem://{i}"}
+        )
+    d._on_membership_change()
+    assert d.train_epoch() is not None  # the one and only compilation
+    for i in (3, 130, 499):
+        d.apply_event({"event": "leave", "site": f"s{i}"})
+    d._on_membership_change()
+    assert d.train_epoch() is not None
+    d.apply_event({"event": "join", "site": "s3", "data_dir": "mem://3"})
+    d._on_membership_change()
+    assert d.train_epoch() is not None
+    assert d.table.generation_of("s3") == 2
+    assert jit_cache_size(d.trainer.epoch_fn) == 1, (
+        "attack/churn pattern changes retraced the epoch"
+    )
+    # the rejoined attacker restarted with a clean reputation slot
+    slot = d.table.slot_of("s3")
+    h = jax.tree.map(np.asarray, d.state.health)
+    assert h["anomaly"].shape == (512,)
+    summary = d.close()
+    assert summary["epochs_run"] == 3

@@ -187,6 +187,51 @@ def test_s002_pack_unaware_model_flagged_on_packed_cell():
     assert any(s.startswith("unmodeled") for s in snippets), snippets
 
 
+def test_s002_robust_model_on_plain_psum_program_flagged():
+    """The r17 robust-wire negative fixture (mirror of the pack-unaware one
+    above): an engine that DECLARES the robust gather-mode wire model while
+    its traced program still ships the plain weighted psum must trip S002 in
+    both directions — the modeled [pack, ...] per-site gather blocks never
+    ship (overcounting), and the psum'd dense operands are covered by
+    nothing (undercounting). The real trimmed-mean engine is clean on its
+    own traced program (the acceptance matrix covers that cell)."""
+    prog = _trace("dSGD")  # the legacy psum program
+    robust = make_engine("dSGD", robust_agg="trimmed_mean")
+    lying = dataclasses.replace(
+        prog.engine,
+        wire_shapes=robust.wire_shapes,
+        wire_bytes=robust.wire_bytes,
+    )
+    fs = sem.check_wire_bytes(
+        prog.audit.collectives, lying, prog.state.params, prog.block,
+        prog.path,
+    )
+    snippets = {f.snippet for f in fs}
+    assert any(s.startswith("missing") for s in snippets), snippets
+    assert any(s.startswith("unmodeled") for s in snippets), snippets
+
+
+def test_s002_robust_cells_wire_models_consistent():
+    """wire_shapes must sum to wire_bytes for every engine × robust mode at
+    pack factors 1 and 4 — the structural half of the robust-mode S002 proof
+    (the traced half runs in the acceptance matrix)."""
+    params = {
+        "dense": jnp.zeros((8, 4), jnp.float32),
+        "bias": jnp.zeros((4,), jnp.float32),
+    }
+    for name in ("dSGD", "rankDAD", "powerSGD"):
+        for mode in ("norm_clip", "trimmed_mean", "coordinate_median"):
+            eng = make_engine(name, robust_agg=mode, dad_reduction_rank=2)
+            for pack in (1, 4):
+                shapes = modeled_wire_shapes(eng, params, pack=pack)
+                total = sum(
+                    int(np.prod(s)) * d.itemsize for s, d in shapes
+                )
+                assert total == int(
+                    payload_bytes_of(eng, params, pack=pack)
+                ), (name, mode, pack)
+
+
 def test_s002_inconsistent_model_flagged():
     bad = dataclasses.replace(
         make_engine("dSGD"), wire_bytes=lambda g: 1, wire_shapes=None
