@@ -866,7 +866,8 @@ def _setup_packed_epoch(S: int, K: int, engine_name: str = "dSGD",
                         engine_kw: dict | None = None,
                         dims: dict | None = None, fault_plan=None,
                         staleness_bound: int = 0, attack_plan=None,
-                        robust_agg: str = "none"):
+                        robust_agg: str = "none", slices: int = 1,
+                        dcn_quant: str = ""):
     """One sites-scaling arm: S virtual sites packed K per device on a real
     ``(site,)`` mesh — the full federated round as ONE compiled SPMD program
     with two-level aggregation (trainer/steps.py packed path). Epoch inputs
@@ -885,24 +886,44 @@ def _setup_packed_epoch(S: int, K: int, engine_name: str = "dSGD",
     straggling virtual site's buffered update keeps contributing at decayed
     weight. ``attack_plan`` + ``robust_agg`` (r17, robustness/attacks.py)
     compose on top: the CI hostile-site smoke measures the byzantine-
-    attacked, robustly-aggregated packed round as one compiled program."""
+    attacked, robustly-aggregated packed round as one compiled program.
+
+    ``slices > 1`` (r18) lays the three-tier ``(slice, site)`` topology over
+    the same device set — the sweep then ALSO records the per-tier wire
+    split (``ici_bytes_per_device_round`` vs ``dcn_bytes_per_slice_round``,
+    the latter quantized by ``dcn_quant``; both figures are what the sliced
+    semantic cells prove against the traced program)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from dinunet_implementations_tpu.parallel.mesh import (
-        SITE_AXIS,
         packed_site_mesh,
+        site_axis_of,
+        sliced_site_mesh,
     )
-    from dinunet_implementations_tpu.telemetry.metrics import payload_bytes_of
+    from dinunet_implementations_tpu.telemetry.metrics import (
+        dcn_bytes_of,
+        payload_bytes_of,
+    )
     from dinunet_implementations_tpu.trainer import (
         init_train_state,
         make_train_epoch_fn,
     )
     from dinunet_implementations_tpu.trainer.steps import _state_specs
 
-    mesh = packed_site_mesh(S, K)
-    engine_kw = {**(engine_kw or {}), "robust_agg": robust_agg}
+    if slices > 1:
+        if S % slices:
+            raise SystemExit(
+                f"--slices {slices} must divide the site count ({S}) — "
+                f"every slice holds the same number of virtual sites"
+            )
+        mesh = sliced_site_mesh(slices, S // slices, K)
+    else:
+        mesh = packed_site_mesh(S, K)
+    site_part = site_axis_of(mesh)
+    engine_kw = {**(engine_kw or {}), "robust_agg": robust_agg,
+                 "dcn_wire_quant": dcn_quant}
     d, task, engine, opt, np_x, np_y, np_w = _flagship_arm(
         engine_name, engine_kw, {**(dims or {}), "sites": S}
     )
@@ -927,16 +948,21 @@ def _setup_packed_epoch(S: int, K: int, engine_name: str = "dSGD",
         )
 
         attack = jnp.asarray(attack_window(attack_plan, S, 0, d["steps"]))
+    ici_bytes = int(payload_bytes_of(engine, state0.params, pack=K))
     info = {
         "mesh_devices": int(mesh.devices.size),
-        "wire_bytes_per_device_round": int(
-            payload_bytes_of(engine, state0.params, pack=K)
-        ),
+        "wire_bytes_per_device_round": ici_bytes,
+        "ici_bytes_per_device_round": ici_bytes,
+        # the per-slice inter-slice hop figure (0 on single-slice meshes)
+        "dcn_bytes_per_slice_round": int(dcn_bytes_of(
+            engine, state0.params, pack=K,
+            sites_per_slice=S // max(slices, 1), slices=slices,
+        )),
     }
-    # commit everything to its steady-state sharding: inputs split P(site)
-    # into [K, ...] device blocks, state to the epoch's own specs (the
-    # trainer's _place_state move — avoids a warmup recompile)
-    site_sh = NamedSharding(mesh, P(SITE_AXIS))
+    # commit everything to its steady-state sharding: inputs split over the
+    # site tier(s) into [K, ...] device blocks, state to the epoch's own
+    # specs (the trainer's _place_state move — avoids a warmup recompile)
+    site_sh = NamedSharding(mesh, P(site_part))
     x, y, w = (jax.device_put(a, site_sh) for a in (x, y, w))
     if live is not None:
         live = jax.device_put(live, site_sh)
@@ -947,7 +973,7 @@ def _setup_packed_epoch(S: int, K: int, engine_name: str = "dSGD",
         attack = jax.device_put(attack, site_sh)
     state0 = jax.tree.map(
         lambda a, spec: jax.device_put(a, NamedSharding(mesh, spec)),
-        state0, _state_specs(state0),
+        state0, _state_specs(state0, site_part),
     )
     epoch_fn = make_train_epoch_fn(
         task, engine, opt, mesh=mesh, local_iterations=1,
@@ -961,7 +987,11 @@ def _setup_packed_epoch(S: int, K: int, engine_name: str = "dSGD",
     )
 
     guard = (
-        CompileGuard({"epoch_fn": epoch_fn}, label=f"sites{S}-pack{K}")
+        CompileGuard(
+            {"epoch_fn": epoch_fn},
+            label=f"sites{S}-pack{K}" + (f"-slices{slices}" if slices > 1
+                                         else ""),
+        )
         if sanitize_enabled() else None
     )
 
@@ -980,7 +1010,8 @@ def measure_sites_scaling(sites_list, packs=None, obs: int = 3,
                           engine_name: str = "dSGD",
                           engine_kw: dict | None = None, fault_plan=None,
                           staleness_bound: int = 0, attack_plan=None,
-                          robust_agg: str = "none") -> list[dict]:
+                          robust_agg: str = "none",
+                          slices_list=None, dcn_quant: str = "") -> list[dict]:
     """The sites-scaling sweep (``--sites``): for each virtual site count S,
     run the packed federated round on the available device mesh and emit one
     JSON record with ``sites`` / ``sites_per_chip`` / ``pack_factor`` — the
@@ -988,7 +1019,16 @@ def measure_sites_scaling(sites_list, packs=None, obs: int = 3,
     gives an explicit pack factor per S; default picks the smallest K that
     divides S with an S/K-member site mesh fitting the device set (every
     device used when device_count divides S; e.g. 12 sites on 8 devices
-    auto-pack K=2 onto a 6-member mesh)."""
+    auto-pack K=2 onto a 6-member mesh).
+
+    ``slices_list`` (r18, ``--slices``) crosses each S with the given slice
+    counts on the three-tier ``(slice, site)`` topology: every record then
+    carries ``slices`` / ``sites_per_slice`` and the per-TIER wire split —
+    ``ici_bytes_per_device_round`` (unchanged by slicing: tiers 0+1 are the
+    packed two-level reduce) vs ``dcn_bytes_per_slice_round`` (the
+    inter-slice hop, quantized by ``dcn_quant``) with the codec's
+    shrink-vs-f32 ratio, the figures the sliced semantic cells prove
+    against traced operand shapes."""
     import jax
 
     def auto_pack(S: int, n_dev: int) -> int:
@@ -1001,48 +1041,88 @@ def measure_sites_scaling(sites_list, packs=None, obs: int = 3,
     n_dev = len(jax.devices())
     for i, S in enumerate(sites_list):
         K = packs[i] if packs is not None else auto_pack(S, n_dev)
-        run_chain, samples, info = _setup_packed_epoch(
-            S, K, engine_name=engine_name, engine_kw=engine_kw, dims=dims,
-            fault_plan=fault_plan, staleness_bound=staleness_bound,
-            attack_plan=attack_plan, robust_agg=robust_agg,
-        )
-        run_chain(1)  # compile + warm up outside the timing
-        pairs = [
-            (run_chain(n // 2 + 1), run_chain(n + 1)) for _ in range(obs)
-        ]
-        dist = marginal_distribution(pairs, n)
-        rec = {
-            "metric": "samples/sec (ICA-LSTM federated round, packed "
-                      "sites-scaling sweep)",
-            "engine": engine_name,
-            "sites": S,
-            "pack_factor": K,
-            "sites_per_chip": K,
-            "mesh_devices": info["mesh_devices"],
-            "devices_available": n_dev,
-            "wire_bytes_per_device_round": info["wire_bytes_per_device_round"],
-            "backend": jax.default_backend(),
-            "chain_epochs": n,
-            "samples_per_sec": throughput_stats(dist, samples),
-            "unit": "samples/sec (whole mesh)",
-        }
-        if engine_kw:
-            rec["engine_kw"] = engine_kw
-        if dims:
-            rec["dims"] = {**dims, "sites": S}
-        if fault_plan is not None:
-            rec["faults"] = fault_plan.to_json()
-            steps = (dims or {}).get("steps", STEPS_PER_EPOCH)
-            rec["dead_site_rounds"] = int(
-                (fault_plan.liveness(S, 0, steps) == 0).sum()
+        for slices in (slices_list or [1]):
+            run_chain, samples, info = _setup_packed_epoch(
+                S, K, engine_name=engine_name, engine_kw=engine_kw,
+                dims=dims, fault_plan=fault_plan,
+                staleness_bound=staleness_bound,
+                attack_plan=attack_plan, robust_agg=robust_agg,
+                slices=slices, dcn_quant=dcn_quant,
             )
-        if staleness_bound:
-            rec["staleness_bound"] = staleness_bound
-        if attack_plan is not None:
-            rec["attacks"] = attack_plan.to_json()
-        if robust_agg != "none":
-            rec["robust_agg"] = robust_agg
-        records.append(rec)
+            run_chain(1)  # compile + warm up outside the timing
+            pairs = [
+                (run_chain(n // 2 + 1), run_chain(n + 1)) for _ in range(obs)
+            ]
+            dist = marginal_distribution(pairs, n)
+            rec = {
+                "metric": "samples/sec (ICA-LSTM federated round, packed "
+                          "sites-scaling sweep)",
+                "engine": engine_name,
+                "sites": S,
+                "pack_factor": K,
+                "sites_per_chip": K,
+                "mesh_devices": info["mesh_devices"],
+                "devices_available": n_dev,
+                "wire_bytes_per_device_round":
+                    info["wire_bytes_per_device_round"],
+                "ici_bytes_per_device_round":
+                    info["ici_bytes_per_device_round"],
+                "backend": jax.default_backend(),
+                "chain_epochs": n,
+                "samples_per_sec": throughput_stats(dist, samples),
+                "unit": "samples/sec (whole mesh)",
+            }
+            if slices_list is not None:
+                rec.update(
+                    slices=slices,
+                    sites_per_slice=S // max(slices, 1),
+                    dcn_bytes_per_slice_round=
+                        info["dcn_bytes_per_slice_round"],
+                )
+                if slices > 1:
+                    # codec shrink on the expensive hop: the same sliced
+                    # topology's f32 (no-DCN-codec) figure over this one
+                    from dinunet_implementations_tpu.engines import (
+                        make_engine,
+                    )
+                    from dinunet_implementations_tpu.telemetry.metrics \
+                        import dcn_bytes_of
+
+                    base_kw = {
+                        k: v for k, v in (engine_kw or {}).items()
+                        if k not in ("wire_quant", "dcn_wire_quant")
+                    }
+                    ref = make_engine(
+                        engine_name, robust_agg=robust_agg, **base_kw
+                    )
+                    params = _flagship_params_template(engine_name, dims)
+                    f32 = dcn_bytes_of(
+                        ref, params, pack=K,
+                        sites_per_slice=S // slices, slices=slices,
+                    )
+                    if info["dcn_bytes_per_slice_round"]:
+                        rec["dcn_shrink_vs_f32"] = round(
+                            f32 / info["dcn_bytes_per_slice_round"], 3
+                        )
+                if dcn_quant:
+                    rec["dcn_wire_quant"] = dcn_quant
+            if engine_kw:
+                rec["engine_kw"] = engine_kw
+            if dims:
+                rec["dims"] = {**dims, "sites": S}
+            if fault_plan is not None:
+                rec["faults"] = fault_plan.to_json()
+                steps = (dims or {}).get("steps", STEPS_PER_EPOCH)
+                rec["dead_site_rounds"] = int(
+                    (fault_plan.liveness(S, 0, steps) == 0).sum()
+                )
+            if staleness_bound:
+                rec["staleness_bound"] = staleness_bound
+            if attack_plan is not None:
+                rec["attacks"] = attack_plan.to_json()
+            if robust_agg != "none":
+                rec["robust_agg"] = robust_agg
+            records.append(rec)
     return records
 
 
@@ -1313,6 +1393,12 @@ def main():
         ):
             print(json.dumps(rec), flush=True)
         return
+    if "--slices" in sys.argv and "--sites" not in sys.argv:
+        raise SystemExit(
+            "--slices composes with the --sites sweep (e.g. "
+            "`--sites 128,512 --slices 1,2,4`); give a site count to "
+            "spread over the slices"
+        )
     if "--sites" in sys.argv:
         # sites-scaling sweep: S virtual sites packed K per device on a real
         # site mesh (two-level aggregation, trainer/steps.py), one JSON line
@@ -1382,11 +1468,25 @@ def main():
             )
         robust = (sys.argv[sys.argv.index("--robust-agg") + 1]
                   if "--robust-agg" in sys.argv else "none")
+        # multi-slice composition (r18): `--slices 1,2,4` crosses each S
+        # with the three-tier (slice, site) topology — records gain the
+        # per-tier wire split (ici vs dcn bytes + codec shrink). The DCN
+        # codec follows --wire-quant unless --dcn-wire-quant overrides it
+        # (TrainConfig.dcn_wire_quant semantics). The CI multislice smoke
+        # rides this path: --slices 2 --sites 64 --pack 8 --wire-quant int8.
+        slices_list = None
+        if "--slices" in sys.argv:
+            slices_list = [
+                int(s)
+                for s in sys.argv[sys.argv.index("--slices") + 1].split(",")
+            ]
+        dcn_quant = (sys.argv[sys.argv.index("--dcn-wire-quant") + 1]
+                     if "--dcn-wire-quant" in sys.argv else "")
         for rec in measure_sites_scaling(
             sites_list, packs=packs, obs=obs, n=n, dims=dims,
             engine_name=engine_name, engine_kw=engine_kw, fault_plan=plan,
             staleness_bound=staleness, attack_plan=attack,
-            robust_agg=robust,
+            robust_agg=robust, slices_list=slices_list, dcn_quant=dcn_quant,
         ):
             print(json.dumps(rec), flush=True)
         return

@@ -24,9 +24,16 @@ from .core.config import (
     load_inputspec,
     resolve_site_configs,
 )
-from .parallel.mesh import MODEL_AXIS, SITE_AXIS, host_mesh, make_site_mesh
+from .parallel.mesh import (
+    MODEL_AXIS,
+    SITE_AXIS,
+    SLICE_AXIS,
+    host_mesh,
+    make_site_mesh,
+    sliced_site_mesh,
+)
 
-__version__ = "0.12.0"
+__version__ = "0.13.0"
 
 
 def __getattr__(name):

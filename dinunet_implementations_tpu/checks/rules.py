@@ -25,6 +25,9 @@ PRINT_ALLOWED_FILES = {
     "checks/__main__.py",  # this analyzer's own CLI
     "telemetry/report.py",  # telemetry run-summary CLI (tables on stdout)
     "serving/__main__.py",  # serving CLI: summary/latency JSON on stdout
+    # multi-host worker CLI (r18): the UNSUPPORTED capability-probe line on
+    # stdout IS the product — the launcher greps it next to rc 66
+    "runner/dcn_worker.py",
 }
 
 #: R002 — packages where a swallowed ``except Exception`` can eat the

@@ -550,6 +550,129 @@ def check_wire_bytes(
     return findings
 
 
+def check_dcn_wire(
+    collectives: list, engine, params_template, pack: int,
+    sites_per_slice: int, path: str, stats_shapes=(), slices: int = 2,
+) -> list:
+    """The DCN-tier audit for sliced cells (r18): every collective touching
+    the slice axis is either the split inter-slice hop (names EXACTLY
+    ``(slice,)`` — the re-quantized per-slice partial / the hierarchical
+    gather's slice leg) or a fused ``(slice, site)`` reduce (bookkeeping and
+    the no-DCN-codec payload form — one collective spanning both tiers,
+    bit-identical to the flat reduce); anything else (a slice+model mix, a
+    site-inner ordering) is a mis-laid axis (S001). The payloads of those
+    collectives must then match the engine's ``dcn_wire_shapes`` model both
+    ways at the cell's pack factor and per-slice site count, and the byte
+    totals must agree with ``Engine.dcn_bytes`` — so the
+    ``dcn_bytes_per_slice_round`` telemetry/bench figure is PROVEN against
+    traced operand shapes, codec shrink included (S002)."""
+    from ..parallel.mesh import SITE_AXIS, SLICE_AXIS
+    from ..telemetry.metrics import dcn_bytes_of, modeled_dcn_shapes
+
+    findings = []
+    dcn_colls = []
+    for site in collectives:
+        if SLICE_AXIS not in site.named_axes:
+            continue
+        if tuple(site.named_axes) not in (
+            (SLICE_AXIS,), (SLICE_AXIS, SITE_AXIS),
+        ):
+            findings.append(Finding(
+                rule="S001", path=path, line=0, col=0,
+                message=(
+                    f"collective '{site.prim}' touches the slice axis with "
+                    f"axes {tuple(site.named_axes)} — the DCN tier is "
+                    f"slice-only (the split hop) or the fused (slice, "
+                    f"site) reduce; any other mix re-orders the hierarchy"
+                ),
+                snippet=f"{site.prim} axes={tuple(site.named_axes)}",
+                fixit="route inter-slice traffic through "
+                      "parallel/collectives.py three_level_psum / "
+                      "site_all_gather (the PackedAxis slice forms)",
+            ))
+            continue
+        if site.prim in COMM_PRIMS and site.scan_depth == 0:
+            findings.append(Finding(
+                rule="S001", path=path, line=0, col=0,
+                message=(
+                    f"inter-slice collective '{site.prim}' appears OUTSIDE "
+                    f"the rounds scan — stray per-epoch DCN traffic"
+                ),
+                snippet=f"{site.prim} dcn-outside-scan",
+                fixit="keep the DCN hop inside the rounds scan "
+                      "(trainer/steps.py one_round)",
+            ))
+        if site.prim in COMM_PRIMS:
+            dcn_colls.append(site)
+    expected = modeled_dcn_shapes(
+        engine, params_template, pack=pack, sites_per_slice=sites_per_slice
+    )
+    model_total = sum(math.prod(s) * d.itemsize for s, d in expected)
+    db = int(dcn_bytes_of(
+        engine, params_template, pack=pack, sites_per_slice=sites_per_slice,
+        slices=slices,
+    ))
+    if model_total != db:
+        findings.append(Finding(
+            rule="S002", path=path, line=0, col=0,
+            message=(
+                f"engine '{engine.name}': dcn_wire_shapes model sums to "
+                f"{model_total} B but dcn_bytes reports {db} B — the "
+                f"structured and scalar DCN payload models have drifted"
+            ),
+            snippet="dcn-model-inconsistent",
+            fixit="derive Engine.dcn_bytes and Engine.dcn_wire_shapes from "
+                  "the same shape arithmetic",
+        ))
+    matches, missing, leftovers = _match_payload(dcn_colls, expected)
+    for shape, dtype in missing:
+        findings.append(Finding(
+            rule="S002", path=path, line=0, col=0,
+            message=(
+                f"engine '{engine.name}': modeled DCN payload "
+                f"{shape}@{dtype} never appears as an operand of a "
+                f"slice-axis collective — the DCN wire model OVERCOUNTS "
+                f"what crosses the inter-slice hop"
+            ),
+            snippet=f"dcn-missing {shape}",
+            fixit="make Engine.dcn_wire_shapes mirror the slice-axis "
+                  "collectives the aggregate actually launches",
+        ))
+    for t in leftovers:
+        if t["shape"] in tuple(stats_shapes):
+            continue  # fused sync-BN stat reduces are not engine payload
+        findings.append(Finding(
+            rule="S002", path=path, line=0, col=0,
+            message=(
+                f"engine '{engine.name}': slice-axis collective "
+                f"'{t['prim']}' ships an operand shaped {t['shape']} that "
+                f"no DCN wire-model entry covers — the DCN model "
+                f"UNDERCOUNTS what crosses the inter-slice hop"
+            ),
+            snippet=f"dcn-unmodeled {t['prim']} {t['shape']}",
+            fixit="add the payload to Engine.dcn_wire_shapes/dcn_bytes (or "
+                  "stop shipping it across slices)",
+        ))
+    traced_total = sum(
+        math.prod(shape) * isz for shape, _, isz, _ in matches
+    )
+    if not findings and traced_total != db:
+        findings.append(Finding(
+            rule="S002", path=path, line=0, col=0,
+            message=(
+                f"engine '{engine.name}': traced DCN payload is "
+                f"{traced_total} B/round/slice but dcn_bytes models {db} B "
+                f"at pack={pack}, sites_per_slice={sites_per_slice} — the "
+                f"per-tier telemetry figures are wrong"
+            ),
+            snippet="dcn-bytes-mismatch",
+            fixit="reconcile the slice-collective operand dtypes with the "
+                  "modeled DCN payload dtype (is the codec re-quantization "
+                  "really happening at the slice boundary?)",
+        ))
+    return findings
+
+
 def check_precision_flow(
     collectives: list, engine, params_template, pack: int, path: str,
     require_lowp_dot: bool = False, dots=(),
@@ -702,7 +825,9 @@ class TraceCell:
     engine: str
     # "vmap" (all sites on one device) | "mesh" (1 site/device) |
     # "fold" (2 packed/device) | "fold4" (4 packed/device — the deeper
-    # site-packing corner, r12)
+    # site-packing corner, r12) | "sliced" (2 slices × 2 members, K=2 —
+    # the r18 three-tier topology) | "sliced4" (2 slices × 2 members, K=4
+    # — packed fold4 under slicing)
     topology: str
     pipeline: str  # "host" | "device"
     precision_bits: str = "32"
@@ -730,6 +855,14 @@ class TraceCell:
     # bookkeeping gathers — against the traced program, plus S001 (the
     # reputation layer's scalar psums stay inside the scan)
     robust: str = "none"
+    # inter-slice (DCN) wire codec for the sliced topologies (r18,
+    # TrainConfig.dcn_wire_quant semantics: "" follows wire_quant). Sliced
+    # cells verify the per-TIER wire models: S002's ICI proof ignores
+    # slice-only collectives, and the DCN-tier check proves the engine's
+    # dcn_wire_shapes against exactly the collectives that touch the slice
+    # axis — so "the expensive hop carries one codec-quantized per-slice
+    # partial per round" is a traced property, not a modeled one.
+    dcn_quant: str = ""
     # free-form label suffix for cells distinguished only by engine_kw
     # (e.g. "+fused" for the Pallas power-iteration corner) — labels key
     # the semantic baseline, so they must stay unique per cell
@@ -744,6 +877,8 @@ class TraceCell:
             name += f"@{self.precision_bits}"
         if self.wire_quant != "none":
             name += f"@{self.wire_quant}"
+        if self.dcn_quant:
+            name += f"@dcn-{self.dcn_quant}"
         if self.donate:
             name += "+donate"
         if self.staleness:
@@ -752,6 +887,10 @@ class TraceCell:
             name += f"+{self.robust}"
         name += self.tag
         return f"{name}/{self.topology}/{self.pipeline}"
+
+    @property
+    def sliced(self) -> bool:
+        return self.topology.startswith("sliced")
 
 
 @dataclasses.dataclass
@@ -766,6 +905,10 @@ class CellProgram:
     audit: ProgramAudit
     compiled: object  # only for donate cells
     path: str
+    # the r18 sliced topology, derived from the cell's ACTUAL mesh (never
+    # hardcoded by the rule driver): 1 / 0 on unsliced cells
+    slices: int = 1
+    sites_per_slice: int = 0
 
 
 def build_cell_inputs(cell: TraceCell, engine=None) -> tuple:
@@ -782,14 +925,16 @@ def build_cell_inputs(cell: TraceCell, engine=None) -> tuple:
 
     from ..engines import make_engine
     from ..models import MSANNet
-    from ..parallel.mesh import host_mesh
+    from ..parallel.mesh import host_mesh, sliced_site_mesh
     from ..trainer.steps import (
         FederatedTask,
         init_train_state,
         make_optimizer,
     )
 
-    S = {"fold": 4, "fold4": 8}.get(cell.topology, 2)
+    S = {"fold": 4, "fold4": 8, "sliced": 8, "sliced4": 16}.get(
+        cell.topology, 2
+    )
     steps, B, N = 2, 4, 8
     if cell.dense_model:
         # every leaf non-compressible ([1, 2] kernel + bias): the low-rank
@@ -803,10 +948,18 @@ def build_cell_inputs(cell: TraceCell, engine=None) -> tuple:
         engine = make_engine(
             cell.engine, precision_bits=cell.precision_bits,
             wire_quant=cell.wire_quant, robust_agg=cell.robust,
+            dcn_wire_quant=cell.dcn_quant,
             **dict(cell.engine_kw),
         )
     opt = make_optimizer("adam", 1e-2)
-    mesh = host_mesh(2) if cell.topology in ("mesh", "fold", "fold4") else None
+    if cell.topology in ("mesh", "fold", "fold4"):
+        mesh = host_mesh(2)
+    elif cell.sliced:
+        # the r18 three-tier corner: 2 slices × 2 site members over 4 CPU
+        # devices, with K = S/4 virtual sites packed per member
+        mesh = sliced_site_mesh(2, S // 2, S // 4)
+    else:
+        mesh = None
     state = init_train_state(
         task, engine, opt, jax.random.PRNGKey(0),
         jnp.ones((B, D), jnp.float32), num_sites=S,
@@ -835,7 +988,7 @@ def build_cell_inputs(cell: TraceCell, engine=None) -> tuple:
 def trace_cell(cell: TraceCell, engine=None) -> CellProgram:
     """Build and trace one matrix cell's REAL epoch program (tiny shapes,
     CPU)."""
-    from ..parallel.mesh import SITE_AXIS
+    from ..parallel.mesh import pack_factor
     from ..trainer.steps import epoch_program_artifacts, make_train_epoch_fn
 
     task, engine, opt, state, args, mesh = build_cell_inputs(cell, engine)
@@ -846,11 +999,16 @@ def trace_cell(cell: TraceCell, engine=None) -> CellProgram:
     )
     closed, _, comp = epoch_program_artifacts(fn, *args, compiled=cell.donate)
     S = args[1].shape[0]
-    block = S if mesh is None else S // dict(mesh.shape)[SITE_AXIS]
+    block = S if mesh is None else pack_factor(mesh, S)
+    from ..parallel.mesh import slice_count
+
+    slices = slice_count(mesh)
     return CellProgram(
         cell=cell, engine=engine, state=state, args=args, block=block,
         audit=audit_jaxpr(closed), compiled=comp,
         path=f"trace://{cell.label}",
+        slices=slices,
+        sites_per_slice=S // slices if slices > 1 else 0,
     )
 
 
@@ -992,6 +1150,43 @@ def default_matrix() -> list:
             engine_kw=(("dad_reduction_rank", 2),),
         ),
     ]
+    # multi-slice cells (r18): the three-tier topology across the engine
+    # corners — the per-TIER wire proofs. The fused (no DCN codec) form
+    # must show the ICI model unchanged with the (slice, site) reduces
+    # covering the DCN model at the intra wire dtype; the int8-DCN split
+    # cells must show slice-ONLY collectives carrying exactly one
+    # codec-quantized per-slice partial per payload (dSGD: the whole tree
+    # as ONE fused vector) at ≤ ¼ the f32 bytes — proven against traced
+    # operand shapes, incl. the packed K=4 corner (sliced4) where a
+    # per-device-charged DCN model would be 4x wrong.
+    cells += [
+        TraceCell(name, "sliced", "host", engine_kw=kw, dense_model=dense)
+        for name, kw, dense in _ENGINE_CORNERS
+    ]
+    cells += [
+        TraceCell("dSGD", "sliced", "host", dcn_quant="int8"),
+        TraceCell("dSGD", "sliced4", "device", wire_quant="int8",
+                  dcn_quant="int8"),
+        TraceCell(
+            "rankDAD", "sliced4", "host", wire_quant="int8",
+            dcn_quant="int8",
+            engine_kw=(("dad_num_pow_iters", 2), ("dad_reduction_rank", 2)),
+        ),
+        TraceCell(
+            "powerSGD", "sliced", "host", dcn_quant="int8",
+            engine_kw=(("dad_reduction_rank", 2),),
+        ),
+        # robust × sliced (the review corner): the gather reducers' dense
+        # payload must cross the slice hop DCN-re-quantized exactly as the
+        # engines' dcn models charge it — the powerSGD dense-gather path
+        # shipped f32 across DCN against an int8 model until this cell
+        TraceCell(
+            "powerSGD", "sliced", "host", dcn_quant="int8",
+            robust="trimmed_mean", engine_kw=(("dad_reduction_rank", 2),),
+        ),
+        TraceCell("dSGD", "sliced", "host", dcn_quant="int8",
+                  robust="norm_clip"),
+    ]
     return cells
 
 
@@ -1093,10 +1288,72 @@ def identity_text_fn(cell: TraceCell):
     return text
 
 
+def slices_identity_pairs() -> list:
+    """The r18 S005 pairs, as ``(label, text_a, text_b, expect_identical)``:
+
+    - ``slices-off`` — the ``num_slices=1`` opt-out must lower the EXACT
+      legacy single-mesh program (sliced_site_mesh(1, ...) collapses to
+      packed_site_mesh; if it ever starts building a 1-deep slice axis
+      instead, this gate trips before any perf number does);
+    - ``slices-on`` — the sliced topology must genuinely change the program
+      (the inverse gate: a "sliced" mesh that silently flattens back would
+      make every multi-slice claim vacuous);
+    - ``slices-dcn-int8`` — the DCN codec must genuinely split the
+      inter-slice hop (re-quantized slice-only collectives in the program)
+      vs the fused no-codec form.
+
+    Shared by the CLI S005 gate and the tier-1 mirror
+    (tests/test_multislice.py)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..engines import make_engine
+    from ..models import MSANNet
+    from ..parallel.mesh import packed_site_mesh, sliced_site_mesh
+    from ..trainer.steps import (
+        FederatedTask,
+        init_train_state,
+        make_optimizer,
+        make_train_epoch_fn,
+    )
+
+    import jax
+
+    S, steps, B, D = 8, 2, 4, 6
+    model = MSANNet(in_size=D, hidden_sizes=(8,), out_size=2)
+    task = FederatedTask(model)
+    opt = make_optimizer("adam", 1e-2)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(S, steps, B, D)).astype(np.float32))
+    y = jnp.zeros((S, steps, B), jnp.int32)
+    w = jnp.ones((S, steps, B), jnp.float32)
+
+    def text(mesh, **engine_kw):
+        engine = make_engine("dSGD", **engine_kw)
+        state = init_train_state(
+            task, engine, opt, jax.random.PRNGKey(0),
+            jnp.ones((B, D), jnp.float32), num_sites=S,
+        )
+        fn = make_train_epoch_fn(task, engine, opt, mesh=mesh)
+        return fn.lower(state, x, y, w).as_text()
+
+    legacy = text(packed_site_mesh(S, 2))
+    off = text(sliced_site_mesh(1, S, 2))
+    sliced = text(sliced_site_mesh(2, S // 2, 2))
+    sliced_dcn = text(
+        sliced_site_mesh(2, S // 2, 2), dcn_wire_quant="int8"
+    )
+    return [
+        ("slices-off", legacy, off, True),
+        ("slices-on", legacy, sliced, False),
+        ("slices-dcn-int8", sliced, sliced_dcn, False),
+    ]
+
+
 def _identity_gate() -> list:
     """The S005 program-identity pairs (:data:`IDENTITY_CASES` on the
     flagship dSGD corner, :data:`IDENTITY_CASES_RANKDAD` on the rankDAD
-    one)."""
+    one, plus the r18 multi-slice pairs)."""
     import jax
 
     pairs = []
@@ -1113,6 +1370,7 @@ def _identity_gate() -> list:
             else:
                 variant = text(**kw)
             pairs.append((label, base, variant, expect_identical))
+    pairs += slices_identity_pairs()
     return check_lowering_identity(pairs)
 
 
@@ -1244,8 +1502,15 @@ def run_semantic_checks(cells=None) -> list:
     findings: list = []
     for cell in (default_matrix() if cells is None else cells):
         prog = trace_cell(cell)
-        findings += check_collective_axes(prog.audit.collectives, prog.path)
-        if cell.topology in ("mesh", "fold", "fold4"):
+        allowed = None
+        if cell.sliced:
+            from ..parallel.mesh import MODEL_AXIS, SITE_AXIS, SLICE_AXIS
+
+            allowed = {SITE_AXIS, MODEL_AXIS, SLICE_AXIS}
+        findings += check_collective_axes(
+            prog.audit.collectives, prog.path, allowed_axes=allowed
+        )
+        if cell.topology in ("mesh", "fold", "fold4") or cell.sliced:
             # the vmap topology folds all sites onto one device — its
             # "collectives" are local reductions with no wire, so the
             # byte/precision proofs run where communication is real
@@ -1255,12 +1520,24 @@ def run_semantic_checks(cells=None) -> list:
                 tuple(leaf.shape)
                 for leaf in jax.tree_util.tree_leaves(prog.state.batch_stats)
             )
+            ici_colls = prog.audit.collectives
+            if cell.sliced:
+                # the ICI proof covers tiers 0+1: slice-ONLY collectives
+                # are the DCN tier's (proven by check_dcn_wire below);
+                # fused (slice, site) reduces still carry the per-device
+                # payload the ICI model describes
+                from ..parallel.mesh import SLICE_AXIS
+
+                ici_colls = [
+                    c for c in prog.audit.collectives
+                    if tuple(c.named_axes) != (SLICE_AXIS,)
+                ]
             findings += check_wire_bytes(
-                prog.audit.collectives, prog.engine, prog.state.params,
+                ici_colls, prog.engine, prog.state.params,
                 prog.block, prog.path, stats_shapes=stats_shapes,
             )
             findings += check_precision_flow(
-                prog.audit.collectives, prog.engine, prog.state.params,
+                ici_colls, prog.engine, prog.state.params,
                 prog.block, prog.path,
                 require_lowp_dot=(
                     cell.precision_bits == "16"
@@ -1269,6 +1546,12 @@ def run_semantic_checks(cells=None) -> list:
                 ),
                 dots=prog.audit.dots,
             )
+            if cell.sliced:
+                findings += check_dcn_wire(
+                    prog.audit.collectives, prog.engine, prog.state.params,
+                    prog.block, prog.sites_per_slice, prog.path,
+                    stats_shapes=stats_shapes, slices=prog.slices,
+                )
         if cell.donate:
             findings += check_donation(
                 prog.compiled, prog.args, (0,), prog.path

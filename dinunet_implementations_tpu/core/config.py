@@ -243,6 +243,22 @@ class TrainConfig:
     # --- TPU-build extras
     num_sites: int = 2
     sites_per_device: int = 1  # >1 folds several simulated sites onto one chip
+    # multi-slice scale-out (r18, parallel/mesh.py sliced_site_mesh): > 1
+    # lays an OUTER `slice` mesh axis over the site tier — sites spread
+    # num_slices ways, intra-slice aggregation rides ICI and ONE inter-slice
+    # hop per round crosses DCN carrying the already-reduced per-slice
+    # partial (quantized by dcn_wire_quant). 1 (default) is the legacy
+    # single-mesh program, bit-identical (S005 "slices-off"). Emulated on
+    # virtual CPU devices in one process; real hosts launch one
+    # runner/dcn_worker.py process per slice.
+    num_slices: int = 1
+    # the INTER-SLICE wire codec, independent of the intra-slice `wire_quant`
+    # ("" = follow wire_quant): "none" ships the per-slice partial fused with
+    # the intra-slice reduce (no slice-boundary re-quantization — sliced
+    # trajectories stay bit-exact vs unsliced); "bf16"/"int8"/"fp8" re-
+    # quantize the partial before the DCN hop, landing the shrink exactly
+    # where bandwidth is scarcest (S002-proven per-tier wire models).
+    dcn_wire_quant: str = ""
     # sequence/model parallelism (SURVEY.md §2.2 TPU extension): >1 builds a
     # (site, model) mesh; each site's model shards its sequence axis over the
     # model axis — ICALstm runs its BiLSTM as a ring LSTM, the multimodal

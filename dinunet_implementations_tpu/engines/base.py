@@ -66,6 +66,23 @@ follow the CODEC dtype, so an int8 wire models (and S002 proves) 1 byte per
 element. ``wire_quant="none"`` keeps the legacy ``precision_bits`` path
 program-identically (S005-gated).
 
+Multi-slice wires (r18, parallel/collectives.py three-tier forms): engines
+take a ``dcn_wire_quant`` factory kwarg (``""`` follows ``wire_quant``;
+``"none"`` opts the DCN tier out) and the wire model splits per tier:
+``wire_bytes``/``wire_shapes`` stay the INTRA-SLICE (ICI) per-device model —
+unchanged under slicing, because tiers 0+1 are exactly the packed two-level
+reduction within one slice — while ``dcn_bytes``/``dcn_wire_shapes`` model
+what ONE SLICE ships across the inter-slice DCN hop per round:
+``(grads_template, pack=1, sites_per_slice=1) -> bytes / [(shape, dtype),
+...]``. With a DCN codec the psum-shaped payloads collapse to re-quantized
+per-slice partials (dSGD ships its whole tree as ONE fused codec-grid
+vector — one payload per slice per round) and the factor gathers
+re-quantize their per-slice block before the slice hop; without one, the
+fused ``(slice, site)`` collectives ship the partial at the ICI wire dtype
+(the hierarchically-decomposed all-reduce). checks/semantic.py's DCN-tier
+rules prove both models against the traced sliced programs, so
+``dcn_bytes_per_slice_round`` is verified, not modeled.
+
 Byzantine-robust aggregation (r17, parallel/collectives.py ``ROBUST_AGGS``):
 engines take ``robust_agg`` (``none`` | ``norm_clip`` | ``trimmed_mean`` |
 ``coordinate_median``) plus ``robust_trim_frac`` / ``robust_clip_mult``
@@ -180,6 +197,15 @@ class Engine:
     # the payload dtype this engine quantizes its wire to (numpy dtype);
     # audited by checks/semantic.py rule S004 on the traced aggregation path
     wire_dtype: Any = None
+    # r18 DCN-tier models (module docstring): what ONE SLICE ships across
+    # the inter-slice hop per round — (grads, pack=1, sites_per_slice=1) ->
+    # bytes and [(shape, dtype), ...]. None -> telemetry's partial-at-wire-
+    # dtype fallback. Verified by the sliced semantic cells.
+    dcn_bytes: Callable | None = None
+    dcn_wire_shapes: Callable | None = None
+    # the dtype the DCN hop re-quantizes per-slice partials to; None = no
+    # DCN codec (the fused form ships the ICI wire dtype)
+    dcn_dtype: Any = None
 
 
 def robust_gather_wire(pack: int, robust_agg: str) -> list:
@@ -197,6 +223,33 @@ def robust_gather_wire(pack: int, robust_agg: str) -> list:
     if robust_agg in ("trimmed_mean", "coordinate_median"):
         return [((pack,), f32)]
     return []
+
+
+def robust_gather_dcn_wire(sites_per_slice: int, robust_agg: str) -> list:
+    """The robust bookkeeping gathers' DCN-tier operands (r18): under a
+    sliced axis each bookkeeping gather's inter-slice hop ships the slice's
+    assembled ``[sites_per_slice]`` vector at f32 — norms and weights are
+    never DCN-re-quantized (they steer the trim band / clip threshold, and
+    a codec round-trip there would move the defense itself)."""
+    import numpy as np
+
+    f32 = np.dtype(np.float32)
+    if robust_agg == "norm_clip":
+        return [((sites_per_slice,), f32), ((sites_per_slice,), f32)]
+    if robust_agg in ("trimmed_mean", "coordinate_median"):
+        return [((sites_per_slice,), f32)]
+    return []
+
+
+def wire_shapes_bytes(shapes) -> int:
+    """Byte total of one structured wire model (``[(shape, dtype), ...]``).
+    The ONE summation behind every engine's ``dcn_bytes``, so the scalar
+    and structured DCN models cannot drift for engines built this way (the
+    semantic checker's model-inconsistency case exists for engines that
+    hand-roll the pair)."""
+    import math
+
+    return sum(math.prod(s) * d.itemsize for s, d in shapes)
 
 
 def dense_wire_bytes(grads, itemsize: int = 4) -> int:

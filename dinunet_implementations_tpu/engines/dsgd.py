@@ -21,6 +21,7 @@ from ..parallel.collectives import (
     payload_cast,
     payload_dtype,
     payload_uncast,
+    resolve_dcn_codec,
     resolve_wire_codec,
     robust_site_reduce,
     site_all_gather,
@@ -32,14 +33,16 @@ from .base import (
     dense_wire_shapes,
     mask_dead_site,
     register_engine,
+    robust_gather_dcn_wire,
     robust_gather_wire,
+    wire_shapes_bytes,
 )
 
 
 @register_engine("dSGD")
 def make_dsgd(precision_bits="32", wire_quant="none", wire_stochastic=False,
               robust_agg="none", robust_trim_frac=0.2, robust_clip_mult=2.5,
-              **_unused) -> Engine:
+              dcn_wire_quant="", **_unused) -> Engine:
     # the wire codec (parallel/collectives.py, r14): "none" keeps the legacy
     # precision_bits payload cast byte-for-byte; int8/fp8 quantize each
     # site's payload (scale-per-payload) before the collective and the
@@ -47,6 +50,13 @@ def make_dsgd(precision_bits="32", wire_quant="none", wire_stochastic=False,
     codec = resolve_wire_codec(precision_bits, wire_quant, wire_stochastic)
     pdtype = np.dtype(codec.dtype)
     itemsize = pdtype.itemsize
+    # the inter-slice codec (r18): None = the fused form (no slice-boundary
+    # re-quantization); a WireCodec = the split form, where the whole dense
+    # tree's per-slice partials ship across DCN as ONE codec-grid vector
+    dcn = resolve_dcn_codec(
+        precision_bits, wire_quant, dcn_wire_quant, wire_stochastic
+    )
+    ddtype = np.dtype(dcn.dtype) if dcn is not None else None
     if robust_agg not in ROBUST_AGGS:
         raise ValueError(
             f"robust_agg must be one of {ROBUST_AGGS}, got {robust_agg!r}"
@@ -93,6 +103,36 @@ def make_dsgd(precision_bits="32", wire_quant="none", wire_stochastic=False,
             ] + extras
         return dense_wire_shapes(grads, pdtype) + extras
 
+    def dcn_wire_shapes(grads, pack: int = 1, sites_per_slice: int = 1):
+        # the inter-slice (DCN) tier, per slice per round (engines/base.py
+        # module docstring). Gather modes ship the slice's assembled
+        # [sites_per_slice, ...] per-site block per leaf (DCN-re-quantized
+        # when a codec is set); the psum modes ship the per-slice partial —
+        # as ONE fused codec-grid vector under a DCN codec (the whole tree,
+        # one collective launch on the expensive hop), per-leaf at the ICI
+        # wire dtype otherwise (the fused (slice, site) collective's
+        # operand). norm_clip's two bookkeeping gathers cross at f32.
+        import math
+
+        import jax
+
+        extras = robust_gather_dcn_wire(sites_per_slice, robust_agg)
+        if gather_mode:
+            d = ddtype if ddtype is not None else pdtype
+            return [
+                ((sites_per_slice,) + tuple(g.shape), d)
+                for g in jax.tree.leaves(grads)
+            ] + extras
+        if ddtype is not None:
+            total = sum(
+                math.prod(g.shape) for g in jax.tree.leaves(grads)
+            )
+            return [((total,), ddtype)] + extras
+        return dense_wire_shapes(grads, pdtype) + extras
+
+    def dcn_bytes(grads, pack: int = 1, sites_per_slice: int = 1) -> int:
+        return wire_shapes_bytes(dcn_wire_shapes(grads, pack, sites_per_slice))
+
     def aggregate(grads, state, weight, axis_name, live=None):
         # dead/quarantined sites: payload zeroed, weight zeroed — the
         # weighted mean renormalizes over live weight only (robustness/).
@@ -134,7 +174,9 @@ def make_dsgd(precision_bits="32", wire_quant="none", wire_stochastic=False,
                 )
             agg = jax.tree.map(
                 lambda g: robust_site_reduce(
-                    site_all_gather(g, axis_name).astype(jnp.float32),
+                    site_all_gather(
+                        g, axis_name, dcn_wire=dcn
+                    ).astype(jnp.float32),
                     w_all, robust_agg, robust_trim_frac,
                 ),
                 payload,
@@ -146,7 +188,7 @@ def make_dsgd(precision_bits="32", wire_quant="none", wire_stochastic=False,
             # epoch)
             payload = payload_cast(grads, precision_bits)
             agg = site_weighted_mean(
-                payload, weight, axis_name, wire_dtype=pdtype
+                payload, weight, axis_name, wire_dtype=pdtype, dcn_wire=dcn
             )
             return payload_uncast(agg, grads), state
         # quantized wire: each (virtual) site round-trips its payload through
@@ -155,12 +197,18 @@ def make_dsgd(precision_bits="32", wire_quant="none", wire_stochastic=False,
         # on the packed path the in-register partial re-quantizes before the
         # single cross-device psum (two_level_psum). The traced
         # quantize→psum chain is what S002/S004 resolve to prove the shrink.
+        # Sliced axes: the DCN codec re-quantizes the per-slice partials and
+        # the whole tree crosses DCN as one fused vector (weighted_tree_sum).
         packed = isinstance(axis_name, PackedAxis)
         payload = jax.tree.map(
             lambda g: codec.compress(g, batched=packed), grads
         )
-        agg = site_weighted_mean(payload, weight, axis_name, wire_dtype=codec)
+        agg = site_weighted_mean(
+            payload, weight, axis_name, wire_dtype=codec, dcn_wire=dcn
+        )
         return payload_uncast(agg, grads), state
 
     return Engine("dSGD", init, aggregate, wire_bytes=wire_bytes,
-                  wire_shapes=wire_shapes, wire_dtype=pdtype)
+                  wire_shapes=wire_shapes, wire_dtype=pdtype,
+                  dcn_bytes=dcn_bytes, dcn_wire_shapes=dcn_wire_shapes,
+                  dcn_dtype=ddtype)

@@ -26,6 +26,7 @@ from ..parallel.collectives import (
     PackedAxis,
     clip_site_gradients,
     payload_dtype,
+    resolve_dcn_codec,
     resolve_wire_codec,
     robust_site_reduce,
     site_all_gather,
@@ -38,7 +39,9 @@ from .base import (
     Engine,
     mask_dead_site,
     register_engine,
+    robust_gather_dcn_wire,
     robust_gather_wire,
+    wire_shapes_bytes,
 )
 from .lowrank import (
     from_matrix,
@@ -61,6 +64,7 @@ def make_powersgd(
     robust_agg="none",
     robust_trim_frac=0.2,
     robust_clip_mult=2.5,
+    dcn_wire_quant="",
     **_unused,
 ) -> Engine:
     if robust_agg not in ROBUST_AGGS:
@@ -88,6 +92,15 @@ def make_powersgd(
     import numpy as np
 
     wdtype = np.dtype(codec.dtype)
+    # the inter-slice codec (r18): each factor's per-slice partial (and the
+    # dense 1-D partials) re-quantize before their slice-only psum; the two
+    # factor hops cannot fuse — q' depends on the globally-orthonormalized
+    # P, so each factor's DCN reduce is its own collective by data
+    # dependency. None = the fused form.
+    dcn = resolve_dcn_codec(
+        precision_bits, wire_quant, dcn_wire_quant, wire_stochastic
+    )
+    ddtype = np.dtype(dcn.dtype) if dcn is not None else None
 
     def _compress(x):
         if codec.quant == "none":
@@ -176,6 +189,40 @@ def make_powersgd(
             shapes += [(s, np.dtype(np.float32)) for s in dense]
         return shapes + robust_gather_wire(pack, robust_agg)
 
+    def dcn_wire_shapes(grads, pack: int = 1, sites_per_slice: int = 1):
+        # the inter-slice (DCN) tier, per slice per round: TWO slice hops
+        # per compressible leaf — P's per-slice partial, then (after the
+        # global orthonormalization) q's — each re-quantized through the
+        # DCN codec when one is set; dense 1-D partials per leaf. Gather
+        # modes ship the slice's assembled [sites_per_slice, ...] factor /
+        # dense blocks instead, plus the weight bookkeeping gather at f32.
+        import numpy as np
+
+        groups, dense = lowrank_rank_groups(grads, dad_reduction_rank)
+        fdtype = ddtype if ddtype is not None else wdtype
+        dense_dtype = (
+            ddtype if ddtype is not None else np.dtype(np.float32)
+        )
+        shapes = []
+        for r, mns in groups:
+            for m, n in mns:
+                if gather_mode:
+                    shapes.append(((sites_per_slice, m, r), fdtype))
+                    shapes.append(((sites_per_slice, n, r), fdtype))
+                else:
+                    shapes.append(((m, r), fdtype))
+                    shapes.append(((n, r), fdtype))
+        if gather_mode:
+            shapes += [
+                ((sites_per_slice,) + tuple(s), dense_dtype) for s in dense
+            ]
+        else:
+            shapes += [(tuple(s), dense_dtype) for s in dense]
+        return shapes + robust_gather_dcn_wire(sites_per_slice, robust_agg)
+
+    def dcn_bytes(grads, pack: int = 1, sites_per_slice: int = 1) -> int:
+        return wire_shapes_bytes(dcn_wire_shapes(grads, pack, sites_per_slice))
+
     def aggregate(grads, state, weight, axis_name, live=None):
         # Dead-site round: G zeroed (NaN-safe where) and weight zeroed, so
         # this site's M = e contributes nothing to the psum'd P/Q' (scale 0)
@@ -214,10 +261,14 @@ def make_powersgd(
         def agg_leaf(g, q, e):
             if q is None and gather_mode:
                 # robust dense path: gather the per-site leaf and reduce
-                # robustly per coordinate (wire ×pack, modeled above)
+                # robustly per coordinate (wire ×pack, modeled above; the
+                # slice hop re-quantizes through the DCN codec, matching
+                # the dcn_wire_shapes model — rankDAD's dense path ditto)
                 return (
                     robust_site_reduce(
-                        site_all_gather(g.astype(jnp.float32), axis_name),
+                        site_all_gather(
+                            g.astype(jnp.float32), axis_name, dcn_wire=dcn
+                        ),
                         w_all, robust_agg, robust_trim_frac,
                     ).astype(g.dtype),
                     None,
@@ -225,9 +276,12 @@ def make_powersgd(
                 )
             if q is None:
                 if packed:
-                    # dense 1-D leaf: two-level weighted psum (K-invariant)
+                    # dense 1-D leaf: two-level weighted psum (K-invariant;
+                    # three-level with the DCN codec on sliced axes)
                     return (
-                        weighted_site_sum(g, scale, axis_name).astype(g.dtype),
+                        weighted_site_sum(
+                            g, scale, axis_name, dcn_wire=dcn
+                        ).astype(g.dtype),
                         None,
                         None,
                     )
@@ -246,7 +300,8 @@ def make_powersgd(
                 # codec grid is what crosses the wire.
                 M = jax.vmap(to_matrix)(g).astype(jnp.float32) + e
                 Pg = site_all_gather(
-                    _compress_rows(lp_matmul(M, q, mm_dtype)), axis_name
+                    _compress_rows(lp_matmul(M, q, mm_dtype)), axis_name,
+                    dcn_wire=dcn,
                 )  # [S, m, r]
                 P = orthonormalize(robust_site_reduce(
                     Pg.astype(jnp.float32), w_all, robust_agg,
@@ -257,6 +312,7 @@ def make_powersgd(
                         lp_matmul(jnp.swapaxes(M, 1, 2), P, mm_dtype)
                     ),
                     axis_name,
+                    dcn_wire=dcn,
                 )  # [S, n, r]
                 q_new = robust_site_reduce(
                     Qg.astype(jnp.float32), w_all, robust_agg,
@@ -301,12 +357,13 @@ def make_powersgd(
                 sc = scale[:, None, None]
                 M = jax.vmap(to_matrix)(g).astype(jnp.float32) + e
                 P = two_level_psum(
-                    lp_matmul(M, q, mm_dtype) * sc, axis_name, wire_arg
+                    lp_matmul(M, q, mm_dtype) * sc, axis_name, wire_arg,
+                    dcn_wire=dcn,
                 )
                 P = orthonormalize(P)
                 q_new = two_level_psum(
                     lp_matmul(jnp.swapaxes(M, 1, 2), P, mm_dtype) * sc,
-                    axis_name, wire_arg,
+                    axis_name, wire_arg, dcn_wire=dcn,
                 )
                 G_hat = P @ q_new.T  # the global aggregate, replicated
                 e_new = M - G_hat[None]
@@ -347,4 +404,6 @@ def make_powersgd(
         return agg, new_state
 
     return Engine("powerSGD", init, aggregate, wire_bytes=wire_bytes,
-                  wire_shapes=wire_shapes, wire_dtype=wdtype)
+                  wire_shapes=wire_shapes, wire_dtype=wdtype,
+                  dcn_bytes=dcn_bytes, dcn_wire_shapes=dcn_wire_shapes,
+                  dcn_dtype=ddtype)

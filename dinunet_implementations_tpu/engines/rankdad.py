@@ -45,6 +45,7 @@ from ..parallel.collectives import (
     PackedAxis,
     clip_site_gradients,
     payload_dtype,
+    resolve_dcn_codec,
     resolve_wire_codec,
     robust_site_reduce,
     site_all_gather,
@@ -56,7 +57,9 @@ from .base import (
     Engine,
     mask_dead_site,
     register_engine,
+    robust_gather_dcn_wire,
     robust_gather_wire,
+    wire_shapes_bytes,
 )
 from .lowrank import (
     default_omega,
@@ -82,6 +85,7 @@ def make_rankdad(
     robust_agg="none",
     robust_trim_frac=0.2,
     robust_clip_mult=2.5,
+    dcn_wire_quant="",
     **_unused,
 ) -> Engine:
     if robust_agg not in ROBUST_AGGS:
@@ -106,6 +110,13 @@ def make_rankdad(
     import numpy as np
 
     wdtype = np.dtype(codec.dtype)
+    # the inter-slice codec (r18): the per-slice factor block re-quantizes
+    # (scale per virtual-site row) before the DCN gather hop, and the dense
+    # 1-D partials before their slice psum; None = the fused form
+    dcn = resolve_dcn_codec(
+        precision_bits, wire_quant, dcn_wire_quant, wire_stochastic
+    )
+    ddtype = np.dtype(dcn.dtype) if dcn is not None else None
 
     def _use_fused() -> bool:
         # fused Pallas power iteration (ops/poweriter_pallas.py): None =
@@ -185,6 +196,36 @@ def make_rankdad(
             shapes += [(s, np.dtype(np.float32)) for s in dense]
         return shapes + robust_gather_wire(pack, robust_agg)
 
+    def dcn_wire_shapes(grads, pack: int = 1, sites_per_slice: int = 1):
+        # the inter-slice (DCN) tier, per slice per round: each rank class's
+        # gather hop ships the slice's assembled [sites_per_slice, Σ(m+n), r]
+        # factor block (DCN-re-quantized per virtual-site row when a codec
+        # is set, at the ICI wire dtype otherwise — gathers are always
+        # hierarchical under slicing); the dense 1-D leaves ship their
+        # per-slice partials (codec grid under a DCN codec, f32 fused
+        # otherwise), gathered ×sites_per_slice in the robust gather modes.
+        import numpy as np
+
+        groups, dense = lowrank_rank_groups(grads, dad_reduction_rank)
+        fdtype = ddtype if ddtype is not None else wdtype
+        shapes = [
+            ((sites_per_slice, sum(m + n for m, n in mns), r), fdtype)
+            for r, mns in groups
+        ]
+        dense_dtype = (
+            ddtype if ddtype is not None else np.dtype(np.float32)
+        )
+        if gather_mode:
+            shapes += [
+                ((sites_per_slice,) + tuple(s), dense_dtype) for s in dense
+            ]
+        else:
+            shapes += [(tuple(s), dense_dtype) for s in dense]
+        return shapes + robust_gather_dcn_wire(sites_per_slice, robust_agg)
+
+    def dcn_bytes(grads, pack: int = 1, sites_per_slice: int = 1) -> int:
+        return wire_shapes_bytes(dcn_wire_shapes(grads, pack, sites_per_slice))
+
     def aggregate(grads, state, weight, axis_name, live=None):
         # Dead-site round: G zeroed (NaN-safe where) + weight zeroed — the
         # site still factorizes (same program, no recompile) but its Q·scale
@@ -248,12 +289,18 @@ def make_rankdad(
                 # robustly per coordinate (the dense half of the wire now
                 # genuinely scales with the pack factor — modeled above)
                 out[i] = robust_site_reduce(
-                    site_all_gather(g.astype(jnp.float32), axis_name),
+                    site_all_gather(
+                        g.astype(jnp.float32), axis_name, dcn_wire=dcn
+                    ),
                     w_all, robust_agg, robust_trim_frac,
                 ).astype(g.dtype)
             elif packed:
                 # dense dSGD path for 1-D leaves: two-level weighted psum
-                out[i] = weighted_site_sum(g, scale, axis_name).astype(g.dtype)
+                # (three-level on sliced axes — the partial re-quantizes
+                # through the DCN codec before the slice hop)
+                out[i] = weighted_site_sum(
+                    g, scale, axis_name, dcn_wire=dcn
+                ).astype(g.dtype)
             else:
                 out[i] = jax.lax.psum(
                     g.astype(jnp.float32) * scale, axis_name
@@ -313,7 +360,7 @@ def make_rankdad(
                     # S002/S004 resolve to prove the byte shrink
                     parts.append(codec.compress(P, batched=packed))
                     parts.append(codec.compress(qs, batched=packed))
-            gathered = site_all_gather_packed(parts, axis_name)
+            gathered = site_all_gather_packed(parts, axis_name, dcn_wire=dcn)
             for k, (i, (P, Q)) in enumerate(zip(idxs, pqs)):
                 if gather_mode:
                     # per-site rank-r reconstructions [S, m, n], robustly
@@ -357,4 +404,6 @@ def make_rankdad(
         return jax.tree.unflatten(treedef, out), new_state
 
     return Engine("rankDAD", init, aggregate, wire_bytes=wire_bytes,
-                  wire_shapes=wire_shapes, wire_dtype=wdtype)
+                  wire_shapes=wire_shapes, wire_dtype=wdtype,
+                  dcn_bytes=dcn_bytes, dcn_wire_shapes=dcn_wire_shapes,
+                  dcn_dtype=ddtype)

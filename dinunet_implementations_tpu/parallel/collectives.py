@@ -27,6 +27,34 @@ Axis forms (r12 — site packing). ``axis_name`` may be:
   unbatched partial over the mesh axis. Per-device wire bytes are then
   independent of K for every psum-shaped exchange; only genuine per-site
   payloads (the low-rank factor all-gather) scale with K.
+
+Three-tier form (r18 multi-slice, ``PackedAxis.slice_name`` set): the mesh
+carries an OUTER ``slice`` axis whose collectives cross DCN, the slow
+inter-slice fabric (parallel/mesh.py ``sliced_site_mesh``). Reductions grow
+a tier: the in-register pack sum (tier 0) and the intra-slice psum over ICI
+(tier 1) as before, then an inter-slice hop (tier 2) that ships only the
+already-reduced per-slice partial. The tier-2 payload treatment is the
+``dcn_wire`` argument, independent of the intra-slice codec:
+
+- ``dcn_wire=None`` (``dcn_wire_quant`` resolves to "none") — the FUSED
+  form: tiers 1+2 are ONE collective naming ``(slice, site)`` together.
+  Value-wise this is exactly the flat single-mesh reduce (same members,
+  same reduction order — sliced==unsliced trajectories stay bit-exact
+  site-for-site), and it is what XLA/the TPU runtime hierarchically
+  decomposes over ICI+DCN on real multi-slice hardware. Bookkeeping
+  reductions (losses, weight totals, sync-BN) always take this form —
+  they must never be re-quantized at a slice boundary.
+- ``dcn_wire=WireCodec`` — the SPLIT form: psum over ``site`` completes the
+  per-slice partial, the partial re-quantizes through the DCN codec (scale
+  per payload), and ONE psum naming only ``slice`` ships it across DCN.
+  int8/fp8 then land their 4x shrink exactly where bandwidth is scarcest:
+  the expensive hop carries one codec-grid payload per slice per round
+  instead of one dense payload per device.
+
+Gathers are always hierarchical under a sliced axis (gather over ``site``,
+optionally DCN-re-quantize the per-slice block, gather over ``slice``) —
+gathering is exact, so the site order and values match the flat form
+bit-for-bit when no DCN codec is set.
 """
 
 from __future__ import annotations
@@ -48,10 +76,25 @@ class PackedAxis:
     sum over that axis, then one cross-device collective over ``name``).
     ``name=None`` means no mesh half (every virtual site on one device — the
     cross-device collective degenerates to the identity); trace-time static,
-    safe to close over in jitted code."""
+    safe to close over in jitted code.
+
+    ``slice_name`` (r18 multi-slice) names the OUTER inter-slice mesh axis
+    when the mesh has one — reductions then grow the DCN tier (module
+    docstring: fused vs split forms, picked per call by ``dcn_wire``).
+    ``slice_name=None`` keeps the exact legacy two-level program."""
 
     name: str | None  # the mesh axis (from parallel/mesh.py constants)
     pack: int  # K — virtual sites per device (the leading payload axis)
+    slice_name: str | None = None  # the DCN mesh axis (sliced meshes only)
+
+    def reduce_axes(self):
+        """The axis names a FUSED (bookkeeping / dcn_wire=None) reduction
+        spans: ``(slice, site)`` on the sliced form — one collective over
+        both tiers, bit-identical to the flat single-mesh reduce — else
+        just ``name``."""
+        if self.slice_name is not None:
+            return (self.slice_name, self.name)
+        return self.name
 
 
 def _bcast(scale, like):
@@ -84,7 +127,9 @@ def site_weight_scale(weight, axis_name=SITE_AXIS):
     if isinstance(axis_name, PackedAxis):
         total = jnp.sum(w)
         if axis_name.name is not None:
-            total = jax.lax.psum(total, axis_name.name)
+            # bookkeeping reduce: spans the slice tier FUSED when present
+            # (never re-quantized at a slice boundary — module docstring)
+            total = jax.lax.psum(total, axis_name.reduce_axes())
     else:
         total = jax.lax.psum(w, axis_name)
     return jnp.where(total > 0, w / jnp.maximum(total, 1e-12), 0.0)
@@ -102,36 +147,113 @@ def payload_uncast(tree, like):
     return jax.tree.map(lambda g, l: g.astype(l.dtype), tree, like)
 
 
-def two_level_psum(x, axes: PackedAxis, wire_dtype=None):
-    """The packed reduction primitive: in-register sum over the leading
-    ``[K]`` virtual-site axis, the partial optionally quantized to
-    ``wire_dtype`` (what the device actually ships — f32 accumulation resumes
-    after the collective, policy above), then ONE cross-device psum of the
-    UNBATCHED partial. The wire cost is K-independent by construction.
-    ``wire_dtype`` may be a plain dtype (legacy bf16 round-trip) or a
-    :class:`WireCodec` (r14 quantized wires — the partial re-quantizes with
-    its own per-payload scale before the cross-device hop)."""
+def _pack_partial(x, wire_dtype):
+    """Tier 0 + intra-slice wire quantization: in-register sum over the
+    leading ``[K]`` virtual-site axis, the partial optionally quantized to
+    ``wire_dtype`` (plain dtype round-trip or a :class:`WireCodec`)."""
     part = jnp.sum(x, axis=0)
     if isinstance(wire_dtype, WireCodec):
         part = wire_dtype.compress(part)
     elif wire_dtype is not None:
         part = wire_compress(part, wire_dtype)
+    return part
+
+
+def _dcn_hop(partial, axes: PackedAxis, dcn_wire):
+    """Tier 2 (the SPLIT form): re-quantize the completed per-slice partial
+    through the DCN codec and ship it in ONE psum naming only the slice
+    axis — the only collective form that crosses DCN alone, which is what
+    checks/semantic.py's DCN-tier rules key on."""
+    return jax.lax.psum(dcn_wire.compress(partial), axes.slice_name)
+
+
+def three_level_psum(x, axes: PackedAxis, wire_dtype=None, dcn_wire=None):
+    """The hierarchical reduction primitive (module docstring): tier 0 is
+    the in-register pack sum, tier 1 the intra-slice psum of the UNBATCHED
+    partial (quantized to ``wire_dtype`` — what the device ships over ICI;
+    f32 accumulation resumes after the collective), tier 2 the inter-slice
+    DCN hop. With ``axes.slice_name=None`` this IS the legacy two-level
+    reduction, op for op. With a slice axis, ``dcn_wire=None`` fuses tiers
+    1+2 into one ``(slice, site)`` collective (bit-identical values to the
+    flat reduce); a :class:`WireCodec` splits them, re-quantizing the
+    per-slice partial before the slice-only psum. The ICI wire cost is
+    K-independent and the DCN hop ships one partial per slice per round."""
+    part = _pack_partial(x, wire_dtype)
     if axes.name is None:
         return part
-    return jax.lax.psum(part, axes.name)
+    if axes.slice_name is None:
+        return jax.lax.psum(part, axes.name)
+    if dcn_wire is None:
+        return jax.lax.psum(part, axes.reduce_axes())
+    return _dcn_hop(jax.lax.psum(part, axes.name), axes, dcn_wire)
 
 
-def weighted_site_sum(g, scale, axis_name, wire_dtype=None):
+def two_level_psum(x, axes: PackedAxis, wire_dtype=None, dcn_wire=None):
+    """The r12 name for :func:`three_level_psum` — kept because every packed
+    call site reads naturally as "two-level" on single-slice meshes, where
+    the lowering is unchanged op for op; sliced axes route the same call
+    through the DCN tier."""
+    return three_level_psum(x, axes, wire_dtype, dcn_wire)
+
+
+def weighted_site_sum(g, scale, axis_name, wire_dtype=None, dcn_wire=None):
     """One dense payload leaf of a weighted exchange: ``Σ_s scale_s · g_s``
     accumulated in f32. Classic axes psum the per-site scaled value; a
     :class:`PackedAxis` takes the two-level route (``scale`` is then the
-    ``[K]`` vector and ``g`` carries the leading pack axis). ``wire_dtype``
-    quantizes the packed partial only — on the classic path the per-member
-    payload is whatever the caller already cast it to."""
+    ``[K]`` vector and ``g`` carries the leading pack axis), growing the
+    DCN tier on sliced axes (``dcn_wire`` — :func:`three_level_psum`).
+    ``wire_dtype`` quantizes the packed partial only — on the classic path
+    the per-member payload is whatever the caller already cast it to."""
     gf = g.astype(jnp.float32)
     if isinstance(axis_name, PackedAxis):
-        return two_level_psum(gf * _bcast(scale, gf), axis_name, wire_dtype)
+        return three_level_psum(
+            gf * _bcast(scale, gf), axis_name, wire_dtype, dcn_wire
+        )
     return jax.lax.psum(gf * scale, axis_name)
+
+
+def weighted_tree_sum(tree, scale, axes: PackedAxis, wire_dtype=None,
+                      dcn_wire=None):
+    """A whole pytree's weighted exchange with ONE inter-slice collective.
+
+    Per leaf, tiers 0+1 run exactly like :func:`weighted_site_sum`; the DCN
+    tier then ships the ENTIRE tree of per-slice partials in a single
+    slice-only psum — every leaf DCN-re-quantized (scale per payload),
+    raveled and concatenated, so the expensive hop pays one collective
+    launch per round instead of one per leaf. Single-slice axes (or
+    ``dcn_wire=None``) reduce per leaf exactly like the mapped
+    :func:`weighted_site_sum` — same ops, so the legacy program is
+    untouched. dSGD's whole dense exchange rides this (engines/dsgd.py)."""
+    if not isinstance(axes, PackedAxis):
+        return jax.tree.map(
+            lambda g: weighted_site_sum(g, scale, axes, wire_dtype), tree
+        )
+    if axes.slice_name is None or dcn_wire is None or axes.name is None:
+        return jax.tree.map(
+            lambda g: weighted_site_sum(
+                g, scale, axes, wire_dtype, dcn_wire
+            ),
+            tree,
+        )
+    partials = jax.tree.map(
+        lambda g: jax.lax.psum(
+            _pack_partial(
+                g.astype(jnp.float32) * _bcast(scale, g), wire_dtype
+            ),
+            axes.name,
+        ),
+        tree,
+    )
+    leaves, treedef = jax.tree.flatten(partials)
+    comp = [dcn_wire.compress(leaf).reshape(-1) for leaf in leaves]
+    flat = comp[0] if len(comp) == 1 else jnp.concatenate(comp)
+    tot = jax.lax.psum(flat, axes.slice_name)
+    outs, off = [], 0
+    for leaf in leaves:
+        n = leaf.size
+        outs.append(tot[off:off + n].reshape(leaf.shape))
+        off += n
+    return jax.tree.unflatten(treedef, outs)
 
 
 def site_sum(tree, axis_name=SITE_AXIS):
@@ -144,16 +266,19 @@ def site_sum(tree, axis_name=SITE_AXIS):
 def site_mean(tree, axis_name=SITE_AXIS):
     """Unweighted mean across sites."""
     if isinstance(axis_name, PackedAxis):
-        n = axis_name.pack * (
-            1 if axis_name.name is None else axis_size(axis_name.name)
-        )
+        n = axis_name.pack
+        if axis_name.name is not None:
+            n = n * axis_size(axis_name.name)
+        if axis_name.slice_name is not None:
+            n = n * axis_size(axis_name.slice_name)
         return jax.tree.map(
             lambda g: two_level_psum(g, axis_name) / n, tree
         )
     return jax.tree.map(lambda g: jax.lax.pmean(g, axis_name), tree)
 
 
-def site_weighted_mean(tree, weight, axis_name=SITE_AXIS, wire_dtype=None):
+def site_weighted_mean(tree, weight, axis_name=SITE_AXIS, wire_dtype=None,
+                       dcn_wire=None):
     """Example-count-weighted mean across sites.
 
     dSGD semantics: each site contributes its gradient weighted by how many
@@ -163,17 +288,18 @@ def site_weighted_mean(tree, weight, axis_name=SITE_AXIS, wire_dtype=None):
     vector under a :class:`PackedAxis`, where the local weighted partial is
     reduced in-register and quantized to ``wire_dtype`` before the single
     cross-device psum (the two-level form; per-device wire bytes do not scale
-    with K).
+    with K). On a sliced axis with a DCN codec, the whole tree's per-slice
+    partials ship across DCN in ONE fused slice-only collective
+    (:func:`weighted_tree_sum`) — one payload per slice per round.
     """
     scale = site_weight_scale(weight, axis_name)
     # Accumulate in fp32 even for bf16 payloads; cast back only after the psum.
-    return jax.tree.map(
-        lambda g: weighted_site_sum(g, scale, axis_name, wire_dtype).astype(g.dtype),
-        tree,
-    )
+    agg = weighted_tree_sum(tree, scale, axis_name, wire_dtype, dcn_wire)
+    return jax.tree.map(lambda a, g: a.astype(g.dtype), agg, tree)
 
 
-def site_all_gather(x, axis_name=SITE_AXIS, axis: int = 0, tiled: bool = False):
+def site_all_gather(x, axis_name=SITE_AXIS, axis: int = 0, tiled: bool = False,
+                    dcn_wire=None):
     """Gather per-site values to every site (used by the low-rank engines to
     share rank-r factors instead of full gradients).
 
@@ -187,7 +313,16 @@ def site_all_gather(x, axis_name=SITE_AXIS, axis: int = 0, tiled: bool = False):
     A :class:`PackedAxis` gathers the device's whole ``[K, ...]`` virtual-site
     block in ONE collective and flattens to the same global (device-major)
     site order — this is the one exchange whose wire bytes genuinely scale
-    with K (every virtual site's factors must reach every device)."""
+    with K (every virtual site's factors must reach every device).
+
+    Sliced axes (``slice_name`` set) gather hierarchically: the intra-slice
+    gather assembles the slice's ``[S/slices, ...]`` block over ICI, then ONE
+    inter-slice gather ships that block across DCN — re-quantized per
+    virtual-site row through ``dcn_wire`` when a DCN codec is set (payload
+    gathers only; bookkeeping gathers pass ``dcn_wire=None`` and cross
+    exact). The flattened result is the same slice-major global site order
+    as the data layout — gathering is exact, so without a DCN codec the
+    values match the flat single-mesh gather bit-for-bit."""
     if isinstance(axis_name, str):
         return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
     if isinstance(axis_name, PackedAxis):
@@ -195,7 +330,15 @@ def site_all_gather(x, axis_name=SITE_AXIS, axis: int = 0, tiled: bool = False):
         if axis_name.name is None:
             return x  # every virtual site already local: [S, ...] as-is
         out = jax.lax.all_gather(x, axis_name.name, axis=0)
-        return out.reshape((-1,) + x.shape[1:])
+        out = out.reshape((-1,) + x.shape[1:])
+        if axis_name.slice_name is not None:
+            if dcn_wire is not None:
+                # per-virtual-site-row DCN re-quantization of the slice's
+                # block before the expensive hop (batched: scale per row)
+                out = dcn_wire.compress(out, batched=True)
+            out = jax.lax.all_gather(out, axis_name.slice_name, axis=0)
+            out = out.reshape((-1,) + x.shape[1:])
+        return out
     assert axis == 0 and not tiled, "tuple-axis gather supports leading-dim stacking only"
     out = x
     for ax in reversed(tuple(axis_name)):
@@ -203,7 +346,7 @@ def site_all_gather(x, axis_name=SITE_AXIS, axis: int = 0, tiled: bool = False):
     return out.reshape((-1,) + x.shape)
 
 
-def site_all_gather_packed(parts, axis_name=SITE_AXIS):
+def site_all_gather_packed(parts, axis_name=SITE_AXIS, dcn_wire=None):
     """ONE ``all_gather`` for a list of same-dtype ``[k_i, ...]`` arrays
     (matching trailing dims): concatenate along axis 0, gather, re-split into
     ``[S, k_i, ...]`` views.
@@ -222,9 +365,11 @@ def site_all_gather_packed(parts, axis_name=SITE_AXIS):
     packed = isinstance(axis_name, PackedAxis)
     cat_axis = 1 if packed else 0
     if len(parts) == 1:
-        return [site_all_gather(parts[0], axis_name)]
+        return [site_all_gather(parts[0], axis_name, dcn_wire=dcn_wire)]
     sizes = [p.shape[cat_axis] for p in parts]
-    gathered = site_all_gather(jnp.concatenate(parts, axis=cat_axis), axis_name)
+    gathered = site_all_gather(
+        jnp.concatenate(parts, axis=cat_axis), axis_name, dcn_wire=dcn_wire
+    )
     outs, off = [], 0
     for k in sizes:
         outs.append(gathered[:, off:off + k])
@@ -374,6 +519,23 @@ def resolve_wire_codec(precision_bits="32", wire_quant: str = "none",
     )
 
 
+def resolve_dcn_codec(precision_bits="32", wire_quant: str = "none",
+                      dcn_wire_quant: str = "", stochastic: bool = False):
+    """Resolve ``TrainConfig.dcn_wire_quant`` to the inter-slice codec, or
+    ``None`` — the FUSED form (no re-quantization at the slice boundary;
+    tiers 1+2 are one collective, sliced==unsliced stays bit-exact).
+
+    ``""`` (the config default) follows ``wire_quant``, so quantized wires
+    land their shrink on BOTH tiers unless the operator splits them;
+    ``"none"`` explicitly opts the DCN tier out while the ICI wire stays
+    quantized. Single-slice meshes never consult this — there is no DCN
+    tier to codec."""
+    eff = dcn_wire_quant or wire_quant
+    if eff == "none":
+        return None
+    return resolve_wire_codec(precision_bits, eff, stochastic)
+
+
 # ---------------------------------------------------------------------------
 # byzantine-robust site-axis reducers (r17)
 # ---------------------------------------------------------------------------
@@ -514,8 +676,13 @@ def clip_site_gradients(grads, weight, axis_name, clip_mult: float):
 def site_index(axis_name=SITE_AXIS):
     if isinstance(axis_name, PackedAxis):
         # per-device block start: virtual site d*K + j lives at row j of the
-        # packed leaf on mesh member d (device-major global order)
-        base = 0 if axis_name.name is None else jax.lax.axis_index(axis_name.name)
+        # packed leaf on mesh member d (device-major global order; sliced
+        # meshes linearize slice-major over the (slice, site) pair — the
+        # same order the P((slice, site)) data layout shards to)
+        if axis_name.name is None:
+            base = 0
+        else:
+            base = jax.lax.axis_index(axis_name.reduce_axes())
         return base * axis_name.pack
     return jax.lax.axis_index(axis_name)
 
@@ -523,5 +690,7 @@ def site_index(axis_name=SITE_AXIS):
 def site_count(axis_name=SITE_AXIS):
     if isinstance(axis_name, PackedAxis):
         n = 1 if axis_name.name is None else axis_size(axis_name.name)
+        if axis_name.slice_name is not None:
+            n = n * axis_size(axis_name.slice_name)
         return n * axis_name.pack
     return axis_size(axis_name)

@@ -28,7 +28,7 @@ import jax
 import numpy as np
 
 from ..robustness.retry import with_retry
-from .mesh import MODEL_AXIS, SITE_AXIS
+from .mesh import MODEL_AXIS, SITE_AXIS, SLICE_AXIS, site_axis_of
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 _initialized = False
@@ -239,6 +239,88 @@ def multihost_site_mesh(
     return jax.sharding.Mesh(arr, (SITE_AXIS, MODEL_AXIS))
 
 
+def multihost_sliced_site_mesh(
+    num_slices: int | None = None,
+    sites_per_slice: int | None = None,
+    sites_per_device: int = 1,
+    model_axis_size: int = 1,
+    devices: list | None = None,
+) -> jax.sharding.Mesh:
+    """The real-host form of ``parallel/mesh.py sliced_site_mesh``: a global
+    ``(slice, site, model)`` mesh where the SLICE axis tiles processes —
+    the multi-slice deployment shape (one ``runner/dcn_worker.py`` process
+    per TPU slice), so the ONLY traffic that crosses DCN is the per-round
+    inter-slice hop of the three-tier aggregation, and the intra-slice
+    psum + the model axis never leave a process's ICI domain.
+
+    ``num_slices`` defaults to ``jax.process_count()`` (the 1:1
+    process-per-slice deployment) and must divide it; ``sites_per_slice``
+    is the VIRTUAL site count per slice (defaults to packing every local
+    device: ``local_devices // model_axis_size × sites_per_device``).
+    Single-process callers collapse to :func:`sliced_site_mesh` over the
+    local devices — the CPU-emulation path."""
+    n_proc = jax.process_count()
+    if num_slices is None:
+        num_slices = n_proc if n_proc > 1 else 1
+    devices = devices if devices is not None else jax.devices()
+    per_proc = len(devices) // max(n_proc, 1)
+    if sites_per_slice is None:
+        procs_per_slice = max(n_proc // max(num_slices, 1), 1)
+        sites_per_slice = max(
+            per_proc // model_axis_size, 1
+        ) * sites_per_device * procs_per_slice
+    if n_proc == 1:
+        from .mesh import sliced_site_mesh
+
+        return sliced_site_mesh(
+            num_slices, sites_per_slice, sites_per_device, devices,
+            model_axis_size,
+        )
+    if n_proc % num_slices:
+        raise ValueError(
+            f"num_slices={num_slices} must divide the process count "
+            f"({n_proc}) — slices are process granules over DCN"
+        )
+    if sites_per_slice % sites_per_device:
+        raise ValueError(
+            f"sites_per_device={sites_per_device} must divide the per-slice "
+            f"site count ({sites_per_slice})"
+        )
+    procs_per_slice = n_proc // num_slices
+    site_members = sites_per_slice // sites_per_device  # per slice
+    if site_members % procs_per_slice:
+        raise ValueError(
+            f"{site_members} site-axis members per slice must divide over "
+            f"{procs_per_slice} processes per slice"
+        )
+    per_proc_sites = site_members // procs_per_slice
+    need = per_proc_sites * model_axis_size
+    if need > per_proc:
+        raise ValueError(
+            f"{per_proc_sites} sites × model={model_axis_size} needs "
+            f"{need} devices per process, have {per_proc}"
+        )
+    if need < per_proc:
+        by_proc: dict[int, list] = {}
+        for d in devices:
+            by_proc.setdefault(d.process_index, []).append(d)
+        devices = [d for p in sorted(by_proc) for d in by_proc[p][:need]]
+    from jax.experimental import mesh_utils
+
+    # DCN granules: slices stack processes outermost (the slice axis), any
+    # surplus processes extend the site axis within a slice; the model axis
+    # never leaves a process. Same granule fallback as multihost_site_mesh.
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    by_process = None in slice_ids or len(slice_ids) != n_proc
+    arr = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(1, per_proc_sites, model_axis_size),
+        dcn_mesh_shape=(num_slices, procs_per_slice, 1),
+        devices=devices,
+        process_is_granule=by_process,
+    )
+    return jax.sharding.Mesh(arr, (SLICE_AXIS, SITE_AXIS, MODEL_AXIS))
+
+
 def spans_processes(mesh) -> bool:
     """True when ``mesh`` includes devices of other processes (a real
     multi-host mesh) — the cases where plain host-local arrays can neither
@@ -262,7 +344,8 @@ def put_site_batch(mesh, arr, dtype=None):
     a = np.asarray(arr)
     if dtype is not None:
         a = a.astype(dtype)
-    sh = NamedSharding(mesh, P(SITE_AXIS))
+    # the leading per-site dim splits over (slice, site) on sliced meshes
+    sh = NamedSharding(mesh, P(site_axis_of(mesh)))
     if spans_processes(mesh):
         return jax.make_array_from_process_local_data(sh, a, global_shape=a.shape)
     return jax.device_put(a, sh)

@@ -4,11 +4,15 @@ This replaces the reference's COINSTAC transport layer (L0): Docker containers
 exchanging JSON payloads through a message bus (reference ``entry.py:5``,
 ``local.py:19``, ``remote.py:13``). In the TPU build, every federated site lives
 on a slice of a ``jax.sharding.Mesh`` with a ``"site"`` axis; the local→remote
-gradient ship + remote→local broadcast collapses into XLA collectives over ICI
-(multi-host: DCN). See SURVEY.md §2.2.
+gradient ship + remote→local broadcast collapses into XLA collectives over ICI.
+See SURVEY.md §2.2.
 
 Axes:
-  - ``site``  — one federated site per mesh index (or per core-group).
+  - ``slice`` — optional OUTER axis over TPU slices / hosts (r18 multi-slice
+                scale-out): collectives over it cross DCN, the slow
+                inter-slice fabric. Absent on single-slice meshes.
+  - ``site``  — one federated site per mesh index (or per core-group);
+                collectives over it ride intra-slice ICI.
   - ``model`` — optional inner axis for tensor/sequence sharding within a site
                 (a TPU-build extension; the reference is single-device per site).
 
@@ -22,6 +26,21 @@ epoch). Aggregation is then two-level (parallel/collectives.py PackedAxis):
 a local in-register reduce over the packed rows followed by one cross-device
 collective over ``site`` — which is how an 8-device mesh runs 512+ sites in
 one compiled SPMD program without site count ever touching device count.
+
+Multi-slice (r18): once one mesh is the ceiling, the site axis grows an
+outer ``slice`` tier (:func:`sliced_site_mesh`). Per-site arrays shard
+``P((slice, site))`` — slice-major global order, so virtual site
+``(sl·D + d)·K + j`` lives at row ``j`` on slice ``sl``'s member ``d``, the
+same order ``axis_index((slice, site, fold))`` linearizes to. Aggregation
+becomes three-tier (parallel/collectives.py ``three_level_psum``): the
+in-register packed reduce (tier 0), one intra-slice collective over ICI
+(tier 1), and an inter-slice hop over DCN (tier 2) that ships only the
+already-reduced per-slice partial — quantizable independently of the ICI
+wire (``TrainConfig.dcn_wire_quant``). What used to be an aside ("multi-host:
+DCN") is a real mode: single-process CPU emulation lays the slice axis over
+virtual devices so the whole tier-1 suite exercises it, and
+``runner/dcn_worker.py`` launches one process per slice over
+``jax.distributed`` for real hosts.
 """
 
 from __future__ import annotations
@@ -32,6 +51,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 SITE_AXIS = "site"
 MODEL_AXIS = "model"
+# outer inter-slice axis (r18 multi-slice scale-out): present only on meshes
+# built by sliced_site_mesh with num_slices > 1 — collectives over it are the
+# DCN tier of the three-level aggregation (parallel/collectives.py)
+SLICE_AXIS = "slice"
 # vmap axis name for sites folded onto one device (several simulated sites per
 # chip, e.g. 32 sites on 8 chips): the trainer nests a vmap over the local
 # site block inside shard_map, and cross-site collectives run over the
@@ -92,14 +115,79 @@ def packed_site_mesh(
     )
 
 
+def sliced_site_mesh(
+    num_slices: int,
+    sites_per_slice: int,
+    sites_per_device: int = 1,
+    devices: list | None = None,
+    model_axis_size: int = 1,
+) -> Mesh:
+    """A three-tier ``(slice, site, model)`` mesh: ``num_slices`` slices,
+    each holding ``sites_per_slice`` VIRTUAL sites packed ``sites_per_device``
+    per mesh member.
+
+    ``num_slices == 1`` collapses to the legacy ``(site, model)`` mesh from
+    :func:`packed_site_mesh` — the S005-gated opt-out: a single-slice config
+    compiles the exact single-mesh program, no slice axis anywhere.
+
+    Single-process emulation lays the slice axis over virtual (CPU) devices
+    in slice-major order; a multi-process (``jax.distributed``) runtime maps
+    processes to slices instead (parallel/distributed.py
+    ``multihost_sliced_site_mesh`` — same axes, DCN-granule-aware layout).
+    """
+    if num_slices < 1:
+        raise ValueError(f"num_slices must be >= 1, got {num_slices}")
+    if sites_per_device < 1:
+        raise ValueError(f"sites_per_device must be >= 1, got {sites_per_device}")
+    if sites_per_slice % sites_per_device:
+        raise ValueError(
+            f"sites_per_device={sites_per_device} must divide the per-slice "
+            f"site count ({sites_per_slice})"
+        )
+    if num_slices == 1:
+        return packed_site_mesh(
+            sites_per_slice, sites_per_device, devices, model_axis_size
+        )
+    per_slice = sites_per_slice // sites_per_device  # site-axis members/slice
+    devices = list(devices if devices is not None else jax.devices())
+    need = num_slices * per_slice * model_axis_size
+    if need > len(devices):
+        raise ValueError(
+            f"need {need} devices for {num_slices} slices × {per_slice} "
+            f"site-axis members × model={model_axis_size}, have {len(devices)}"
+        )
+    arr = np.array(devices[:need]).reshape(
+        num_slices, per_slice, model_axis_size
+    )
+    return Mesh(arr, (SLICE_AXIS, SITE_AXIS, MODEL_AXIS))
+
+
+def slice_count(mesh: Mesh | None) -> int:
+    """Number of slices on ``mesh`` (1 for single-slice/legacy meshes and
+    the vmap-folded ``mesh=None`` topology)."""
+    if mesh is None:
+        return 1
+    return dict(mesh.shape).get(SLICE_AXIS, 1)
+
+
+def site_axis_of(mesh: Mesh):
+    """The partition-spec entry for the leading per-site dim on ``mesh``:
+    the ``(slice, site)`` pair on sliced meshes (slice-major global order),
+    plain ``site`` otherwise. Everything that shards a ``[S, …]`` per-site
+    array goes through this, so the layout convention lives in ONE place."""
+    if SLICE_AXIS in getattr(mesh, "axis_names", ()):
+        return (SLICE_AXIS, SITE_AXIS)
+    return SITE_AXIS
+
+
 def pack_factor(mesh: Mesh | None, num_sites: int) -> int:
     """The site-packing factor K a ``[num_sites, …]`` per-site array gets on
-    ``mesh``: virtual sites per device along the mesh's site axis.
+    ``mesh``: virtual sites per device along the mesh's (slice, site) axes.
     ``mesh=None`` (the vmap-folded single-device topology) packs everything
     onto one device — K = num_sites."""
     if mesh is None:
         return num_sites
-    mesh_sites = dict(mesh.shape)[SITE_AXIS]
+    mesh_sites = dict(mesh.shape)[SITE_AXIS] * slice_count(mesh)
     if num_sites % mesh_sites:
         raise ValueError(
             f"{num_sites} virtual sites do not divide over the mesh's "
@@ -109,8 +197,9 @@ def pack_factor(mesh: Mesh | None, num_sites: int) -> int:
 
 
 def site_sharding(mesh: Mesh, *trailing_axes) -> NamedSharding:
-    """Sharding with the leading dim split over ``site`` (per-site data)."""
-    return NamedSharding(mesh, P(SITE_AXIS, *trailing_axes))
+    """Sharding with the leading dim split over the site tier(s) — ``site``,
+    or ``(slice, site)`` on a sliced mesh (per-site data)."""
+    return NamedSharding(mesh, P(site_axis_of(mesh), *trailing_axes))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

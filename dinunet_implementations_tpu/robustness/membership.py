@@ -104,6 +104,45 @@ class MembershipTable:
             return self.generations[slot]
         return dict(self.known).get(site_id, 0)
 
+    def slice_of(self, slot: int, num_slices: int) -> int:
+        """The mesh SLICE a slot lives on under an ``num_slices``-way sliced
+        topology (r18): the ``[capacity]`` virtual-site axis shards
+        ``P((slice, site))`` slice-major, so slice ``i`` owns the contiguous
+        slot band ``[i·cap/n, (i+1)·cap/n)``. A slice joining or leaving a
+        run is therefore the same table transition as its band's sites
+        joining/leaving — no new machinery, just more slots per event.
+        ``num_slices <= 1`` is always slice 0 (the single-mesh case)."""
+        if num_slices <= 1:
+            return 0
+        if self.capacity % num_slices:
+            raise MembershipError(
+                f"num_slices={num_slices} must divide capacity "
+                f"({self.capacity})"
+            )
+        if not 0 <= slot < self.capacity:
+            raise MembershipError(
+                f"slot {slot} outside [0, {self.capacity})"
+            )
+        return slot // (self.capacity // num_slices)
+
+    def placements(self, num_slices: int) -> dict:
+        """``{site_id: (slice, slot)}`` for every occupied slot — the
+        logical-site → (slice, slot) map the daemon's membership events and
+        ``/statusz`` surface report under a sliced mesh."""
+        return {
+            s: (self.slice_of(i, num_slices), i)
+            for i, s in enumerate(self.slots)
+            if s is not None
+        }
+
+    def slice_occupancy(self, num_slices: int) -> list:
+        """Occupied-slot count per slice (the per-slice membership gauges)."""
+        counts = [0] * max(num_slices, 1)
+        for i, s in enumerate(self.slots):
+            if s is not None:
+                counts[self.slice_of(i, num_slices)] += 1
+        return counts
+
     # -- transitions (pure; each returns a NEW table) --------------------
 
     def join(self, site_id: str) -> tuple:

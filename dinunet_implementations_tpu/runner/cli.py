@@ -65,6 +65,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="site packing: K virtual sites per mesh device with "
                         "two-level aggregation (512+ sites on an 8-device "
                         "mesh; see docs/ARCHITECTURE.md Site virtualization)")
+    p.add_argument("--slices", type=int, default=None,
+                   help="multi-slice scale-out (r18): lay the site tier "
+                        "over this many slices — intra-slice aggregation "
+                        "rides ICI, one inter-slice hop per round crosses "
+                        "DCN (docs/ARCHITECTURE.md Multi-slice)")
+    p.add_argument("--dcn-wire-quant", default=None,
+                   choices=["none", "bf16", "int8", "fp8"],
+                   help="inter-slice wire codec, independent of "
+                        "--wire-quant (default: follow it); quantizes the "
+                        "per-slice partial on the slow DCN hop only")
     p.add_argument("--out-dir", default=None,
                    help="output root (default <data-path>/output)")
     p.add_argument("--site", type=int, default=None,
@@ -210,6 +220,8 @@ def main(argv: list[str] | None = None) -> int:
         ("batch_size", args.batch_size), ("num_folds", args.num_folds),
         ("model_axis_size", args.model_axis_size),
         ("sites_per_device", args.sites_per_device),
+        ("num_slices", args.slices),
+        ("dcn_wire_quant", args.dcn_wire_quant),
         ("profile_dir", args.profile_dir),
         ("telemetry", args.telemetry),
         ("xprof_dir", args.xprof_dir),

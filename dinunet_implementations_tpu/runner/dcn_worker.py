@@ -1,0 +1,241 @@
+"""Multi-host worker entry point — one process per host (or per TPU slice).
+
+Graduated from the r8 test fixture (``tests/dcn_worker.py``) into the real
+multi-slice launch path (r18): each invocation joins a ``jax.distributed``
+runtime as ONE process of an N-process cluster and trains the shared
+federated program over the resulting global mesh. With ``--slices N`` the
+mesh is the three-tier ``(slice, site, model)`` topology
+(parallel/distributed.py ``multihost_sliced_site_mesh`` via
+``TrainConfig.num_slices``) — processes map to slices, so the ONLY
+per-round DCN traffic is the inter-slice hop of the hierarchical
+aggregation, carrying one (optionally ``--dcn-wire-quant``-quantized)
+per-slice partial.
+
+Typical per-slice launch (one process per TPU slice / host)::
+
+    python -m dinunet_implementations_tpu.runner.dcn_worker \
+        --coordinator host0:1234 --num-processes 4 --process-id $RANK \
+        --slices 4 --data-path /data/tree --out-dir /shared/out
+
+Every process computes identical replicated results; only process 0 writes
+logs/checkpoints (trainer/loop.py ``_coordinator``). ``--report PATH``
+writes a JSON record of the run — mesh shape, per-epoch losses, a params
+checksum (bit-compared across processes by the multihost smoke test), the
+epoch compile count, and the process-0-only write counters.
+
+Capability probe: a jaxlib whose CPU backend cannot execute cross-process
+collectives at all exits with code 66 (``UNSUPPORTED``), distinct from a
+real failure — the CI/tier-1 smoke skips instead of failing red.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+
+#: exit code for "this backend cannot run multiprocess collectives" — the
+#: tier-1/CI smokes skip on it (tests/test_distributed.py)
+UNSUPPORTED_RC = 66
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(
+        prog="dcn_worker",
+        description="multi-host/multi-slice federated training worker",
+    )
+    p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                   help="jax.distributed coordinator address (process 0 "
+                        "hosts it); omit with --num-processes 1 for the "
+                        "single-process reference run")
+    p.add_argument("--num-processes", type=int, default=1)
+    p.add_argument("--process-id", type=int, default=0)
+    p.add_argument("--data-path", required=True,
+                   help="dataset tree (reference simulator layout); every "
+                        "process loads the same tree and feeds its own "
+                        "addressable mesh slices")
+    p.add_argument("--out-dir", default=None,
+                   help="shared output dir (process 0 writes)")
+    p.add_argument("--report", default=None, metavar="PATH",
+                   help="write the run-report JSON here")
+    p.add_argument("--slices", type=int, default=1,
+                   help="num_slices for the three-tier (slice, site, model) "
+                        "mesh; must divide --num-processes (1 = the legacy "
+                        "hybrid (site, model) mesh)")
+    p.add_argument("--dcn-wire-quant", default="",
+                   choices=["", "none", "bf16", "int8", "fp8"],
+                   help="inter-slice wire codec (TrainConfig.dcn_wire_quant; "
+                        "'' follows --set wire_quant)")
+    p.add_argument("--devices-per-process", type=int, default=4,
+                   help="virtual CPU devices per process (emulation; "
+                        "ignored on real accelerator backends)")
+    p.add_argument("--epochs", type=int, default=4)
+    p.add_argument("--task", default="FS-Classification")
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--set", dest="overrides", action="append", default=[],
+                   metavar="KEY=VALUE",
+                   help="raw TrainConfig overrides (JSON-parsed values)")
+    return p.parse_args(argv)
+
+
+def _config_overrides(pairs):
+    out = {}
+    for kv in pairs:
+        k, _, v = kv.partition("=")
+        try:
+            out[k] = json.loads(v)
+        except json.JSONDecodeError:
+            out[k] = v
+    return out
+
+
+def _params_checksum(state) -> str:
+    """Order-stable digest of the replicated params — every process of a
+    correct run reports the SAME hex (params are replicated by the
+    aggregation collectives; the multihost smoke bit-compares this across
+    processes after one round). ``addressable_data(0)`` reads the local
+    replica, so no cross-process fetch is needed."""
+    import jax
+    import numpy as np
+
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(state.params):
+        a = leaf.addressable_data(0) if hasattr(leaf, "addressable_data") else leaf
+        h.update(np.ascontiguousarray(np.asarray(a)).tobytes())
+    return h.hexdigest()
+
+
+def main(argv=None) -> int:
+    args = _parse(argv if argv is not None else sys.argv[1:])
+
+    # Belt and braces across jax versions: the XLA_FLAGS env var is consumed
+    # at backend-client creation (lazy — still effective even when
+    # sitecustomize imported jax at interpreter start, as long as no device
+    # was queried), and newer jax prefers the jax_num_cpu_devices knob.
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + f" --xla_force_host_platform_device_count="
+            f"{args.devices_per_process}"
+        ).strip()
+
+    import jax
+
+    if not os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            jax.config.update("jax_num_cpu_devices", args.devices_per_process)
+        except AttributeError:
+            pass  # older jax: the XLA_FLAGS device-count flag applies
+
+    from dinunet_implementations_tpu.parallel import (
+        distributed_init,
+        distributed_shutdown,
+    )
+
+    multi = distributed_init(
+        coordinator_address=args.coordinator,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+    ) if args.num_processes > 1 else distributed_init()
+
+    import dinunet_implementations_tpu.trainer.loop as loop_mod
+    from dinunet_implementations_tpu import TrainConfig
+    from dinunet_implementations_tpu.parallel.distributed import (
+        spans_processes,
+    )
+    from dinunet_implementations_tpu.runner import FedRunner
+
+    writes = {"logs": 0, "ckpt": 0}
+    _orig_logs = loop_mod.write_logs_json
+    _orig_ckpt = loop_mod.save_checkpoint
+
+    def _count_logs(*a, **k):
+        writes["logs"] += 1
+        return _orig_logs(*a, **k)
+
+    def _count_ckpt(*a, **k):
+        writes["ckpt"] += 1
+        return _orig_ckpt(*a, **k)
+
+    loop_mod.write_logs_json = _count_logs
+    loop_mod.save_checkpoint = _count_ckpt
+
+    # keep the final epoch state visible for the params checksum (the fit
+    # result dict carries metrics, not weights) — and the trainer for the
+    # CompileGuard-style epoch compile count
+    final = {"state": None, "trainer": None}
+    _orig_run_epoch = loop_mod.FederatedTrainer.run_epoch
+
+    def _record_run_epoch(self, state, *a, **k):
+        out = _orig_run_epoch(self, state, *a, **k)
+        final["state"], final["trainer"] = out[0], self
+        return out
+
+    loop_mod.FederatedTrainer.run_epoch = _record_run_epoch
+
+    cfg = TrainConfig(
+        task_id=args.task, epochs=args.epochs, validation_epochs=2,
+        patience=10, batch_size=args.batch_size,
+        split_ratio=(0.7, 0.15, 0.15), seed=0,
+        num_slices=args.slices, dcn_wire_quant=args.dcn_wire_quant,
+    ).with_overrides(_config_overrides(args.overrides))
+    runner = FedRunner(cfg, data_path=args.data_path, out_dir=args.out_dir)
+    try:
+        res = runner.run(verbose=False)[0]
+    except Exception as e:  # noqa: BLE001 — capability probe, see below
+        if "Multiprocess computations aren't implemented" in str(e):
+            # this jaxlib's CPU backend cannot execute cross-process
+            # collectives at all (e.g. 0.4.x): report "unsupported",
+            # distinct from a real failure, so callers can skip
+            print(f"UNSUPPORTED: {e}", flush=True)
+            distributed_shutdown()
+            return UNSUPPORTED_RC
+        raise
+
+    if args.report:
+        from dinunet_implementations_tpu.checks.sanitize import jit_cache_size
+
+        trainer = final["trainer"]
+        report = {
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count(),
+            "global_devices": len(jax.devices()),
+            "local_devices": len(jax.local_devices()),
+            "multi": bool(multi),
+            "mesh_spans_processes": spans_processes(runner.mesh),
+            "mesh_shape": dict(runner.mesh.shape),
+            "mesh_axes": list(runner.mesh.axis_names),
+            "num_slices": args.slices,
+            "epoch_losses": [float(x) for x in res["epoch_losses"]],
+            "test_metrics": res["test_metrics"],
+            "n_log_writes": writes["logs"],
+            "n_ckpt_writes": writes["ckpt"],
+            # bit-compared across processes by the multihost smoke: the
+            # replicated params after the final round
+            "params_sha256": (
+                _params_checksum(final["state"])
+                if final["state"] is not None else None
+            ),
+            # the one-epoch-compile-per-process contract (CompileGuard's
+            # counter): churnless multi-host training must compile the
+            # epoch exactly once in EVERY process
+            "epoch_compiles": (
+                jit_cache_size(trainer.epoch_fn)
+                if trainer is not None else None
+            ),
+        }
+        with open(args.report, "w") as fh:
+            json.dump(report, fh)
+
+    # clean teardown: leave the runtime re-entrant (the coordinated barrier
+    # in shutdown also surfaces a wedged peer as a nonzero exit, instead of
+    # letting a caller's timeout mask it)
+    distributed_shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -68,23 +68,43 @@ def auto_site_mesh(cfg: TrainConfig, num_sites: int):
     ``(site, model)`` mesh when the devices fit (k = cfg.sites_per_device
     virtual sites per member, r12), CPU host devices as the simulator
     fallback, and ``None`` (fold every site onto one device via vmap)
-    otherwise. Shared by the batch :class:`FedRunner` and the daemon-mode
-    :class:`FedDaemon`, so both resolve churn-capacity and fold topologies
-    identically."""
+    otherwise. ``cfg.num_slices > 1`` (r18) lays the outer DCN slice axis
+    over either form — processes map to slices on a multi-host runtime,
+    virtual devices emulate them in one process. Shared by the batch
+    :class:`FedRunner` and the daemon-mode :class:`FedDaemon`, so both
+    resolve churn-capacity and fold topologies identically."""
     import jax
 
     m = max(cfg.model_axis_size, 1)
     k = max(cfg.sites_per_device, 1)
+    n_slices = max(cfg.num_slices, 1)
     if num_sites % k:
         raise ValueError(
             f"sites_per_device={k} must divide the site count ({num_sites})"
         )
     n_mesh = num_sites // k  # mesh site-axis size; k sites pack per device
+    if n_slices > 1 and num_sites % (k * n_slices):
+        raise ValueError(
+            f"num_slices={n_slices} × sites_per_device={k} must divide the "
+            f"site count ({num_sites})"
+        )
     devs = jax.devices()
     cpus = [d for d in devs if d.platform == "cpu"]
     if jax.process_count() > 1:
         # multi-host runtime (distributed_init): hybrid mesh — the model
-        # axis stays on each host's ICI, sites span DCN
+        # axis stays on each host's ICI, sites span DCN; with num_slices > 1
+        # processes become slice granules and the inter-slice hop is the
+        # only per-round DCN traffic (the multi-slice deployment shape,
+        # one runner/dcn_worker.py process per slice)
+        if n_slices > 1:
+            from ..parallel.distributed import multihost_sliced_site_mesh
+
+            return multihost_sliced_site_mesh(
+                num_slices=n_slices,
+                sites_per_slice=num_sites // n_slices,
+                sites_per_device=k,
+                model_axis_size=m,
+            )
         from ..parallel.distributed import multihost_site_mesh
 
         if n_mesh % jax.process_count():
@@ -95,6 +115,16 @@ def auto_site_mesh(cfg: TrainConfig, num_sites: int):
         return multihost_site_mesh(
             sites_per_process=n_mesh // jax.process_count(),
             model_axis_size=m,
+        )
+    if n_slices > 1:
+        # single-process emulation of the sliced topology over virtual
+        # devices — the whole tier-1 suite exercises the DCN tier this way
+        from ..parallel.mesh import sliced_site_mesh
+
+        if len(devs) < n_mesh * m and len(cpus) >= n_mesh * m:
+            devs = cpus
+        return sliced_site_mesh(
+            n_slices, num_sites // n_slices, k, devs, model_axis_size=m
         )
     if len(devs) >= n_mesh * m:
         # the packed topology (parallel/mesh.py): k virtual sites per mesh
@@ -399,6 +429,12 @@ class FedDaemon:
         if mesh == "auto":
             mesh = auto_site_mesh(self.cfg, capacity)
         self.mesh = mesh
+        # multi-slice (r18): slot → slice mapping for membership events /
+        # gauges — one trace id is then followable spool→slice→aggregation→
+        # publish. 1 on single-slice meshes (every slot reads slice 0).
+        from ..parallel.mesh import slice_count
+
+        self.num_slices = slice_count(mesh)
         self.trainer = FederatedTrainer(
             self.cfg, get_task(self.cfg.task_id).build_model(self.cfg),
             mesh, out_dir=self.out_dir, fault_plan=fault_plan, bus=self.bus,
@@ -592,6 +628,7 @@ class FedDaemon:
                                      result="rejected")
                     return False
                 self.table, slot, gen = self.table.join(site)
+                sl = self.table.slice_of(slot, self.num_slices)
                 self._data[site] = arrays
                 self._dirs[site] = data_dir
                 self._overrides[site] = overrides
@@ -600,28 +637,35 @@ class FedDaemon:
                 self._ensure_state()
                 self._reset_slot(slot, site=site, generation=gen)
                 self._log(
-                    f"[serve] join {site!r} → slot {slot} (generation {gen})"
+                    f"[serve] join {site!r} → slot {slot} (slice {sl}, "
+                    f"generation {gen})"
                 )
                 self._event("membership-join", site=site, slot=slot,
-                            generation=gen, trace=trace_id)
+                            slice=sl, generation=gen, trace=trace_id)
                 self.flight.note("membership-join", site=site, slot=slot,
-                                 trace=trace_id)
+                                 slice=sl, trace=trace_id)
                 self.bus.counter("serve_spool_events_total", result="applied")
                 self.bus.gauge("serve_member_generation", gen, site=site)
+                self._publish_slice_gauges()
                 return True
             if kind == "leave":
                 site = str(ev["site"])
                 self.table, slot = self.table.leave(site)
+                sl = self.table.slice_of(slot, self.num_slices)
                 self._data.pop(site, None)
                 self._dirs.pop(site, None)
                 self._overrides.pop(site, None)
                 self._traces.pop(site, None)
-                self._log(f"[serve] leave {site!r} (slot {slot} freed)")
+                self._log(
+                    f"[serve] leave {site!r} (slot {slot}, slice {sl} freed)"
+                )
                 self._event("membership-leave", site=site, slot=slot,
-                            trace=trace_id)
-                self.flight.note("membership-leave", site=site, slot=slot)
+                            slice=sl, trace=trace_id)
+                self.flight.note("membership-leave", site=site, slot=slot,
+                                 slice=sl)
                 self.bus.counter("serve_spool_events_total", result="applied")
                 self.bus.clear_gauge("serve_member_generation", site=site)
+                self._publish_slice_gauges()
                 return True
         except (MembershipError, KeyError) as e:
             log_warning(f"[serve] bad membership event {ev!r}: {e}")
@@ -631,6 +675,16 @@ class FedDaemon:
         log_warning(f"[serve] unknown spool event {ev!r} — ignored")
         self.bus.counter("serve_spool_events_total", result="rejected")
         return False
+
+    def _publish_slice_gauges(self) -> None:
+        """Per-slice membership gauges (r18): one ``serve_slice_members``
+        gauge per slice, so the /statusz surface shows WHERE on the sliced
+        topology the federation sits — a slice draining to 0 is the
+        operator's cue before the quorum trips."""
+        for sl, n in enumerate(
+            self.table.slice_occupancy(self.num_slices)
+        ):
+            self.bus.gauge("serve_slice_members", n, slice=str(sl))
 
     def _reset_slot(self, slot: int, site: str = "", generation: int = 0):
         """Fresh state rows for a newly-assigned slot (generation semantics:
@@ -682,8 +736,14 @@ class FedDaemon:
         refresh the occupancy mask, and checkpoint the membership epoch."""
         from ..robustness.membership import move_slot_state
 
+        from ..parallel.mesh import slice_count
+
+        # packing granules: one per (slice, site)-axis member — under a
+        # sliced mesh rebalancing evens occupancy across slices too (the
+        # per-device [K] blocks tile slice-major, parallel/mesh.py)
         num_blocks = (
-            dict(self.mesh.shape)[SITE_AXIS] if self.mesh is not None else 1
+            dict(self.mesh.shape)[SITE_AXIS] * slice_count(self.mesh)
+            if self.mesh is not None else 1
         )
         self.table, moves = self.table.rebalance(num_blocks)
         for site, src, dst in moves:
@@ -1060,12 +1120,15 @@ class FedDaemon:
             "members": {
                 site: {
                     "slot": slot,
+                    "slice": self.table.slice_of(slot, self.num_slices),
                     "generation": self.table.generation_of(site),
                     "samples": len(self._data.get(site, ())),
                     "trace_id": self._traces.get(site),
                 }
                 for site, slot in sorted(self.table.members().items())
             },
+            "num_slices": self.num_slices,
+            "slice_occupancy": self.table.slice_occupancy(self.num_slices),
             "membership_epoch": self.table.epoch,
             "steps": self._steps,
             "inventory_rows": self._rows,

@@ -55,6 +55,7 @@ def _accepts_pack(fn) -> bool:
 
 #: metric keys of the TrainState.telemetry pytree (trace-stable; keep sorted)
 TELEMETRY_KEYS = (
+    "dcn_bytes",
     "grad_sq_last",
     "grad_sq_max",
     "grad_sq_sum",
@@ -132,6 +133,62 @@ def payload_bytes_of(engine, grads_template, pack: int = 1) -> float:
     ))
 
 
+def dcn_bytes_of(engine, grads_template, pack: int = 1,
+                 sites_per_slice: int = 1, slices: int = 1) -> float:
+    """Modeled per-round INTER-SLICE (DCN) payload bytes for one SLICE —
+    the r18 twin of :func:`payload_bytes_of`, split per tier so telemetry,
+    ``logs.json`` and the ``/statusz`` bus report ICI and DCN traffic
+    separately. ``slices <= 1`` (single-slice meshes, the vmap fold) is
+    0.0 — there is no inter-slice hop to model. Uses the engine's own
+    ``dcn_bytes`` model (engines/base.py) when it has one; the fallback
+    ships every leaf's per-slice partial whole at the engine's DCN (else
+    ICI wire, else f32) dtype. Verified against the traced sliced programs
+    by checks/semantic.py — a figure, like the ICI one, that is proven,
+    not just modeled."""
+    if slices <= 1:
+        return 0.0
+    db = getattr(engine, "dcn_bytes", None)
+    if db is not None:
+        return float(db(grads_template, pack=pack,
+                        sites_per_slice=sites_per_slice))
+    import jax
+
+    d = np.dtype(
+        getattr(engine, "dcn_dtype", None)
+        or getattr(engine, "wire_dtype", None)
+        or np.float32
+    )
+    return float(sum(
+        math.prod(leaf.shape) * d.itemsize
+        for leaf in jax.tree.leaves(grads_template)
+    ))
+
+
+def modeled_dcn_shapes(engine, grads_template, pack: int = 1,
+                       sites_per_slice: int = 1) -> list:
+    """The structured model behind :func:`dcn_bytes_of`: ``[(shape, numpy
+    dtype), ...]`` — one entry per inter-slice hop payload per round per
+    slice (``Engine.dcn_wire_shapes``), with the same dense fallback as
+    the bytes model."""
+    ds = getattr(engine, "dcn_wire_shapes", None)
+    if ds is not None:
+        return [
+            (tuple(s), np.dtype(d))
+            for s, d in ds(grads_template, pack=pack,
+                           sites_per_slice=sites_per_slice)
+        ]
+    import jax
+
+    d = np.dtype(
+        getattr(engine, "dcn_dtype", None)
+        or getattr(engine, "wire_dtype", None)
+        or np.float32
+    )
+    return [
+        (tuple(leaf.shape), d) for leaf in jax.tree.leaves(grads_template)
+    ]
+
+
 def modeled_wire_shapes(engine, grads_template, pack: int = 1) -> list:
     """The structured payload model behind :func:`payload_bytes_of`:
     ``[(shape, numpy dtype), ...]`` — one entry per collective payload
@@ -178,5 +235,10 @@ def telemetry_summary(telemetry) -> dict | None:
         "site_residual_norm_mean": norms(t["residual_sq_sum"] / rounds),
         "update_norm_last": float(np.sqrt(max(float(t["update_sq_last"][0]), 0.0))),
         "payload_bytes_per_round": float(t["payload_bytes"][0] / rounds[0]),
+        # r18 per-tier split: the inter-slice (DCN) hop's per-slice figure;
+        # 0.0 on single-slice runs (and on pre-r18 accumulators)
+        "dcn_bytes_per_round": (
+            float(t["dcn_bytes"][0] / rounds[0]) if "dcn_bytes" in t else 0.0
+        ),
         "rounds": int(t["rounds"][0]),
     }

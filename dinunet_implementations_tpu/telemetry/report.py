@@ -150,6 +150,8 @@ def render_fit(dirpath: str) -> None:
             f"transfer_bytes/epoch={last.get('transfer_bytes', 'n/a')} · "
             f"payload_bytes/round="
             f"{round(float(last.get('payload_bytes', 0)) / rounds)} · "
+            f"dcn_bytes/round="
+            f"{round(float(last.get('dcn_bytes', 0)) / rounds)} · "
             f"update‖·‖ last={_norm(last.get('update_sq_last', 0)):.5f} · "
             f"prefetch_stall_s={summary.get('prefetch_stall_s', 'n/a')}"
         )

@@ -54,7 +54,9 @@ ROW_REQUIRED = {
     "epoch": frozenset({
         "kind", "fold", "epoch", "train_loss", "epoch_seconds",
         "transfer_bytes", "site_grad_sq_last", "site_grad_sq_sum",
-        "site_residual_sq_sum", "update_sq_last", "payload_bytes", "rounds",
+        "site_residual_sq_sum", "update_sq_last", "payload_bytes",
+        # r18 per-tier wire split: inter-slice (DCN) bytes, 0.0 off-slice
+        "dcn_bytes", "rounds",
     }),
     "event": frozenset({"kind", "name"}),
     "summary": frozenset({

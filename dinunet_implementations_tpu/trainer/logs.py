@@ -156,6 +156,9 @@ def telemetry_log_fields(summary: dict | None, site_index: int | None = None) ->
             "site_residual_norm_mean": list(summary["site_residual_norm_mean"]),
             "update_norm_last": summary["update_norm_last"],
             "payload_bytes_per_round": summary["payload_bytes_per_round"],
+            # r18 per-tier split: the inter-slice hop's per-slice figure
+            # (0.0 on single-slice runs)
+            "dcn_bytes_per_round": summary.get("dcn_bytes_per_round", 0.0),
         }
     return {
         "grad_norm_last": summary["site_grad_norm_last"][site_index],

@@ -98,8 +98,13 @@ class FederatedTrainer:
             robust_agg=cfg.robust_agg,
             robust_trim_frac=cfg.robust_trim_frac,
             robust_clip_mult=cfg.robust_clip_mult,
+            dcn_wire_quant=cfg.dcn_wire_quant,
             **task_args
         )
+        # modeled per-round inter-slice (DCN) bytes for the bus rollup —
+        # filled at fit time once the site count / pack factor are known;
+        # stays 0.0 on single-slice meshes (r18, telemetry/metrics.py)
+        self._dcn_bytes_round = 0.0
         self.optimizer = make_optimizer(cfg.optimizer, cfg.learning_rate)
         if cfg.pipeline not in ("device", "host"):
             raise ValueError(
@@ -211,14 +216,30 @@ class FederatedTrainer:
 
     def init_state(self, sample_x, num_sites: int | None = None) -> TrainState:
         rng = jax.random.PRNGKey(self.cfg.seed)
+        n = num_sites or getattr(self, "_num_sites", 1)
         state = init_train_state(
             self.task, self.engine, self.optimizer, rng, sample_x,
-            num_sites=num_sites or getattr(self, "_num_sites", 1),
+            num_sites=n,
             telemetry=self._telemetry_on,
             staleness_bound=self.cfg.staleness_bound,
             overlap_rounds=self.cfg.overlap_rounds,
             reputation=self.cfg.robust_agg != "none",
         )
+        from ..parallel.mesh import SITE_AXIS, pack_factor, slice_count
+
+        if self.mesh is not None and slice_count(self.mesh) > 1:
+            # per-tier wire accounting for the bus rollup (r18): the modeled
+            # per-slice DCN payload per round from the engine's own model,
+            # at this fit's pack factor — a static figure the sliced
+            # semantic cells verify against the traced program
+            from ..telemetry.metrics import dcn_bytes_of
+
+            k = pack_factor(self.mesh, n)
+            self._dcn_bytes_round = dcn_bytes_of(
+                self.engine, state.params, pack=k,
+                sites_per_slice=k * dict(self.mesh.shape)[SITE_AXIS],
+                slices=slice_count(self.mesh),
+            )
         return self._place_state(state)
 
     def _place_state(self, state: TrainState) -> TrainState:
@@ -236,9 +257,11 @@ class FederatedTrainer:
             return state
         from jax.sharding import NamedSharding
 
+        from ..parallel.mesh import site_axis_of
+
         return jax.tree.map(
             lambda a, spec: jax.device_put(a, NamedSharding(self.mesh, spec)),
-            state, _state_specs(state),
+            state, _state_specs(state, site_axis_of(self.mesh)),
         )
 
     def _put_live(self, live):
@@ -777,6 +800,16 @@ class FederatedTrainer:
                     self.bus.counter("train_epochs_total")
                     self.bus.counter("train_rounds_total", rounds)
                     self.bus.observe("epoch_ms", e_seconds * 1e3)
+                    if self._dcn_bytes_round > 0:
+                        # per-tier wire accounting (r18): modeled inter-slice
+                        # (DCN) bytes this epoch shipped — the /statusz
+                        # surface for "what is the slow hop carrying". A
+                        # static per-round model (verified by the sliced
+                        # semantic cells), so no device sync.
+                        self.bus.counter(
+                            "train_dcn_bytes_total",
+                            self._dcn_bytes_round * rounds,
+                        )
                     if (
                         self._telemetry_on and state.health is not None
                         and "anomaly" in state.health
@@ -1058,13 +1091,17 @@ class FederatedTrainer:
                 ],
                 update_sq_last=float(t["update_sq_last"][0]),
                 payload_bytes=float(t["payload_bytes"][0]),
+                # per-tier split (r18): inter-slice (DCN) bytes shipped so
+                # far — 0.0 on single-slice runs
+                dcn_bytes=float(t.get("dcn_bytes", [0.0])[0]),
                 rounds=int(t["rounds"][0]),
             )
         else:  # epoch rows keep one schema even if metrics are absent
             row.update(
                 site_grad_sq_last=[], site_grad_sq_sum=[],
                 site_grad_sq_max=[], site_residual_sq_sum=[],
-                update_sq_last=0.0, payload_bytes=0.0, rounds=0,
+                update_sq_last=0.0, payload_bytes=0.0, dcn_bytes=0.0,
+                rounds=0,
             )
         self._fit_tel.append(row)
 

@@ -40,9 +40,18 @@ from ..parallel.collectives import (
     two_level_psum,
     weighted_site_sum,
 )
-from ..parallel.mesh import FOLD_AXIS, MODEL_AXIS, SITE_AXIS
+from ..parallel.mesh import (
+    FOLD_AXIS,
+    MODEL_AXIS,
+    SITE_AXIS,
+    SLICE_AXIS,
+    site_axis_of,
+    slice_count,
+)
 from ..robustness.health import default_health
 from ..telemetry.metrics import (
+    TELEMETRY_KEYS,
+    dcn_bytes_of,
     default_round_telemetry,
     payload_bytes_of,
     tree_sq_sum,
@@ -99,24 +108,26 @@ class TrainState:
     overlap: Any = None
 
 
-def _state_specs(state: TrainState):
+def _state_specs(state: TrainState, site_axis=SITE_AXIS):
     """shard_map partition specs: everything replicated except the per-site
     engine state — powerSGD's error-feedback residual/Q and rankDAD's
     warm-start subspace Ω (engines/rankdad.py) — which is sharded over the
     site axis; collapsing it to one site's copy would silently break error
     feedback (and subspace warm starts) across epoch boundaries. The health
-    counters are per-site for the same reason."""
+    counters are per-site for the same reason. ``site_axis`` is the leading
+    per-site partition entry — the ``(slice, site)`` pair on sliced meshes
+    (parallel/mesh.py ``site_axis_of``), plain ``site`` otherwise."""
     return TrainState(
         params=jax.tree.map(lambda _: P(), state.params),
         batch_stats=jax.tree.map(lambda _: P(), state.batch_stats),
         opt_state=jax.tree.map(lambda _: P(), state.opt_state),
-        engine_state=jax.tree.map(lambda _: P(SITE_AXIS), state.engine_state),
+        engine_state=jax.tree.map(lambda _: P(site_axis), state.engine_state),
         rng=P(),
         round=P(),
-        health=jax.tree.map(lambda _: P(SITE_AXIS), state.health),
-        telemetry=jax.tree.map(lambda _: P(SITE_AXIS), state.telemetry),
-        buffers=jax.tree.map(lambda _: P(SITE_AXIS), state.buffers),
-        overlap=jax.tree.map(lambda _: P(SITE_AXIS), state.overlap),
+        health=jax.tree.map(lambda _: P(site_axis), state.health),
+        telemetry=jax.tree.map(lambda _: P(site_axis), state.telemetry),
+        buffers=jax.tree.map(lambda _: P(site_axis), state.buffers),
+        overlap=jax.tree.map(lambda _: P(site_axis), state.overlap),
     )
 
 
@@ -432,6 +443,18 @@ def make_train_epoch_fn(
 
     assert pipeline in ("host", "device"), pipeline
     model_axis = _model_axis_of(mesh)
+    # multi-slice (r18): a mesh built by parallel/mesh.py sliced_site_mesh
+    # carries the outer DCN axis — per-site data then shards over the
+    # (slice, site) pair and aggregation grows the inter-slice tier
+    # (parallel/collectives.py three_level_psum). Single-slice meshes keep
+    # the exact legacy program: site_part is the plain site axis and the
+    # PackedAxis carries no slice name.
+    n_slices = slice_count(mesh)
+    sliced = mesh is not None and SLICE_AXIS in mesh.axis_names
+    site_part = site_axis_of(mesh) if mesh is not None else SITE_AXIS
+    mesh_site_members = (
+        dict(mesh.shape)[SITE_AXIS] if mesh is not None else 1
+    )
     if quarantine_rounds is None:
         quarantine_rounds = 3  # the default threshold
     if staleness_bound < 0:
@@ -538,9 +561,14 @@ def make_train_epoch_fn(
         k, steps = x.shape[0], x.shape[1]
         # trace-time static: mesh topologies carry the (mesh, fold) pair and
         # take the packed two-level aggregation path; the vmap-folded
-        # single-device topology keeps the classic in-vmap form
+        # single-device topology keeps the classic in-vmap form. Sliced
+        # meshes (r18) hand the PackedAxis the slice axis too — the same
+        # engine calls then lower the three-tier reduction.
         packed = isinstance(site_axes, tuple)
-        pax = PackedAxis(SITE_AXIS, k) if packed else None
+        pax = (
+            PackedAxis(SITE_AXIS, k, slice_name=SLICE_AXIS if sliced else None)
+            if packed else None
+        )
         rounds = steps // local_iterations
         L = rounds * local_iterations
 
@@ -611,6 +639,17 @@ def make_train_epoch_fn(
             payload_bytes_of(engine, state.params, pack=k if packed else 1)
             if telem else 0.0
         )
+        # per-tier split (r18): the inter-slice hop's modeled PER-SLICE
+        # bytes — 0.0 on single-slice meshes and the vmap fold (no DCN
+        # tier); like wire_b a trace-time constant, verified by the sliced
+        # semantic cells rather than merely modeled
+        dcn_b = (
+            dcn_bytes_of(
+                engine, state.params, pack=k,
+                sites_per_slice=k * mesh_site_members, slices=n_slices,
+            )
+            if telem and packed else 0.0
+        )
 
         def _ts_round(ts, gsq, rsq):
             """Per-site accumulator update for this round from the (already
@@ -624,6 +663,7 @@ def make_train_epoch_fn(
                 return None
             gsq_f = jnp.where(jnp.isfinite(gsq), gsq, 0.0)
             return {
+                "dcn_bytes": ts["dcn_bytes"] + dcn_b,
                 "grad_sq_last": gsq,
                 "grad_sq_max": jnp.maximum(ts["grad_sq_max"], gsq_f),
                 "grad_sq_sum": ts["grad_sq_sum"] + gsq_f,
@@ -1339,6 +1379,10 @@ def make_train_epoch_fn(
         elif (
             state.telemetry is None
             or state.telemetry["rounds"].shape[0] != inputs.shape[0]
+            # key-set drift (e.g. a pre-r18 checkpoint without the per-tier
+            # dcn_bytes accumulator): refill fresh — per-site sums are
+            # meaningless across a schema change anyway
+            or set(state.telemetry) != set(TELEMETRY_KEYS)
         ):
             state = state.replace(
                 telemetry=default_round_telemetry(inputs.shape[0])
@@ -1386,7 +1430,7 @@ def make_train_epoch_fn(
         def epoch_fn_impl(state: TrainState, inv_x, inv_y, idx, live=None,
                           poison=None, attack=None):
             state = _ensure_health(state, idx)
-            specs = _state_specs(state)
+            specs = _state_specs(state, site_part)
             # optional traced inputs (liveness / NaN gate / attack codes):
             # trace-time presence branches, one compiled program per form —
             # a fit feeds a fixed form, so the compile counter still sees
@@ -1394,6 +1438,10 @@ def make_train_epoch_fn(
             extras = [a for a in (live, poison, attack) if a is not None]
             has_live, has_poison = live is not None, poison is not None
             has_attack = attack is not None
+            axes = (
+                (SLICE_AXIS, SITE_AXIS, FOLD_AXIS) if sliced
+                else (SITE_AXIS, FOLD_AXIS)
+            )
 
             def wrapped(st, ex, ey, ix, *opt):
                 opt = list(opt)
@@ -1401,7 +1449,7 @@ def make_train_epoch_fn(
                 pz = opt.pop(0) if has_poison else None
                 ak = opt.pop(0) if has_attack else None
                 return epoch_over_sites(
-                    st, ix, None, None, lv, site_axes=(SITE_AXIS, FOLD_AXIS),
+                    st, ix, None, None, lv, site_axes=axes,
                     inner_axis=FOLD_AXIS, inventory=(ex, ey), poison=pz,
                     attack=ak,
                 )
@@ -1409,8 +1457,8 @@ def make_train_epoch_fn(
             return shard_map(
                 wrapped,
                 mesh=mesh,
-                in_specs=(specs, P(SITE_AXIS), P(SITE_AXIS), P(SITE_AXIS))
-                + (P(SITE_AXIS),) * len(extras),
+                in_specs=(specs, P(site_part), P(site_part), P(site_part))
+                + (P(site_part),) * len(extras),
                 out_specs=(specs, P()),
                 check_vma=False,
             )(state, inv_x, inv_y, idx, *extras)
@@ -1436,27 +1484,32 @@ def make_train_epoch_fn(
         def epoch_fn_impl(state: TrainState, inputs, labels, weights,
                           live=None, attack=None):
             state = _ensure_health(state, inputs)
-            specs = _state_specs(state)
+            specs = _state_specs(state, site_part)
             has_live, has_attack = live is not None, attack is not None
+            axes = (
+                (SLICE_AXIS, SITE_AXIS, FOLD_AXIS) if sliced
+                else (SITE_AXIS, FOLD_AXIS)
+            )
 
             def shard_wrapped(st, x, y, w, *opt):
                 # x: [k, steps, B, ...] — this device's block of k sites.
                 # k > 1 is the folded case (cfg.sites_per_device: more
                 # simulated sites than devices); cross-site collectives span
-                # the (mesh site, fold) axis pair. k == 1 is the
-                # one-site-per-device case, same program.
+                # the (mesh site, fold) axis pair — plus the outer slice
+                # axis on sliced meshes. k == 1 is the one-site-per-device
+                # case, same program.
                 opt = list(opt)
                 lv = opt.pop(0) if has_live else None
                 ak = opt.pop(0) if has_attack else None
                 return epoch_over_sites(
-                    st, x, y, w, lv, site_axes=(SITE_AXIS, FOLD_AXIS),
+                    st, x, y, w, lv, site_axes=axes,
                     inner_axis=FOLD_AXIS, attack=ak,
                 )
 
             extras = [a for a in (live, attack) if a is not None]
             in_specs = (
-                (specs, P(SITE_AXIS), P(SITE_AXIS), P(SITE_AXIS))
-                + (P(SITE_AXIS),) * len(extras)
+                (specs, P(site_part), P(site_part), P(site_part))
+                + (P(site_part),) * len(extras)
             )
             return shard_map(
                 shard_wrapped,
@@ -1588,6 +1641,7 @@ def make_eval_fn(task: FederatedTask, mesh=None):
         return probs, loss_sums.sum(), w.sum()
 
     if mesh is not None:
+        part = site_axis_of(mesh)  # (slice, site) on sliced meshes (r18)
 
         @jax.jit
         def eval_fn(state: TrainState, inputs, labels, weights):
@@ -1600,11 +1654,11 @@ def make_eval_fn(task: FederatedTask, mesh=None):
                 in_specs=(
                     jax.tree.map(lambda _: P(), state.params),
                     jax.tree.map(lambda _: P(), state.batch_stats),
-                    P(SITE_AXIS),
-                    P(SITE_AXIS),
-                    P(SITE_AXIS),
+                    P(part),
+                    P(part),
+                    P(part),
                 ),
-                out_specs=(P(SITE_AXIS), P(SITE_AXIS), P(SITE_AXIS)),
+                out_specs=(P(part), P(part), P(part)),
                 check_vma=False,
             )(state.params, state.batch_stats, inputs, labels, weights)
 

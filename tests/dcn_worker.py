@@ -1,115 +1,30 @@
-"""Worker process for the live multi-process DCN test (test_distributed.py).
-
-Each invocation is one "host" of a 2-process jax.distributed CPU cluster
-(the COINSTAC one-container-per-site execution model, reference
-``entry.py:5`` / ``compspec.json:284-295``, collapsed to one coordinated
-JAX runtime):
+"""Back-compat shim: the r8 test fixture graduated into the real multi-host
+entry point ``dinunet_implementations_tpu/runner/dcn_worker.py`` (r18). The
+test harness's legacy positional invocation
 
     python dcn_worker.py <port> <num_processes> <process_id> \
         <data_path> <out_dir> <report_path>
 
-With ``num_processes=1`` the same script runs the single-process reference
-run the test compares against. The report JSON records the per-epoch losses
-(bit-compared across processes and topologies), whether the mesh actually
-spans processes, and how many times this process invoked the log writer —
-proving the process-0-only output contract.
+maps onto the module CLI; new capabilities (``--slices``,
+``--dcn-wire-quant``, ``--set``) are flags on the module itself.
 """
 
-import json
 import os
 import sys
 
-port, nproc, pid, data_path, out_dir, report = sys.argv[1:7]
-nproc, pid = int(nproc), int(pid)
-
-# Belt and braces across jax versions: the XLA_FLAGS env var is consumed at
-# backend-client creation (lazy — still effective even when sitecustomize
-# imported jax at interpreter start, as long as no device was queried), and
-# newer jax prefers the jax_num_cpu_devices config knob. The test harness
-# strips the parent's XLA_FLAGS, so set our own before any jax device use.
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=4"
-    ).strip()
-
-import jax
-
-jax.config.update("jax_platforms", "cpu")
-try:
-    jax.config.update("jax_num_cpu_devices", 4)
-except AttributeError:
-    pass  # older jax: the XLA_FLAGS device-count flag set above applies
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from dinunet_implementations_tpu.parallel import (  # noqa: E402
-    distributed_init,
-    distributed_shutdown,
-)
+from dinunet_implementations_tpu.runner import dcn_worker  # noqa: E402
 
-multi = distributed_init(
-    coordinator_address=f"127.0.0.1:{port}", num_processes=nproc, process_id=pid,
-) if nproc > 1 else distributed_init()
+port, nproc, pid, data_path, out_dir, report = sys.argv[1:7]
+extra = sys.argv[7:]  # optional module flags appended by newer harnesses
 
-import dinunet_implementations_tpu.trainer.loop as loop_mod  # noqa: E402
-from dinunet_implementations_tpu import TrainConfig  # noqa: E402
-from dinunet_implementations_tpu.parallel.distributed import (  # noqa: E402
-    spans_processes,
-)
-from dinunet_implementations_tpu.runner import FedRunner  # noqa: E402
-
-writes = {"logs": 0, "ckpt": 0}
-_orig_logs = loop_mod.write_logs_json
-_orig_ckpt = loop_mod.save_checkpoint
-
-
-def _count_logs(*a, **k):
-    writes["logs"] += 1
-    return _orig_logs(*a, **k)
-
-
-def _count_ckpt(*a, **k):
-    writes["ckpt"] += 1
-    return _orig_ckpt(*a, **k)
-
-
-loop_mod.write_logs_json = _count_logs
-loop_mod.save_checkpoint = _count_ckpt
-
-cfg = TrainConfig(
-    task_id="FS-Classification", epochs=4, validation_epochs=2, patience=10,
-    batch_size=8, split_ratio=(0.7, 0.15, 0.15), seed=0,
-)
-runner = FedRunner(cfg, data_path=data_path, out_dir=out_dir)
-try:
-    res = runner.run(verbose=False)[0]
-except Exception as e:  # noqa: BLE001 — capability probe, see below
-    if "Multiprocess computations aren't implemented" in str(e):
-        # this jaxlib's CPU backend cannot execute cross-process collectives
-        # at all (e.g. 0.4.x): report "unsupported", distinct from a real
-        # failure, so the test can skip instead of failing red
-        print(f"UNSUPPORTED: {e}", flush=True)
-        distributed_shutdown()
-        sys.exit(66)
-    raise
-
-with open(report, "w") as fh:
-    json.dump({
-        "process_index": jax.process_index(),
-        "process_count": jax.process_count(),
-        "global_devices": len(jax.devices()),
-        "local_devices": len(jax.local_devices()),
-        "multi": bool(multi),
-        "mesh_spans_processes": spans_processes(runner.mesh),
-        "mesh_shape": dict(runner.mesh.shape),
-        "epoch_losses": [float(x) for x in res["epoch_losses"]],
-        "test_metrics": res["test_metrics"],
-        "n_log_writes": writes["logs"],
-        "n_ckpt_writes": writes["ckpt"],
-    }, fh)
-
-# clean teardown: leave the runtime re-entrant (the coordinated barrier in
-# shutdown also surfaces a wedged peer here, as a nonzero exit, instead of
-# letting the test's timeout mask it)
-distributed_shutdown()
+sys.exit(dcn_worker.main([
+    "--coordinator", f"127.0.0.1:{port}",
+    "--num-processes", nproc,
+    "--process-id", pid,
+    "--data-path", data_path,
+    "--out-dir", out_dir,
+    "--report", report,
+    *extra,
+]))
