@@ -61,7 +61,7 @@ V5E_BF16_PEAK_FLOPS = 197e12
 
 
 def chain_epochs(epoch_fn, state0, x, y, w, n: int, live=None,
-                 attack=None) -> float:
+                 attack=None, slice_live=None) -> float:
     """Run ``n`` chained epochs from ``state0`` and FULLY materialize the
     final state (np.asarray over every leaf) — the only synchronization the
     lazy tunneled backend honors. Returns wall-clock seconds. This is the
@@ -70,14 +70,18 @@ def chain_epochs(epoch_fn, state0, x, y, w, n: int, live=None,
     rounds]`` liveness mask (``--faults``): the same device array feeds every
     epoch (throughput of the masked program, not of a changing schedule);
     ``attack`` is the optional ``[S, rounds]`` attack-code mask
-    (``--attacks``, robustness/attacks.py) riding after it."""
+    (``--attacks``, robustness/attacks.py) riding after it;
+    ``slice_live`` the optional ``[num_slices, rounds]`` slice-liveness
+    mask (r19 — sliced meshes under a slice-fault plan)."""
     import jax
     import numpy as np
 
     s = state0
     t0 = time.time()
     for _ in range(n):
-        if attack is not None:
+        if slice_live is not None:
+            s, _ = epoch_fn(s, x, y, w, live, attack, slice_live)
+        elif attack is not None:
             s, _ = epoch_fn(s, x, y, w, live, attack)
         elif live is not None:
             s, _ = epoch_fn(s, x, y, w, live)
@@ -941,6 +945,16 @@ def _setup_packed_epoch(S: int, K: int, engine_name: str = "dSGD",
     if fault_plan is not None and fault_plan.injects_faults():
         # rounds == steps at local_iterations=1; the first epoch's window
         live = jnp.asarray(fault_plan.liveness(S, 0, d["steps"]))
+    slice_live = None
+    if (
+        slices > 1 and fault_plan is not None
+        and fault_plan.injects_slice_faults()
+    ):
+        # the r19 slice-tier chaos arm: throughput of the slice-masked
+        # three-tier program (replicated mask, one program per pattern)
+        slice_live = jnp.asarray(
+            fault_plan.slice_liveness(slices, 0, d["steps"])
+        )
     attack = None
     if attack_plan is not None and attack_plan.injects_attacks():
         from dinunet_implementations_tpu.robustness.attacks import (
@@ -966,6 +980,9 @@ def _setup_packed_epoch(S: int, K: int, engine_name: str = "dSGD",
     x, y, w = (jax.device_put(a, site_sh) for a in (x, y, w))
     if live is not None:
         live = jax.device_put(live, site_sh)
+    if slice_live is not None:
+        # replicated: every member reads its own slice's row (r19)
+        slice_live = jax.device_put(slice_live, NamedSharding(mesh, P()))
     if attack is not None:
         # the attack mask rides after `live` positionally; live stays None
         # for attack-only runs — the same program form the runner CLI
@@ -997,7 +1014,7 @@ def _setup_packed_epoch(S: int, K: int, engine_name: str = "dSGD",
 
     def run_chain(k: int) -> float:
         t = chain_epochs(epoch_fn, state0, x, y, w, k, live=live,
-                         attack=attack)
+                         attack=attack, slice_live=slice_live)
         if guard is not None:
             guard.check(context=f"sites={S}, pack={K}, chain={k} epochs")
         return t

@@ -33,7 +33,7 @@ from .parallel.mesh import (
     sliced_site_mesh,
 )
 
-__version__ = "0.13.0"
+__version__ = "0.14.0"
 
 
 def __getattr__(name):

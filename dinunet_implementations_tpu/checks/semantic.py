@@ -863,6 +863,14 @@ class TraceCell:
     # axis — so "the expensive hop carries one codec-quantized per-slice
     # partial per round" is a traced property, not a modeled one.
     dcn_quant: str = ""
+    # slice-fault cells (r19, robustness/faults.py slice windows): feed the
+    # [num_slices, rounds] slice-liveness mask (with a dead-slice round)
+    # and build with a min_slices=2 quorum — the wire rules must hold
+    # UNCHANGED ("engines unchanged under masking"): the mask rides a
+    # replicated input and local reductions, zero new collectives, so
+    # S002's ICI proof and the DCN-tier check verify the same figures as
+    # the fault-free sliced cells
+    slice_faults: bool = False
     # free-form label suffix for cells distinguished only by engine_kw
     # (e.g. "+fused" for the Pallas power-iteration corner) — labels key
     # the semantic baseline, so they must stay unique per cell
@@ -885,6 +893,8 @@ class TraceCell:
             name += f"+async{self.staleness}"
         if self.robust != "none":
             name += f"+{self.robust}"
+        if self.slice_faults:
+            name += "+slfault"
         name += self.tag
         return f"{name}/{self.topology}/{self.pipeline}"
 
@@ -982,6 +992,13 @@ def build_cell_inputs(cell: TraceCell, engine=None) -> tuple:
             jnp.zeros((S, steps, B), jnp.int32),
             jnp.ones((S, steps, B), jnp.float32),
         )
+    if cell.slice_faults:
+        # the r19 slice-liveness input: [num_slices, rounds] with slice 1
+        # dead in round 0 — fed after the positional optional inputs
+        # (live / [poison] / attack), which ride as empty-pytree Nones
+        slice_mask = jnp.asarray([[1.0, 1.0], [0.0, 1.0]], jnp.float32)
+        pad = (None, None, None) if cell.pipeline == "device" else (None, None)
+        args = args + pad + (slice_mask,)
     return task, engine, opt, state, args, mesh
 
 
@@ -996,6 +1013,9 @@ def trace_cell(cell: TraceCell, engine=None) -> CellProgram:
         task, engine, opt, mesh=mesh, pipeline=cell.pipeline,
         donate_state=cell.donate, staleness_bound=cell.staleness,
         overlap_rounds=cell.overlap, robust_agg=cell.robust,
+        # slice-fault cells trace the FULL r19 machinery (mask gate +
+        # quorum hold) so the wire proofs cover it
+        min_slices=2 if cell.slice_faults else 1,
     )
     closed, _, comp = epoch_program_artifacts(fn, *args, compiled=cell.donate)
     S = args[1].shape[0]
@@ -1187,6 +1207,21 @@ def default_matrix() -> list:
         TraceCell("dSGD", "sliced", "host", dcn_quant="int8",
                   robust="norm_clip"),
     ]
+    # slice-fault cells (r19): the slice-liveness mask + min_slices=2
+    # quorum in the traced program — "engines unchanged under masking":
+    # S002's ICI figures and the DCN-tier proof must verify the SAME wire
+    # as the fault-free sliced cells (the mask is a replicated input and
+    # local reductions, zero new collectives), incl. the packed
+    # int8-both-tiers corner and the device pipeline.
+    cells += [
+        TraceCell(name, "sliced", "host", engine_kw=kw, slice_faults=True)
+        for name, kw, dense in _ENGINE_CORNERS
+        if not dense
+    ]
+    cells += [
+        TraceCell("dSGD", "sliced4", "device", wire_quant="int8",
+                  dcn_quant="int8", slice_faults=True),
+    ]
     return cells
 
 
@@ -1300,7 +1335,14 @@ def slices_identity_pairs() -> list:
       make every multi-slice claim vacuous);
     - ``slices-dcn-int8`` — the DCN codec must genuinely split the
       inter-slice hop (re-quantized slice-only collectives in the program)
-      vs the fused no-codec form.
+      vs the fused no-codec form;
+    - ``slicefaults-off`` (r19) — a sliced epoch built WITH a min_slices
+      quorum but fed NO slice mask must lower the exact r18 sliced program
+      (the slice-fault machinery gates on the mask's presence, not the
+      config knob — all-slices-live IS the PR 13 program);
+    - ``slicefaults-on`` (r19) — feeding the slice mask must genuinely
+      change the program (the inverse gate: if the gate/hold ops stop
+      appearing, slice faults have silently become a no-op).
 
     Shared by the CLI S005 gate and the tier-1 mirror
     (tests/test_multislice.py)."""
@@ -1328,14 +1370,18 @@ def slices_identity_pairs() -> list:
     y = jnp.zeros((S, steps, B), jnp.int32)
     w = jnp.ones((S, steps, B), jnp.float32)
 
-    def text(mesh, **engine_kw):
+    def text(mesh, slice_live=None, min_slices=1, **engine_kw):
         engine = make_engine("dSGD", **engine_kw)
         state = init_train_state(
             task, engine, opt, jax.random.PRNGKey(0),
             jnp.ones((B, D), jnp.float32), num_sites=S,
         )
-        fn = make_train_epoch_fn(task, engine, opt, mesh=mesh)
-        return fn.lower(state, x, y, w).as_text()
+        fn = make_train_epoch_fn(
+            task, engine, opt, mesh=mesh, min_slices=min_slices
+        )
+        if slice_live is None:
+            return fn.lower(state, x, y, w).as_text()
+        return fn.lower(state, x, y, w, None, None, slice_live).as_text()
 
     legacy = text(packed_site_mesh(S, 2))
     off = text(sliced_site_mesh(1, S, 2))
@@ -1343,10 +1389,18 @@ def slices_identity_pairs() -> list:
     sliced_dcn = text(
         sliced_site_mesh(2, S // 2, 2), dcn_wire_quant="int8"
     )
+    # r19: the slice-fault gate keys on the MASK input, not the quorum knob
+    mask = jnp.asarray(np.array([[1.0, 1.0], [0.0, 1.0]], np.float32))
+    slfault_off = text(sliced_site_mesh(2, S // 2, 2), min_slices=2)
+    slfault_on = text(
+        sliced_site_mesh(2, S // 2, 2), slice_live=mask, min_slices=2
+    )
     return [
         ("slices-off", legacy, off, True),
         ("slices-on", legacy, sliced, False),
         ("slices-dcn-int8", sliced, sliced_dcn, False),
+        ("slicefaults-off", sliced, slfault_off, True),
+        ("slicefaults-on", sliced, slfault_on, False),
     ]
 
 

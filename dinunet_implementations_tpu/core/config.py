@@ -259,6 +259,13 @@ class TrainConfig:
     # quantize the partial before the DCN hop, landing the shrink exactly
     # where bandwidth is scarcest (S002-proven per-tier wire models).
     dcn_wire_quant: str = ""
+    # slice-quorum floor (r19 slice elasticity, trainer/steps.py): on a
+    # sliced mesh with a slice-fault plan, a round with fewer LIVE slices
+    # than this HOLDS — params/optimizer/engine/health frozen, NaN loss,
+    # held_rounds telemetry — instead of training on a rump cohort. 1
+    # (default) trains whenever any slice survives; only meaningful with
+    # num_slices > 1 (rejected otherwise).
+    min_slices: int = 1
     # sequence/model parallelism (SURVEY.md §2.2 TPU extension): >1 builds a
     # (site, model) mesh; each site's model shards its sequence axis over the
     # model axis — ICALstm runs its BiLSTM as a ring LSTM, the multimodal

@@ -167,7 +167,8 @@ def _dcn_hop(partial, axes: PackedAxis, dcn_wire):
     return jax.lax.psum(dcn_wire.compress(partial), axes.slice_name)
 
 
-def three_level_psum(x, axes: PackedAxis, wire_dtype=None, dcn_wire=None):
+def three_level_psum(x, axes: PackedAxis, wire_dtype=None, dcn_wire=None,
+                     slice_live=None):
     """The hierarchical reduction primitive (module docstring): tier 0 is
     the in-register pack sum, tier 1 the intra-slice psum of the UNBATCHED
     partial (quantized to ``wire_dtype`` — what the device ships over ICI;
@@ -177,8 +178,20 @@ def three_level_psum(x, axes: PackedAxis, wire_dtype=None, dcn_wire=None):
     1+2 into one ``(slice, site)`` collective (bit-identical values to the
     flat reduce); a :class:`WireCodec` splits them, re-quantizing the
     per-slice partial before the slice-only psum. The ICI wire cost is
-    K-independent and the DCN hop ships one partial per slice per round."""
+    K-independent and the DCN hop ships one partial per slice per round.
+
+    ``slice_live`` (r19 slice elasticity) is this member's OWN slice's
+    per-round liveness gate — a traced 0/1 scalar. The local partial is
+    zeroed before any cross-member tier, so a dead slice contributes
+    EXACTLY nothing to the DCN reduce and the surviving slices' sum equals
+    the reduce that excluded the dead slice's members outright (``×1.0`` is
+    bit-exact, ``×0`` is exclusion). The epoch's production rounds route
+    slice death through the site-level contribute gate (trainer/steps.py —
+    value-equivalent, proven by tests/test_multislice.py); this explicit
+    form is the primitive-level contract the slice-fault unit tests pin."""
     part = _pack_partial(x, wire_dtype)
+    if slice_live is not None and axes.slice_name is not None:
+        part = part * slice_live
     if axes.name is None:
         return part
     if axes.slice_name is None:
@@ -196,24 +209,28 @@ def two_level_psum(x, axes: PackedAxis, wire_dtype=None, dcn_wire=None):
     return three_level_psum(x, axes, wire_dtype, dcn_wire)
 
 
-def weighted_site_sum(g, scale, axis_name, wire_dtype=None, dcn_wire=None):
+def weighted_site_sum(g, scale, axis_name, wire_dtype=None, dcn_wire=None,
+                      slice_live=None):
     """One dense payload leaf of a weighted exchange: ``Σ_s scale_s · g_s``
     accumulated in f32. Classic axes psum the per-site scaled value; a
     :class:`PackedAxis` takes the two-level route (``scale`` is then the
     ``[K]`` vector and ``g`` carries the leading pack axis), growing the
     DCN tier on sliced axes (``dcn_wire`` — :func:`three_level_psum`).
     ``wire_dtype`` quantizes the packed partial only — on the classic path
-    the per-member payload is whatever the caller already cast it to."""
+    the per-member payload is whatever the caller already cast it to.
+    ``slice_live`` gates this member's slice out of the reduce
+    (:func:`three_level_psum` — sliced axes only)."""
     gf = g.astype(jnp.float32)
     if isinstance(axis_name, PackedAxis):
         return three_level_psum(
-            gf * _bcast(scale, gf), axis_name, wire_dtype, dcn_wire
+            gf * _bcast(scale, gf), axis_name, wire_dtype, dcn_wire,
+            slice_live,
         )
     return jax.lax.psum(gf * scale, axis_name)
 
 
 def weighted_tree_sum(tree, scale, axes: PackedAxis, wire_dtype=None,
-                      dcn_wire=None):
+                      dcn_wire=None, slice_live=None):
     """A whole pytree's weighted exchange with ONE inter-slice collective.
 
     Per leaf, tiers 0+1 run exactly like :func:`weighted_site_sum`; the DCN
@@ -223,7 +240,11 @@ def weighted_tree_sum(tree, scale, axes: PackedAxis, wire_dtype=None,
     launch per round instead of one per leaf. Single-slice axes (or
     ``dcn_wire=None``) reduce per leaf exactly like the mapped
     :func:`weighted_site_sum` — same ops, so the legacy program is
-    untouched. dSGD's whole dense exchange rides this (engines/dsgd.py)."""
+    untouched. dSGD's whole dense exchange rides this (engines/dsgd.py).
+    ``slice_live`` gates the per-slice partial out of the DCN reduce like
+    :func:`three_level_psum` — the reduce then renormalizes over surviving
+    slices only (the weights of a dead slice's members carry zero through
+    ``scale``, so the denominator excludes them too)."""
     if not isinstance(axes, PackedAxis):
         return jax.tree.map(
             lambda g: weighted_site_sum(g, scale, axes, wire_dtype), tree
@@ -231,7 +252,7 @@ def weighted_tree_sum(tree, scale, axes: PackedAxis, wire_dtype=None,
     if axes.slice_name is None or dcn_wire is None or axes.name is None:
         return jax.tree.map(
             lambda g: weighted_site_sum(
-                g, scale, axes, wire_dtype, dcn_wire
+                g, scale, axes, wire_dtype, dcn_wire, slice_live
             ),
             tree,
         )
@@ -244,6 +265,8 @@ def weighted_tree_sum(tree, scale, axes: PackedAxis, wire_dtype=None,
         ),
         tree,
     )
+    if slice_live is not None:
+        partials = jax.tree.map(lambda p: p * slice_live, partials)
     leaves, treedef = jax.tree.flatten(partials)
     comp = [dcn_wire.compress(leaf).reshape(-1) for leaf in leaves]
     flat = comp[0] if len(comp) == 1 else jnp.concatenate(comp)

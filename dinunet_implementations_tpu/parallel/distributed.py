@@ -101,6 +101,7 @@ def distributed_init(
                 **kwargs,
             )
         except (RuntimeError, OSError):
+            _dcn_counter("dcn_join_retries_total")
             # the retryable failure modes: coordinator not up yet (refused
             # connect → ConnectionError ⊂ OSError), DNS/socket errors, and
             # jaxlib surfacing a failed join as RuntimeError/XlaRuntimeError.
@@ -114,22 +115,47 @@ def distributed_init(
             _reset_partial_distributed_state()
             raise
 
-    with_retry(
-        _attempt_initialize,
-        attempts=3,
-        base_delay=0.5,
-        retry_on=(RuntimeError, OSError, ConnectionError),
-        describe="jax.distributed.initialize",
-        deadline_s=join_deadline_s,
-        timeout_s=join_timeout_s,
-        # a TIMED-OUT join is fatal, not retryable: the abandoned attempt's
-        # thread may still be mutating jax's global distributed state, and a
-        # concurrent re-initialize would race it — fast failures (refused
-        # connect) still retry through retry_on
-        retry_on_timeout=False,
-    )()
+    from ..robustness.retry import RetryTimeout
+
+    try:
+        with_retry(
+            _attempt_initialize,
+            attempts=3,
+            base_delay=0.5,
+            retry_on=(RuntimeError, OSError, ConnectionError),
+            describe="jax.distributed.initialize",
+            deadline_s=join_deadline_s,
+            timeout_s=join_timeout_s,
+            # a TIMED-OUT join is fatal, not retryable: the abandoned
+            # attempt's thread may still be mutating jax's global
+            # distributed state, and a concurrent re-initialize would race
+            # it — fast failures (refused connect) still retry via retry_on
+            retry_on_timeout=False,
+        )()
+    except RetryTimeout:
+        # the hung-coordinator fail-fast path: surfaced on the live bus so
+        # a fleet supervisor sees "joins are timing out", not just dying
+        _dcn_counter("dcn_join_timeouts_total")
+        raise
     _initialized = True
     return True
+
+
+def _dcn_counter(name: str, **labels) -> None:
+    """Best-effort live-bus counter for DCN runtime events (join retries,
+    join timeouts — the r19 dcn_timeout observability). The bus is never
+    load-bearing here: a half-imported telemetry layer (early interpreter
+    teardown, exotic embedding) must not turn a join failure into a
+    different failure."""
+    try:
+        from ..telemetry.bus import global_bus
+
+        # API-boundary forward: NAME is a literal at every call site
+        global_bus().counter(name, **labels)  # jaxlint: disable=R007
+    # observability only — the join path's own exception must propagate,
+    # never be replaced by a bus import/publish error
+    except Exception:  # jaxlint: disable=R002
+        pass
 
 
 def _jax_distributed_client():
@@ -373,10 +399,28 @@ def put_site_inventory(mesh, inventory, input_dtype=None):
     )
 
 
-def put_epoch_plan(mesh, positions, live=None, poison=None, attack=None):
+def put_replicated(mesh, arr, dtype=None):
+    """Ship a small host array to the mesh FULLY REPLICATED — the r19
+    slice-liveness mask's placement (every member reads its own slice's row
+    from the same tiny ``[num_slices, rounds]`` array; sharding it would
+    buy nothing and cost a spec). Multi-host meshes feed it per process
+    like the batches — every process holds the identical mask, so the
+    process-local data IS the global array."""
+    a = np.asarray(arr)
+    if dtype is not None:
+        a = a.astype(dtype)
+    sh = NamedSharding(mesh, P())
+    if spans_processes(mesh):
+        return jax.make_array_from_process_local_data(sh, a, global_shape=a.shape)
+    return jax.device_put(a, sh)
+
+
+def put_epoch_plan(mesh, positions, live=None, poison=None, attack=None,
+                   slice_live=None):
     """Ship one epoch's compact plan — the ``[S, steps, B]`` int32 index
-    grid plus the optional ``[S, rounds]`` fault masks and attack-code mask
-    (robustness/attacks.py, r17) — to the mesh. This is the ENTIRE
+    grid plus the optional ``[S, rounds]`` fault masks, attack-code mask
+    (robustness/attacks.py, r17) and ``[num_slices, rounds]`` slice-
+    liveness mask (r19, replicated) — to the mesh. This is the ENTIRE
     per-epoch host→device traffic of the device pipeline: index-plan bytes,
     not dataset bytes."""
     import jax.numpy as jnp
@@ -389,6 +433,10 @@ def put_epoch_plan(mesh, positions, live=None, poison=None, attack=None):
         None if live is None else put(live),
         None if poison is None else put(poison),
         None if attack is None else put(attack),
+        None if slice_live is None else (
+            jnp.asarray(slice_live) if mesh is None
+            else put_replicated(mesh, slice_live)
+        ),
     )
 
 

@@ -18,6 +18,25 @@ run should experience:
 - ``kill_at_round``: simulated preemption — the trainer saves a checkpoint
   and raises :class:`~.preemption.Preempted` once the global round counter
   passes this value (the deterministic arm of the SIGTERM handler);
+- ``slice_drop_at``/``slice_delay_at``/``kill_slice_at``: SLICE-tier faults
+  (r19) — whole-slice outages on the multi-slice DCN topology
+  (parallel/mesh.py ``sliced_site_mesh``), in the same global-round
+  coordinates. ``slice_drop_at`` is ``(slice, first_round, last_round)``
+  windows (inclusive; ``-1`` = to the end), ``slice_delay_at`` is
+  ``(slice, round, delay)`` straggler triples (the slice's DCN hop misses
+  rounds ``[round, round + delay)`` — a preempted-and-rescheduled slice),
+  and ``kill_slice_at`` is ``(slice, round)`` pairs: the slice dies at that
+  round and STAYS dead until a supervisor restarts it. All three render
+  into the ``[num_slices, rounds]`` mask of :meth:`FaultPlan
+  .slice_liveness` — a traced epoch input exactly like the site mask, so
+  ONE compiled program per fit covers any slice-fault pattern. Under the
+  supervised multi-process runner (runner/dcn_worker.py) ``kill_slice_at``
+  is realized PHYSICALLY instead — the slice's worker process SIGKILLs
+  itself when its round counter crosses the kill, and the supervisor's
+  restart/consensus-rejoin path is what brings it back — so emulated and
+  real runs exercise the same declarative plan
+  (``slice_liveness(include_kills=False)`` keeps the mask arm out when the
+  process arm owns the fault);
 - ``delay_at``: deterministic STRAGGLERS — ``(site, round, delay)`` triples:
   the site's fresh update for rounds ``[round, round + delay)`` never
   arrives (it is "in flight" for ``delay`` rounds). In the bulk-sync
@@ -68,11 +87,27 @@ class FaultPlan:
     nan_at: tuple = ()  # (round, site) pairs
     kill_at_round: int | None = None
     delay_at: tuple = ()  # (site, round, delay) straggler triples
+    # -- slice-tier faults (r19, module docstring) -----------------------
+    slice_drop_at: tuple = ()  # (slice, first_round, last_round); -1 = forever
+    slice_delay_at: tuple = ()  # (slice, round, delay) straggler triples
+    kill_slice_at: tuple = ()  # (slice, round): dead from round until restart
 
     def __post_init__(self):
         object.__setattr__(self, "drop", _tuplize(self.drop, 3, "drop"))
         object.__setattr__(self, "nan_at", _tuplize(self.nan_at, 2, "nan_at"))
         object.__setattr__(self, "delay_at", _tuplize(self.delay_at, 3, "delay_at"))
+        object.__setattr__(
+            self, "slice_drop_at",
+            _tuplize(self.slice_drop_at, 3, "slice_drop_at"),
+        )
+        object.__setattr__(
+            self, "slice_delay_at",
+            _tuplize(self.slice_delay_at, 3, "slice_delay_at"),
+        )
+        object.__setattr__(
+            self, "kill_slice_at",
+            _tuplize(self.kill_slice_at, 2, "kill_slice_at"),
+        )
         if not 0.0 <= float(self.flaky_prob) <= 1.0:
             raise ValueError(
                 f"FaultPlan.flaky_prob must be in [0, 1], got {self.flaky_prob}"
@@ -88,6 +123,22 @@ class FaultPlan:
                 raise ValueError(
                     f"bad FaultPlan.delay_at entry {(site, rnd, delay)} "
                     "(need site >= 0, round >= 0, delay >= 1)"
+                )
+        for sl, first, last in self.slice_drop_at:
+            if sl < 0 or first < 0 or (last != -1 and last < first):
+                raise ValueError(
+                    f"bad FaultPlan.slice_drop_at entry {(sl, first, last)}"
+                )
+        for sl, rnd, delay in self.slice_delay_at:
+            if sl < 0 or rnd < 0 or delay < 1:
+                raise ValueError(
+                    f"bad FaultPlan.slice_delay_at entry {(sl, rnd, delay)} "
+                    "(need slice >= 0, round >= 0, delay >= 1)"
+                )
+        for sl, rnd in self.kill_slice_at:
+            if sl < 0 or rnd < 0:
+                raise ValueError(
+                    f"bad FaultPlan.kill_slice_at entry {(sl, rnd)}"
                 )
 
     # -- round-window mask generation ------------------------------------
@@ -152,12 +203,70 @@ class FaultPlan:
                 mask[site, r] = True
         return mask
 
+    def slice_liveness(self, num_slices: int, round_start: int,
+                       num_rounds: int, include_kills: bool = True
+                       ) -> np.ndarray:
+        """``[num_slices, num_rounds]`` float32 mask for the round window
+        ``[round_start, round_start + num_rounds)``: 1 = slice live, 0 =
+        slice dead. Pure function of the plan and GLOBAL round coordinates
+        (chunk/resume-independent, like :meth:`liveness`).
+
+        ``include_kills=False`` leaves the ``kill_slice_at`` windows out of
+        the mask — the supervised multi-process runner realizes those as
+        real process deaths (runner/dcn_worker.py), and masking them too
+        would keep a restarted slice dead forever."""
+        live = np.ones((num_slices, num_rounds), np.float32)
+        for sl, first, last in self.slice_drop_at:
+            if sl >= num_slices:
+                continue
+            lo = max(first - round_start, 0)
+            hi = num_rounds if last == -1 else min(last + 1 - round_start, num_rounds)
+            if lo < hi:
+                live[sl, lo:hi] = 0.0
+        for sl, rnd, delay in self.slice_delay_at:
+            # a straggling slice misses its DCN hop for the in-flight
+            # window, exactly like a site-level delay_at misses its arrival
+            if sl >= num_slices:
+                continue
+            lo = max(rnd - round_start, 0)
+            hi = min(rnd + delay - round_start, num_rounds)
+            if lo < hi:
+                live[sl, lo:hi] = 0.0
+        if include_kills:
+            for sl, rnd in self.kill_slice_at:
+                # a killed slice stays dead to the end of the mask: only a
+                # supervisor restart (which re-renders without the kill)
+                # brings it back
+                if sl >= num_slices:
+                    continue
+                lo = max(rnd - round_start, 0)
+                if lo < num_rounds:
+                    live[sl, lo:] = 0.0
+        return live
+
+    def kill_round_for_slice(self, slice_id: int) -> int | None:
+        """The earliest ``kill_slice_at`` round for ``slice_id``, or None —
+        the supervised worker's deterministic self-kill arm keys on this."""
+        rounds = [r for sl, r in self.kill_slice_at if sl == slice_id]
+        return min(rounds) if rounds else None
+
     def injects_faults(self) -> bool:
         """True when the plan perturbs training rounds (drops / flaky / NaN /
-        stragglers) — a kill-only plan needs no per-round masks."""
+        stragglers) — a kill-only plan needs no per-round masks. Slice-tier
+        windows are separate (:meth:`injects_slice_faults`): they render
+        into the ``[num_slices, rounds]`` mask, not the site mask."""
         return (
             bool(self.drop) or self.flaky_prob > 0.0 or bool(self.nan_at)
             or bool(self.delay_at)
+        )
+
+    def injects_slice_faults(self, include_kills: bool = True) -> bool:
+        """True when the plan perturbs the SLICE tier (r19) — the trainer
+        then feeds the ``[num_slices, rounds]`` slice mask as a traced
+        input. Same ``include_kills`` semantics as :meth:`slice_liveness`."""
+        return bool(
+            self.slice_drop_at or self.slice_delay_at
+            or (include_kills and self.kill_slice_at)
         )
 
     # -- JSON round-trip (CLI / bench surface) ---------------------------
@@ -170,6 +279,9 @@ class FaultPlan:
             "nan_at": [list(t) for t in self.nan_at],
             "kill_at_round": self.kill_at_round,
             "delay_at": [list(t) for t in self.delay_at],
+            "slice_drop_at": [list(t) for t in self.slice_drop_at],
+            "slice_delay_at": [list(t) for t in self.slice_delay_at],
+            "kill_slice_at": [list(t) for t in self.kill_slice_at],
         }
 
     @classmethod
@@ -214,6 +326,23 @@ def fault_window(plan: FaultPlan | None, num_sites: int, round0: int,
     return (
         plan.liveness(num_sites, round0, rounds),
         plan.nan_mask(num_sites, round0, rounds),
+    )
+
+
+def slice_fault_window(plan: FaultPlan | None, num_slices: int, round0: int,
+                       rounds: int, include_kills: bool = True):
+    """The per-epoch SLICE-liveness mask for the global round window
+    ``[round0, round0 + rounds)`` — ``[num_slices, rounds]`` float32, or
+    ``None`` when the plan has no slice-tier faults (or the topology has no
+    slice tier to fault). The one place both pipelines derive the slice
+    window from, mirroring :func:`fault_window`."""
+    if (
+        plan is None or num_slices <= 1
+        or not plan.injects_slice_faults(include_kills)
+    ):
+        return None
+    return plan.slice_liveness(
+        num_slices, round0, rounds, include_kills=include_kills
     )
 
 

@@ -75,6 +75,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="inter-slice wire codec, independent of "
                         "--wire-quant (default: follow it); quantizes the "
                         "per-slice partial on the slow DCN hop only")
+    p.add_argument("--min-slices", type=int, default=None,
+                   help="slice-quorum floor (r19): a round with fewer LIVE "
+                        "slices than this HOLDS (params/opt frozen, NaN "
+                        "loss, held_rounds telemetry) instead of training "
+                        "on a rump cohort; needs --slices > 1 and a "
+                        "--faults plan with slice windows "
+                        "(slice_drop_at / slice_delay_at / kill_slice_at)")
     p.add_argument("--out-dir", default=None,
                    help="output root (default <data-path>/output)")
     p.add_argument("--site", type=int, default=None,
@@ -222,6 +229,7 @@ def main(argv: list[str] | None = None) -> int:
         ("sites_per_device", args.sites_per_device),
         ("num_slices", args.slices),
         ("dcn_wire_quant", args.dcn_wire_quant),
+        ("min_slices", args.min_slices),
         ("profile_dir", args.profile_dir),
         ("telemetry", args.telemetry),
         ("xprof_dir", args.xprof_dir),

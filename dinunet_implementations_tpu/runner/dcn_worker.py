@@ -17,15 +17,54 @@ Typical per-slice launch (one process per TPU slice / host)::
         --coordinator host0:1234 --num-processes 4 --process-id $RANK \
         --slices 4 --data-path /data/tree --out-dir /shared/out
 
+Supervised mode (r19 — runner/supervisor.py): ``--supervise`` makes this
+invocation the SUPERVISOR of the fleet instead of a worker. It launches
+one worker per ``--process-id`` slot, monitors process exits AND heartbeat
+staleness (each slice's lead rank pulses
+``<out>/heartbeats/slice_<i>.json`` from a timer thread — staleness
+catches hard freezes and dead-mount write blocks; a fleet wedged in a
+collective is recovered through the dead peer's exit + drain), records
+every slice death in the shared liveness spool
+(``<out>/slice_liveness/``), dumps its flight recorder with the slice id +
+last heartbeat age, drains the survivors (SIGTERM → checkpoint + clean
+exit; SIGKILL past the grace window), computes the CROSS-SLICE CHECKPOINT
+CONSENSUS — the newest round where all surviving slices' rotating sidecar
+checkpoints (``<out>/slices/slice_<i>/``, written every epoch with a
+params-sha256 meta; torn files fall back to ``.prev`` per the PR 2
+contract) agree by digest — installs that generation as the fleet resume
+point, and relaunches everything with ``--resume``. A preempted slice
+costs the run one checkpoint window, never the run itself. The
+deterministic chaos arm: a ``--faults`` plan with ``kill_slice_at`` makes
+the named slice's worker SIGKILL ITSELF when its round counter crosses
+the kill (first launch generation only — restarted incarnations sail
+through), so the whole death→consensus→rejoin cycle replays identically
+in CI.
+
 Every process computes identical replicated results; only process 0 writes
 logs/checkpoints (trainer/loop.py ``_coordinator``). ``--report PATH``
 writes a JSON record of the run — mesh shape, per-epoch losses, a params
 checksum (bit-compared across processes by the multihost smoke test), the
 epoch compile count, and the process-0-only write counters.
 
-Capability probe: a jaxlib whose CPU backend cannot execute cross-process
-collectives at all exits with code 66 (``UNSUPPORTED``), distinct from a
-real failure — the CI/tier-1 smoke skips instead of failing red.
+Exit codes (every failure path calls ``distributed_shutdown()`` first, so
+the runtime is re-entrant and a wedged peer surfaces as a nonzero exit
+rather than a hang):
+
+- ``0`` — run completed.
+- ``66`` (:data:`UNSUPPORTED_RC`) — capability probe: this jaxlib's CPU
+  backend cannot execute multiprocess collectives at all; CI smokes SKIP
+  on it instead of failing red. A supervisor propagates it verbatim.
+- ``128 + signum`` — cooperative preemption: SIGTERM/SIGINT landed during
+  the fit, the rotating checkpoint was saved at the epoch boundary, the
+  flight recorder dumped, and the process exited with the shell's
+  signal-death convention (e.g. 143 for SIGTERM). ``75`` is the
+  deterministic FaultPlan ``kill_at_round`` arm of the same path
+  (robustness/preemption.py ``Preempted.exit_code``).
+- ``-9`` / ``137`` — the ``kill_slice_at`` chaos arm's self-SIGKILL (an
+  abrupt, uncheckpointed death by design: the supervisor must recover it
+  from the OTHER slices' checkpoints).
+- ``69`` (:data:`~..runner.supervisor.SUPERVISOR_GAVE_UP_RC`) — supervisor
+  only: a slice kept dying past ``--max-restarts``.
 """
 
 from __future__ import annotations
@@ -34,6 +73,7 @@ import argparse
 import hashlib
 import json
 import os
+import signal
 import sys
 
 #: exit code for "this backend cannot run multiprocess collectives" — the
@@ -57,9 +97,12 @@ def _parse(argv):
                         "process loads the same tree and feeds its own "
                         "addressable mesh slices")
     p.add_argument("--out-dir", default=None,
-                   help="shared output dir (process 0 writes)")
+                   help="shared output dir (process 0 writes; heartbeats, "
+                        "the liveness spool and per-slice checkpoint "
+                        "sidecars live here too)")
     p.add_argument("--report", default=None, metavar="PATH",
-                   help="write the run-report JSON here")
+                   help="write the run-report JSON here (supervised mode: "
+                        "one _p<rank> report per worker)")
     p.add_argument("--slices", type=int, default=1,
                    help="num_slices for the three-tier (slice, site, model) "
                         "mesh; must divide --num-processes (1 = the legacy "
@@ -74,6 +117,35 @@ def _parse(argv):
     p.add_argument("--epochs", type=int, default=4)
     p.add_argument("--task", default="FS-Classification")
     p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--faults", default=None, metavar="JSON|@FILE",
+                   help="deterministic FaultPlan (robustness/faults.py) — "
+                        "site AND slice-tier windows; kill_slice_at is "
+                        "realized as a real self-SIGKILL of the named "
+                        "slice's worker (first generation only)")
+    p.add_argument("--resume", action="store_true",
+                   help="continue from the last rotating checkpoint "
+                        "(FedRunner resume; the supervisor always passes "
+                        "this on relaunch)")
+    p.add_argument("--supervise", action="store_true",
+                   help="run as the fleet SUPERVISOR: launch one worker "
+                        "per process slot, monitor heartbeats/exits, "
+                        "restart dead slices via checkpoint-consensus "
+                        "rejoin (module docstring)")
+    p.add_argument("--heartbeat-s", type=float, default=2.0,
+                   help="worker heartbeat interval (seconds)")
+    p.add_argument("--heartbeat-timeout-s", type=float, default=30.0,
+                   help="supervisor: heartbeat staleness past this is a "
+                        "wedged worker (with_retry deadline semantics "
+                        "before the verdict)")
+    p.add_argument("--max-restarts", type=int, default=2,
+                   help="supervisor: give up (rc 69) after this many "
+                        "fleet restarts")
+    p.add_argument("--slice-ckpt", action="store_true",
+                   help="rotate a per-slice checkpoint sidecar every epoch "
+                        "(consensus input; the supervisor passes this to "
+                        "its workers)")
+    p.add_argument("--restart-generation", type=int, default=1,
+                   help=argparse.SUPPRESS)  # supervisor-internal
     p.add_argument("--set", dest="overrides", action="append", default=[],
                    metavar="KEY=VALUE",
                    help="raw TrainConfig overrides (JSON-parsed values)")
@@ -91,12 +163,22 @@ def _config_overrides(pairs):
     return out
 
 
+def _slice_of(process_id: int, num_processes: int, slices: int) -> int:
+    """The mesh slice this process belongs to — processes are slice
+    granules, contiguous (parallel/distributed.py
+    multihost_sliced_site_mesh)."""
+    if slices <= 1:
+        return 0
+    return process_id // max(num_processes // slices, 1)
+
+
 def _params_checksum(state) -> str:
     """Order-stable digest of the replicated params — every process of a
     correct run reports the SAME hex (params are replicated by the
     aggregation collectives; the multihost smoke bit-compares this across
-    processes after one round). ``addressable_data(0)`` reads the local
-    replica, so no cross-process fetch is needed."""
+    processes after one round, and the cross-slice checkpoint consensus
+    keys on it). ``addressable_data(0)`` reads the local replica, so no
+    cross-process fetch is needed."""
     import jax
     import numpy as np
 
@@ -107,8 +189,162 @@ def _params_checksum(state) -> str:
     return h.hexdigest()
 
 
+# ---------------------------------------------------------------------------
+# supervisor entry
+# ---------------------------------------------------------------------------
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _report_path(base: str | None, rank: int) -> str | None:
+    if not base:
+        return None
+    root, ext = os.path.splitext(base)
+    return f"{root}_p{rank}{ext or '.json'}"
+
+
+def _supervise(args) -> int:
+    """The ``--supervise`` entry: drive a :class:`~.supervisor
+    .SliceSupervisor` over per-slice ``dcn_worker`` processes (module
+    docstring). Runs withOUT initializing jax.distributed in this process —
+    the supervisor is a pure host-side state machine."""
+    import subprocess
+
+    from ..telemetry.bus import global_bus
+    from ..telemetry.flight import FlightRecorder
+    from .supervisor import (
+        SliceSupervisor,
+        consensus_round,
+        slice_ckpt_dir,
+    )
+
+    out_dir = args.out_dir or "."
+    os.makedirs(out_dir, exist_ok=True)
+    flight = FlightRecorder(out_dir, bus=global_bus())
+    flight.install()  # crash dumps; SIGTERM chained (no guard owns it here)
+    launch = {"generation": 0, "port": None}
+
+    def spawn(rank: int, generation: int):
+        if generation != launch["generation"]:
+            launch["generation"] = generation
+            launch["port"] = _free_port()
+        worker_argv = [
+            sys.executable, "-m",
+            "dinunet_implementations_tpu.runner.dcn_worker",
+            "--coordinator", f"127.0.0.1:{launch['port']}",
+            "--num-processes", str(args.num_processes),
+            "--process-id", str(rank),
+            "--data-path", args.data_path,
+            "--slices", str(args.slices),
+            "--epochs", str(args.epochs),
+            "--task", args.task,
+            "--batch-size", str(args.batch_size),
+            "--devices-per-process", str(args.devices_per_process),
+            "--heartbeat-s", str(args.heartbeat_s),
+            "--restart-generation", str(generation),
+            "--slice-ckpt",
+            "--out-dir", out_dir,
+        ]
+        if args.dcn_wire_quant:
+            worker_argv += ["--dcn-wire-quant", args.dcn_wire_quant]
+        if args.faults:
+            worker_argv += ["--faults", args.faults]
+        if args.resume or generation > 1:
+            worker_argv += ["--resume"]
+        rep = _report_path(args.report, rank)
+        if rep:
+            worker_argv += ["--report", rep]
+        for kv in args.overrides:
+            worker_argv += ["--set", kv]
+        # the workers own their backend config (devices-per-process etc.);
+        # an inherited XLA device-count flag would double-apply
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+        with open(os.path.join(
+            out_dir, f"worker_p{rank}_gen{generation}.log"), "w",
+        ) as log:
+            # the child dups the fd at spawn; closing ours leaks nothing
+            return subprocess.Popen(
+                worker_argv, stdout=log, stderr=subprocess.STDOUT, env=env,
+            )
+
+    def slice_of(rank: int) -> int:
+        return _slice_of(rank, args.num_processes, args.slices)
+
+    def install_consensus(generation: int, dead_slice: int) -> None:
+        """Pick the newest round all SURVIVING slices' sidecars agree on
+        and install it as the fleet resume point, unless the shared fold
+        checkpoint already sits at that epoch (keeping its richer fit
+        meta — loss history, early-stop bookkeeping — when it does)."""
+        from ..trainer.checkpoint import CorruptCheckpointError, load_meta
+        from ..trainer.logs import fold_dir
+
+        dirs = {
+            sl: slice_ckpt_dir(out_dir, sl)
+            for sl in range(max(args.slices, 1)) if sl != dead_slice
+        }
+        agreed = consensus_round(dirs or {
+            sl: slice_ckpt_dir(out_dir, sl)
+            for sl in range(max(args.slices, 1))
+        })
+        if agreed is None:
+            flight.note("consensus-none", generation=generation)
+            return  # fleet resumes from the shared fold checkpoint as-is
+        rnd, sha, path = agreed
+        epoch = load_meta(path).get("epoch")
+        resume = os.path.join(
+            fold_dir(out_dir, "remote", args.task, 0),
+            "checkpoint_latest.msgpack",
+        )
+        try:
+            fold_epoch = load_meta(resume).get("epoch")
+        except (OSError, CorruptCheckpointError):
+            fold_epoch = None
+        if fold_epoch != epoch:
+            # torn, missing, or AHEAD of the agreement (the coordinator
+            # checkpointed an epoch a now-dead slice never sealed): roll
+            # the fleet to the agreed generation
+            import shutil
+
+            os.makedirs(os.path.dirname(resume), exist_ok=True)
+            shutil.copyfile(path, resume)
+        flight.note("consensus-install", round=rnd, epoch=epoch,
+                    sha=sha[:12], replaced=fold_epoch != epoch)
+
+    sup = SliceSupervisor(
+        spawn,
+        num_processes=args.num_processes,
+        out_dir=out_dir,
+        slice_of_process=slice_of,
+        heartbeat_timeout_s=args.heartbeat_timeout_s,
+        max_restarts=args.max_restarts,
+        flight=flight,
+        bus=global_bus(),
+        on_consensus=install_consensus,
+        passthrough_rcs=(UNSUPPORTED_RC,),
+    )
+    rc = sup.run()
+    flight.note("supervisor-exit", rc=rc, restarts=sup.restarts)
+    return rc
+
+
+# ---------------------------------------------------------------------------
+# worker entry
+# ---------------------------------------------------------------------------
+
+
 def main(argv=None) -> int:
     args = _parse(argv if argv is not None else sys.argv[1:])
+    if args.supervise:
+        return _supervise(args)
 
     # Belt and braces across jax versions: the XLA_FLAGS env var is consumed
     # at backend-client creation (lazy — still effective even when
@@ -134,6 +370,44 @@ def main(argv=None) -> int:
         distributed_init,
         distributed_shutdown,
     )
+    from dinunet_implementations_tpu.robustness.faults import (
+        parse_fault_plan,
+    )
+    from dinunet_implementations_tpu.robustness.preemption import Preempted
+    from dinunet_implementations_tpu.runner.supervisor import (
+        Heartbeat,
+        heartbeat_path,
+        slice_ckpt_dir,
+    )
+    from dinunet_implementations_tpu.telemetry.flight import FlightRecorder
+
+    try:
+        fault_plan = parse_fault_plan(args.faults)
+    except (ValueError, OSError) as e:
+        print(f"--faults: {e}", file=sys.stderr)
+        return 2
+
+    slice_id = _slice_of(args.process_id, args.num_processes, args.slices)
+    # one sidecar/heartbeat writer per slice: with several processes per
+    # slice (num_processes > slices), slice-mates rotating the same files
+    # would race checkpoint.py's exists-then-replace (and shadow each
+    # other's pulses); params are replicated, so the slice's FIRST rank
+    # writing is lossless
+    procs_per_slice = max(args.num_processes // max(args.slices, 1), 1)
+    slice_lead = args.process_id % procs_per_slice == 0
+    heartbeat = None
+    flight = None
+    if args.out_dir:
+        flight = FlightRecorder(args.out_dir)
+        # crash dumps + SIGTERM-outside-the-fit dumps; DURING the fit the
+        # PreemptionGuard owns SIGTERM and the Preempted handler below
+        # dumps cooperatively (telemetry/flight.py contract)
+        flight.install()
+        if slice_lead:
+            heartbeat = Heartbeat(
+                heartbeat_path(args.out_dir, slice_id), slice_id,
+                interval_s=args.heartbeat_s,
+            ).start()
 
     multi = distributed_init(
         coordinator_address=args.coordinator,
@@ -151,6 +425,7 @@ def main(argv=None) -> int:
     writes = {"logs": 0, "ckpt": 0}
     _orig_logs = loop_mod.write_logs_json
     _orig_ckpt = loop_mod.save_checkpoint
+    _save_checkpoint = loop_mod.save_checkpoint
 
     def _count_logs(*a, **k):
         writes["logs"] += 1
@@ -165,13 +440,58 @@ def main(argv=None) -> int:
 
     # keep the final epoch state visible for the params checksum (the fit
     # result dict carries metrics, not weights) — and the trainer for the
-    # CompileGuard-style epoch compile count
-    final = {"state": None, "trainer": None}
+    # CompileGuard-style epoch compile count. In supervised/--slice-ckpt
+    # mode the same hook also (a) pulses the heartbeat with round progress,
+    # (b) rotates this slice's consensus sidecar, and (c) fires the
+    # kill_slice_at self-SIGKILL chaos arm (first generation only).
+    final = {"state": None, "trainer": None, "epoch": 0, "round": 0}
     _orig_run_epoch = loop_mod.FederatedTrainer.run_epoch
+    kill_round = (
+        fault_plan.kill_round_for_slice(slice_id)
+        if fault_plan is not None and args.restart_generation <= 1 else None
+    )
+    my_ckpt_dir = (
+        slice_ckpt_dir(args.out_dir, slice_id)
+        if args.out_dir and args.slice_ckpt and slice_lead else None
+    )
 
     def _record_run_epoch(self, state, *a, **k):
+        # first call reads the INPUT state's round (a resumed fit starts
+        # past 0; the kill arm must key on genuinely-crossed rounds)
+        round_before = (
+            final["round"] if final["epoch"] else int(state.round)
+        )
         out = _orig_run_epoch(self, state, *a, **k)
         final["state"], final["trainer"] = out[0], self
+        # the GLOBAL fit epoch (run_epoch's third positional arg) — a
+        # restarted generation resumes at epoch k+1, and the sidecar meta
+        # must say so or consensus would compare local counts against the
+        # fold checkpoint's global epochs and roll the fleet back wrong
+        fit_epoch = a[1] if len(a) > 1 else k.get("epoch", 0)
+        final["epoch"] = int(fit_epoch)
+        final["round"] = int(out[0].round)
+        if heartbeat is not None:
+            heartbeat.beat(epoch=final["epoch"], round=final["round"])
+        if kill_round is not None and round_before <= kill_round < final["round"]:
+            # the chaos arm: die like a preempted slice ACTUALLY dies —
+            # abruptly, BEFORE this epoch's sidecar seals, so the
+            # supervisor must recover from the other slices' checkpoints
+            if flight is not None:
+                flight.note("kill-slice", slice=slice_id,
+                            round=final["round"])
+                flight.dump(f"kill-slice:{slice_id}@round{kill_round}")
+            os.kill(os.getpid(), signal.SIGKILL)
+        if my_ckpt_dir is not None:
+            _save_checkpoint(
+                os.path.join(my_ckpt_dir, "checkpoint_latest.msgpack"),
+                out[0],
+                meta={
+                    "round": final["round"], "epoch": final["epoch"],
+                    "slice": slice_id,
+                    "params_sha256": _params_checksum(out[0]),
+                },
+                rotate=True,
+            )
         return out
 
     loop_mod.FederatedTrainer.run_epoch = _record_run_epoch
@@ -182,10 +502,30 @@ def main(argv=None) -> int:
         split_ratio=(0.7, 0.15, 0.15), seed=0,
         num_slices=args.slices, dcn_wire_quant=args.dcn_wire_quant,
     ).with_overrides(_config_overrides(args.overrides))
-    runner = FedRunner(cfg, data_path=args.data_path, out_dir=args.out_dir)
+    runner = FedRunner(
+        cfg, data_path=args.data_path, out_dir=args.out_dir,
+        fault_plan=fault_plan,
+    )
     try:
-        res = runner.run(verbose=False)[0]
+        res = runner.run(verbose=False, resume=args.resume)[0]
+    except Preempted as p:
+        # cooperative preemption (SIGTERM during the fit / kill_at_round):
+        # the rotating checkpoint landed at the epoch boundary before this
+        # raise — dump the flight ring, tear the runtime down, exit with
+        # the documented 128+signum (75 for the deterministic arm)
+        if flight is not None:
+            flight.note("preempted", signum=p.signum, epoch=p.epoch,
+                        slice=slice_id)
+            flight.dump(
+                f"signal:{p.signum}" if p.signum else "kill_at_round"
+            )
+        if heartbeat is not None:
+            heartbeat.stop()
+        distributed_shutdown()
+        return p.exit_code
     except Exception as e:  # noqa: BLE001 — capability probe, see below
+        if heartbeat is not None:
+            heartbeat.stop()
         if "Multiprocess computations aren't implemented" in str(e):
             # this jaxlib's CPU backend cannot execute cross-process
             # collectives at all (e.g. 0.4.x): report "unsupported",
@@ -193,6 +533,10 @@ def main(argv=None) -> int:
             print(f"UNSUPPORTED: {e}", flush=True)
             distributed_shutdown()
             return UNSUPPORTED_RC
+        # any other failure still tears the runtime down first: a raise
+        # with the distributed client live would leave peers wedged in
+        # their next collective with nothing to surface it
+        distributed_shutdown()
         raise
 
     if args.report:
@@ -209,6 +553,8 @@ def main(argv=None) -> int:
             "mesh_shape": dict(runner.mesh.shape),
             "mesh_axes": list(runner.mesh.axis_names),
             "num_slices": args.slices,
+            "slice_id": slice_id,
+            "restart_generation": args.restart_generation,
             "epoch_losses": [float(x) for x in res["epoch_losses"]],
             "test_metrics": res["test_metrics"],
             "n_log_writes": writes["logs"],
@@ -230,6 +576,8 @@ def main(argv=None) -> int:
         with open(args.report, "w") as fh:
             json.dump(report, fh)
 
+    if heartbeat is not None:
+        heartbeat.stop()
     # clean teardown: leave the runtime re-entrant (the coordinated barrier
     # in shutdown also surfaces a wedged peer as a nonzero exit, instead of
     # letting a caller's timeout mask it)

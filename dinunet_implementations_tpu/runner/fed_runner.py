@@ -1128,6 +1128,10 @@ class FedDaemon:
                 for site, slot in sorted(self.table.members().items())
             },
             "num_slices": self.num_slices,
+            # r19 slice elasticity: the slice-quorum floor (trainer/steps.py
+            # holds rounds below it) — surfaced so an operator reading
+            # /statusz sees WHY rounds are holding under slice faults
+            "min_slices": self.cfg.min_slices,
             "slice_occupancy": self.table.slice_occupancy(self.num_slices),
             "membership_epoch": self.table.epoch,
             "steps": self._steps,

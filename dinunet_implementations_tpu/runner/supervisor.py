@@ -1,0 +1,501 @@
+"""Slice-tier worker supervision (r19) — preemption-tolerant multi-slice runs.
+
+At multi-slice scale, a preempted slice or a crashed/wedged worker process is
+the NORMAL failure mode, not the exception (PAPERS.md: the TPUv4 pjit
+playbook treats slice restarts as routine). This module is the host-side
+machinery that turns "one dead ``dcn_worker`` kills the run" into "the run
+completes":
+
+- :class:`Heartbeat` — each worker process writes an atomic JSON heartbeat
+  (pid, slice, epoch/round progress) every ``interval_s`` from a daemon
+  thread; :func:`heartbeat_age_s` is the supervisor's staleness probe.
+- **Liveness spool** (:func:`mark_slice_dead` / :func:`mark_slice_alive` /
+  :func:`read_slice_liveness`) — an append-only event directory recording
+  every slice death (reason, last heartbeat age, restart generation) and
+  revival. The shared, machine-readable record of slice churn: the flight
+  recorder notes the same events, the spool survives the supervisor itself.
+- **Cross-slice checkpoint consensus** (:func:`consensus_round`) — every
+  supervised worker rotates a per-slice checkpoint sidecar whose meta
+  carries ``(round, params_sha256)`` (runner/dcn_worker.py). After a slice
+  death the supervisor picks the NEWEST round at which all surviving
+  slices' candidates (latest AND ``.prev`` — a torn primary falls back per
+  the PR 2 contract) agree by params digest, and installs that generation
+  as the fleet's resume point. Params are replicated by the aggregation
+  collectives, so digest agreement at a round means the fleet state is ONE
+  state — the restarted slice rejoins the run mid-flight by plain
+  ``--resume``, bit-exact with a run that never faulted.
+- :class:`SliceSupervisor` — the restart state machine: LAUNCH the
+  per-slice workers → MONITOR exits and heartbeat staleness (the staleness
+  verdict runs under :func:`~..robustness.retry.with_retry` deadline
+  semantics, so one slow NFS stat never declares a slice dead) → on death,
+  DRAIN the survivors (SIGTERM → they checkpoint and exit ``128+15`` via
+  the PreemptionGuard; SIGKILL after a grace window for workers wedged in
+  a collective — a dead peer leaves the others blocked in the DCN reduce
+  forever, which is exactly why the supervisor exists) → CONSENSUS →
+  RELAUNCH with ``--resume`` until the run completes or ``max_restarts``
+  is exhausted.
+
+jax.distributed cannot (today) shrink or regrow a live process group, so
+the restart unit is the worker FLEET, not the single process: the run
+degrades to checkpoint granularity on a fault, never to zero. That is the
+same recovery contract real multi-slice TPU training uses.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import threading
+import time
+
+from ..robustness.retry import with_retry
+
+#: supervisor exit code: a slice kept dying past max_restarts
+SUPERVISOR_GAVE_UP_RC = 69
+
+HEARTBEAT_DIR = "heartbeats"
+LIVENESS_DIR = "slice_liveness"
+SLICE_CKPT_DIR = "slices"
+
+
+def _atomic_json(path: str, payload: dict) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh)
+    os.replace(tmp, path)
+
+
+# ---------------------------------------------------------------------------
+# heartbeats
+# ---------------------------------------------------------------------------
+
+
+def heartbeat_path(out_dir: str, slice_id: int) -> str:
+    return os.path.join(out_dir, HEARTBEAT_DIR, f"slice_{slice_id}.json")
+
+
+class Heartbeat:
+    """A worker's liveness pulse: an atomically-replaced JSON file carrying
+    pid / slice / wall-clock plus whatever progress the worker last noted
+    (epoch, global round). The pulse rides a daemon TIMER thread, so
+    staleness means the process is hard-frozen (SIGSTOP, scheduler
+    starvation) or its out_dir writes block (dead shared mount) — not
+    merely slow. A worker wedged in a collective whose peer died keeps
+    beating; THAT failure mode is recovered through the peer's observable
+    exit + the supervisor's drain, and the heartbeat is the backstop for
+    deaths with no exit to observe. One writer per slice (the slice-lead
+    rank, runner/dcn_worker.py) keeps the file's semantics crisp."""
+
+    def __init__(self, path: str, slice_id: int, interval_s: float = 2.0):
+        self.path = path
+        self.slice_id = slice_id
+        self.interval_s = interval_s
+        self._extra: dict = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def beat(self, **extra) -> None:
+        """Write one pulse now; ``extra`` (epoch/round progress) persists
+        into subsequent background pulses."""
+        if extra:
+            self._extra.update(extra)
+        try:
+            _atomic_json(self.path, {
+                "pid": os.getpid(),
+                "slice": self.slice_id,
+                "time_unix": time.time(),
+                **self._extra,
+            })
+        except OSError:
+            pass  # a full disk must not kill the worker it monitors
+
+    def start(self) -> "Heartbeat":
+        self.beat()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"heartbeat-slice{self.slice_id}",
+        )
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.beat()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval_s + 1.0)
+            self._thread = None
+
+
+def read_heartbeat(path: str) -> dict | None:
+    """The last pulse, or None (unreadable/missing — a beat may be mid-
+    replace, which os.replace makes atomic, so unreadable means absent)."""
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def heartbeat_age_s(path: str, now: float | None = None) -> float | None:
+    """Seconds since the last pulse, or None when no pulse exists yet."""
+    hb = read_heartbeat(path)
+    if hb is None or "time_unix" not in hb:
+        return None
+    return max((now if now is not None else time.time()) - hb["time_unix"], 0.0)
+
+
+# ---------------------------------------------------------------------------
+# the shared slice-liveness spool
+# ---------------------------------------------------------------------------
+
+
+def _spool_event(liveness_dir: str, event: dict) -> str:
+    os.makedirs(liveness_dir, exist_ok=True)
+    event = {"time_unix": time.time(), **event}
+    # monotonic sequence names keep sorted-order == event order, the same
+    # convention as the daemon's ingest spool (runner/fed_runner.py)
+    seq = len([n for n in os.listdir(liveness_dir) if n.endswith(".json")])
+    path = os.path.join(
+        liveness_dir, f"ev{seq:06d}_slice{event.get('slice', 'x')}.json"
+    )
+    _atomic_json(path, event)
+    return path
+
+
+def mark_slice_dead(liveness_dir: str, slice_id: int, reason: str,
+                    heartbeat_age: float | None = None,
+                    generation: int = 0) -> str:
+    """Record a slice death in the shared liveness spool. Returns the event
+    path."""
+    return _spool_event(liveness_dir, {
+        "event": "dead", "slice": int(slice_id), "reason": reason,
+        "heartbeat_age_s": heartbeat_age, "generation": int(generation),
+    })
+
+
+def mark_slice_alive(liveness_dir: str, slice_id: int,
+                     generation: int) -> str:
+    """Record a slice revival (supervised restart, generation bumped)."""
+    return _spool_event(liveness_dir, {
+        "event": "alive", "slice": int(slice_id),
+        "generation": int(generation),
+    })
+
+
+def read_slice_liveness(liveness_dir: str) -> list:
+    """Every liveness event, oldest first (sorted-name order)."""
+    try:
+        names = sorted(
+            n for n in os.listdir(liveness_dir) if n.endswith(".json")
+        )
+    except OSError:
+        return []
+    out = []
+    for n in names:
+        try:
+            with open(os.path.join(liveness_dir, n)) as fh:
+                out.append(json.load(fh))
+        except (OSError, json.JSONDecodeError):
+            continue
+    return out
+
+
+# ---------------------------------------------------------------------------
+# cross-slice checkpoint consensus
+# ---------------------------------------------------------------------------
+
+
+def slice_ckpt_dir(out_dir: str, slice_id: int) -> str:
+    return os.path.join(out_dir, SLICE_CKPT_DIR, f"slice_{slice_id}")
+
+
+def slice_ckpt_candidates(ckpt_dir: str) -> dict:
+    """``{round: (sha, path)}`` from one slice's rotating checkpoint pair —
+    latest AND ``.prev`` are SEPARATE candidates (a torn primary's round
+    must not shadow the intact previous generation)."""
+    from ..trainer.checkpoint import CorruptCheckpointError, load_meta
+
+    path = os.path.join(ckpt_dir, "checkpoint_latest.msgpack")
+    out: dict = {}
+    for cand in (path + ".prev", path):  # latest last: it wins ties
+        if not os.path.exists(cand):
+            continue
+        try:
+            meta = load_meta(cand, fallback=False)
+        except (OSError, CorruptCheckpointError):
+            continue  # torn/corrupt generation: not a candidate
+        rnd, sha = meta.get("round"), meta.get("params_sha256")
+        if rnd is None or not sha:
+            continue
+        out[int(rnd)] = (sha, cand)
+    return out
+
+
+def consensus_round(slice_dirs: dict) -> tuple | None:
+    """The newest global round at which EVERY surviving slice holds a
+    rotating checkpoint candidate with the SAME params digest.
+
+    ``slice_dirs`` maps slice id → its sidecar checkpoint dir. Returns
+    ``(round, sha, path)`` — ``path`` is one of the agreed checkpoint files
+    (they are bit-identical by digest, so any serves as the fleet's resume
+    point) — or None when no common agreed round exists (the fleet then
+    restarts from whatever the shared fold checkpoint holds, or from
+    scratch)."""
+    per_slice = {
+        sl: slice_ckpt_candidates(d) for sl, d in slice_dirs.items()
+    }
+    if not per_slice or any(not c for c in per_slice.values()):
+        return None
+    common = None
+    for cands in per_slice.values():
+        rounds = set(cands)
+        common = rounds if common is None else (common & rounds)
+    agreed = []
+    for rnd in sorted(common or (), reverse=True):
+        shas = {cands[rnd][0] for cands in per_slice.values()}
+        if len(shas) == 1:
+            sha = shas.pop()
+            path = next(iter(per_slice.values()))[rnd][1]
+            agreed.append((rnd, sha, path))
+            break
+    return agreed[0] if agreed else None
+
+
+# ---------------------------------------------------------------------------
+# the supervisor state machine
+# ---------------------------------------------------------------------------
+
+
+class SliceSupervisor:
+    """Launch, monitor, and restart a fleet of per-slice worker processes
+    (module docstring: the restart unit is the fleet; recovery granularity
+    is the consensus checkpoint).
+
+    ``spawn(process_id, generation)`` returns a started
+    ``subprocess.Popen`` for one worker — the supervisor owns nothing about
+    the worker's command line, which keeps the state machine unit-testable
+    with stub scripts (tests/test_supervisor.py) and reusable by the real
+    ``dcn_worker --supervise`` entry. ``on_consensus(generation,
+    dead_slice)`` (optional) runs between drain and relaunch — the real
+    entry installs the consensus checkpoint as the fleet resume point
+    there. ``passthrough_rcs`` exit
+    codes (e.g. the rc-66 capability skip) propagate immediately instead of
+    counting as a slice death."""
+
+    def __init__(
+        self,
+        spawn,
+        num_processes: int,
+        out_dir: str,
+        slice_of_process=None,
+        heartbeat_timeout_s: float = 30.0,
+        max_restarts: int = 2,
+        poll_s: float = 0.5,
+        grace_s: float = 20.0,
+        flight=None,
+        bus=None,
+        on_consensus=None,
+        passthrough_rcs: tuple = (),
+    ):
+        self.spawn = spawn
+        self.num_processes = num_processes
+        self.out_dir = out_dir
+        self.slice_of_process = slice_of_process or (lambda pid_: pid_)
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.max_restarts = max_restarts
+        self.poll_s = poll_s
+        self.grace_s = grace_s
+        self.flight = flight
+        self.bus = bus
+        self.on_consensus = on_consensus
+        self.passthrough_rcs = tuple(passthrough_rcs)
+        self.generation = 0
+        self.restarts = 0
+        self.liveness_dir = os.path.join(out_dir, LIVENESS_DIR)
+
+    # -- probes ------------------------------------------------------------
+
+    def _note(self, name: str, **attrs) -> None:
+        if self.flight is not None:
+            self.flight.note(name, **attrs)
+
+    def _count(self, name: str, **labels) -> None:
+        if self.bus is not None:
+            # API-boundary forward: NAME is a literal at every call site
+            self.bus.counter(name, **labels)  # jaxlint: disable=R007
+
+    def _stale_verdict(self, slice_id: int) -> float | None:
+        """Heartbeat-staleness verdict for one slice, under with_retry
+        DEADLINE semantics: a missing/old pulse is re-probed with backoff
+        until the staleness budget is spent — one slow shared-FS stat (or
+        a beat landing mid-probe) never declares a live slice dead. Returns
+        the final heartbeat age when the slice is STALE past the deadline,
+        None when a fresh pulse appeared."""
+        path = heartbeat_path(self.out_dir, slice_id)
+
+        class _Stale(OSError):
+            pass
+
+        def probe():
+            age = heartbeat_age_s(path)
+            if age is None or age > self.heartbeat_timeout_s:
+                raise _Stale(f"heartbeat age {age}")
+            return age
+
+        try:
+            with_retry(
+                probe, attempts=8, base_delay=0.25,
+                retry_on=(_Stale,),
+                deadline_s=self.heartbeat_timeout_s,
+                describe=f"slice {slice_id} heartbeat",
+            )()
+            return None
+        except _Stale:
+            return heartbeat_age_s(path)
+
+    # -- fleet control -----------------------------------------------------
+
+    def _launch(self) -> list:
+        self.generation += 1
+        # clear the previous generation's heartbeats: a restarted worker
+        # needs its jax-import warmup before the first pulse, and a stale
+        # file from the DEAD generation would otherwise get the fresh
+        # fleet judged wedged during startup (age None = not stale)
+        hb_dir = os.path.join(self.out_dir, HEARTBEAT_DIR)
+        try:
+            for name in os.listdir(hb_dir):
+                os.remove(os.path.join(hb_dir, name))
+        except OSError:
+            pass
+        procs = []
+        for r in range(self.num_processes):
+            procs.append(self.spawn(r, self.generation))
+        self._note("fleet-launch", generation=self.generation,
+                   processes=self.num_processes)
+        return procs
+
+    def _drain(self, procs: list, skip: int | None = None) -> None:
+        """SIGTERM the surviving workers (they checkpoint and exit via the
+        PreemptionGuard), escalating to SIGKILL after the grace window —
+        a worker wedged in a collective whose peer died never reaches its
+        epoch-boundary signal poll, and waiting on it would wedge the
+        supervisor too."""
+        for i, p in enumerate(procs):
+            if i == skip or p.poll() is not None:
+                continue
+            try:
+                p.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        deadline = time.monotonic() + self.grace_s
+        for i, p in enumerate(procs):
+            if i == skip:
+                continue
+            try:
+                p.wait(timeout=max(deadline - time.monotonic(), 0.1))
+            except subprocess.TimeoutExpired:
+                self._note("worker-wedged", process=i,
+                           generation=self.generation)
+                p.kill()
+                p.wait()
+
+    def _slice_death(self, procs: list, process_id: int, reason: str,
+                     hb_age: float | None) -> None:
+        slice_id = self.slice_of_process(process_id)
+        # the flight dump's reason carries slice id + last heartbeat age —
+        # the post-mortem an operator reads first
+        self._note("slice-death", slice=slice_id, process=process_id,
+                   reason=reason, heartbeat_age_s=hb_age,
+                   generation=self.generation)
+        self._count("supervisor_slice_deaths_total", slice=str(slice_id))
+        if "heartbeat" in reason:
+            self._count("dcn_heartbeat_timeouts_total", slice=str(slice_id))
+        mark_slice_dead(
+            self.liveness_dir, slice_id, reason,
+            heartbeat_age=hb_age, generation=self.generation,
+        )
+        if self.flight is not None:
+            age = "none" if hb_age is None else f"{hb_age:.1f}s"
+            self.flight.dump(
+                f"slice-death:slice={slice_id}:hb_age={age}:{reason}"
+            )
+        self._drain(procs, skip=process_id)
+
+    def run(self) -> int:
+        """The supervise loop. Returns the fleet's exit code: 0 on a
+        completed run, a passthrough rc verbatim (capability skips), the
+        first worker's failing rc when restarts are exhausted (signal
+        deaths mapped to the shell's ``128+signum``), or
+        :data:`SUPERVISOR_GAVE_UP_RC` when a slice keeps dying."""
+        while True:
+            procs = self._launch()
+            death: tuple | None = None  # (process_id, reason, hb_age)
+            while death is None:
+                states = [p.poll() for p in procs]
+                if all(rc == 0 for rc in states):
+                    self._note("fleet-complete", generation=self.generation)
+                    return 0
+                for r, rc in enumerate(states):
+                    if rc is None or rc == 0:
+                        continue
+                    if rc in self.passthrough_rcs:
+                        # capability skip (rc 66): not a fault — drain and
+                        # propagate so CI skips instead of restarting
+                        self._drain(procs, skip=r)
+                        return rc
+                    sig = f" (signal {-rc})" if rc < 0 else ""
+                    death = (r, f"exit rc={rc}{sig}", heartbeat_age_s(
+                        heartbeat_path(self.out_dir,
+                                       self.slice_of_process(r))))
+                    break
+                if death is not None:
+                    break
+                # exits clean so far: probe heartbeats of the still-running
+                # workers for wedge detection
+                for r, rc in enumerate(states):
+                    if rc is not None:
+                        continue
+                    path = heartbeat_path(
+                        self.out_dir, self.slice_of_process(r)
+                    )
+                    age = heartbeat_age_s(path)
+                    if age is not None and age > self.heartbeat_timeout_s:
+                        # suspicious: confirm under the retry deadline
+                        # before killing a live worker
+                        stale = self._stale_verdict(self.slice_of_process(r))
+                        if stale is not None and procs[r].poll() is None:
+                            procs[r].kill()
+                            procs[r].wait()
+                            death = (r, "heartbeat stale", stale)
+                            break
+                if death is None:
+                    time.sleep(self.poll_s)
+            process_id, reason, hb_age = death
+            self._slice_death(procs, process_id, reason, hb_age)
+            self.restarts += 1
+            if self.restarts > self.max_restarts:
+                self._note("supervisor-give-up", restarts=self.restarts)
+                rc = procs[process_id].poll()
+                if rc is None or rc == 0:
+                    return SUPERVISOR_GAVE_UP_RC
+                # Popen reports signal deaths as -signum; sys.exit would
+                # wrap that mod 256 into an undocumented status (e.g. 247)
+                # — map to the shell's 128+signum convention instead
+                return 128 - rc if rc < 0 else rc
+            if self.on_consensus is not None:
+                self.on_consensus(
+                    self.generation, self.slice_of_process(process_id)
+                )
+            mark_slice_alive(
+                self.liveness_dir, self.slice_of_process(process_id),
+                self.generation + 1,
+            )
+            self._count("supervisor_restarts_total")
+            self._note("fleet-restart", generation=self.generation + 1,
+                       after_slice=self.slice_of_process(process_id))

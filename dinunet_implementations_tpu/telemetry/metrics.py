@@ -59,12 +59,18 @@ TELEMETRY_KEYS = (
     "grad_sq_last",
     "grad_sq_max",
     "grad_sq_sum",
+    "held_rounds",
     "payload_bytes",
     "residual_sq_sum",
     "rounds",
     "update_sq_last",
     "update_sq_sum",
 )
+
+#: integer accumulators (the rest are f32). ``held_rounds`` (r19) counts
+#: rounds the slice-quorum floor declined to train — frozen params/opt,
+#: NaN loss (trainer/steps.py); 0 everywhere quorum machinery is off.
+_INT_KEYS = ("rounds", "held_rounds")
 
 
 def default_round_telemetry(num_sites: int) -> dict:
@@ -76,7 +82,7 @@ def default_round_telemetry(num_sites: int) -> dict:
     # distinct arrays per key (not one shared buffer): the epoch program
     # donates the carried state and XLA rejects twice-donated buffers
     return {
-        k: (jnp.zeros((num_sites,), jnp.int32) if k == "rounds"
+        k: (jnp.zeros((num_sites,), jnp.int32) if k in _INT_KEYS
             else jnp.zeros((num_sites,), jnp.float32))
         for k in TELEMETRY_KEYS
     }
@@ -241,4 +247,9 @@ def telemetry_summary(telemetry) -> dict | None:
             float(t["dcn_bytes"][0] / rounds[0]) if "dcn_bytes" in t else 0.0
         ),
         "rounds": int(t["rounds"][0]),
+        # r19 slice elasticity: rounds the slice-quorum floor held back
+        # (0 on pre-r19 accumulators and whenever quorum machinery is off)
+        "held_rounds": (
+            int(t["held_rounds"][0]) if "held_rounds" in t else 0
+        ),
     }

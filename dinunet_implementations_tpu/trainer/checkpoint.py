@@ -268,14 +268,18 @@ def load_checkpoint(path: str, like: TrainState, with_meta: bool = False,
     return state
 
 
-def load_meta(path: str) -> dict:
+def load_meta(path: str, fallback: bool = True) -> dict:
     """The embedded (atomically-paired) meta of a checkpoint, readable
     WITHOUT a state template — the daemon-mode runner reads the membership
     table from here before it can even build a state (the table says which
     sites' data to admit, and the data defines the state's shapes). Falls
     back to ``.prev`` like :func:`load_checkpoint`, so a kill inside the
-    rotate window still yields a paired (state, meta) generation."""
-    raw = _load_raw(path)
+    rotate window still yields a paired (state, meta) generation.
+    ``fallback=False`` reads EXACTLY the named generation (the cross-slice
+    checkpoint-consensus scan, runner/supervisor.py, inspects latest and
+    ``.prev`` as SEPARATE candidates — automatic fallback would silently
+    collapse them into one)."""
+    raw = _load_raw(path, fallback=fallback)
     meta = raw.get("meta_json")
     if isinstance(meta, bytes):
         meta = meta.decode()

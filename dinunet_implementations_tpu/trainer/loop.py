@@ -161,6 +161,7 @@ class FederatedTrainer:
             robust_agg=cfg.robust_agg,
             reputation_z=cfg.reputation_z,
             reputation_rounds=cfg.reputation_rounds,
+            min_slices=cfg.min_slices,
         )
         self.eval_fn = make_eval_fn(self.task, mesh)
         self._inventory = None  # device-resident site inventory, one per fit
@@ -344,10 +345,49 @@ class FederatedTrainer:
             attack = attack_window(
                 self.attack_plan, plan.num_sites, round0, rounds
             )
+            # slice-tier faults (r19): the [num_slices, rounds] whole-slice
+            # mask for this window — None off sliced meshes / slice-clean
+            # plans, so the r18 program is untouched (S005)
+            slice_live = self._slice_window(round0, rounds)
             from ..parallel.distributed import put_epoch_plan
 
             return put_epoch_plan(
-                self.mesh, plan.positions, live, poison, attack
+                self.mesh, plan.positions, live, poison, attack, slice_live
+            )
+
+    def _slice_window(self, round0: int, rounds: int):
+        """The FaultPlan's slice-liveness window for this epoch (r19,
+        robustness/faults.py): ``[num_slices, rounds]`` or None. Kills are
+        rendered into the mask only on single-process emulation — under the
+        supervised multi-process runner they are REAL process deaths
+        (runner/dcn_worker.py), and masking them too would keep a restarted
+        slice dead forever."""
+        from ..parallel.mesh import slice_count
+
+        n_sl = slice_count(self.mesh)
+        if n_sl <= 1 or self.fault_plan is None:
+            return None
+        from ..parallel.distributed import spans_processes
+        from ..robustness.faults import slice_fault_window
+
+        return slice_fault_window(
+            self.fault_plan, n_sl, round0, rounds,
+            include_kills=not spans_processes(self.mesh),
+        )
+
+    def _publish_slice_liveness(self, slice_live) -> None:
+        """Per-slice liveness gauges for the live bus (r19): how many of
+        this epoch's rounds each slice is scheduled live — the /statusz
+        surface for "which slice is the chaos plan (or a supervisor-marked
+        death) taking out". Host-side values, no device sync of
+        consequence (the mask is tiny and replicated)."""
+        if slice_live is None or not self._telemetry_on:
+            return
+        rows = np.asarray(slice_live)
+        for sl_i in range(rows.shape[0]):
+            self.bus.gauge(
+                "train_slice_live_rounds", float(rows[sl_i].sum()),
+                slice=str(sl_i),
             )
 
     def _membership_live(self, live, num_sites: int, rounds: int):
@@ -375,15 +415,16 @@ class FederatedTrainer:
                     train_sites, epoch, batch_size or self.cfg.batch_size,
                     round0=int(state.round),
                 )
-            idx, live, poison, attack = plan
+            idx, live, poison, attack, slice_live = plan
             inv_x, inv_y = self._ensure_inventory(train_sites)
             # the device pipeline's ENTIRE per-epoch host→device traffic
             self._last_transfer_bytes = int(sum(
-                a.nbytes for a in (idx, live, poison, attack)
+                a.nbytes for a in (idx, live, poison, attack, slice_live)
                 if a is not None
             ))
+            self._publish_slice_liveness(slice_live)
             state, losses = self.epoch_fn(
-                state, inv_x, inv_y, idx, live, poison, attack
+                state, inv_x, inv_y, idx, live, poison, attack, slice_live
             )
             return state, np.asarray(losses)
         fb = plan_epoch(
@@ -430,14 +471,28 @@ class FederatedTrainer:
                 self.attack_plan, fb.num_sites, int(state.round),
                 fb.steps // max(self.cfg.local_iterations, 1),
             )
+        # slice-tier faults (r19): the whole-slice mask, windowed on the
+        # same global round counter as the site mask
+        slice_live = self._slice_window(
+            int(state.round), fb.steps // max(self.cfg.local_iterations, 1)
+        ) if self.fault_plan is not None else None
         batch = self._put_batch(fb)
         live_dev = self._put_live(live)
         attack_dev = self._put_live(attack)
+        slice_dev = None
+        if slice_live is not None:
+            from ..parallel.distributed import put_replicated
+
+            slice_dev = put_replicated(self.mesh, slice_live)
         self._last_transfer_bytes = int(
             sum(a.nbytes for a in batch)
-            + sum(a.nbytes for a in (live_dev, attack_dev) if a is not None)
+            + sum(a.nbytes for a in (live_dev, attack_dev, slice_dev)
+                  if a is not None)
         )
-        state, losses = self.epoch_fn(state, *batch, live_dev, attack_dev)
+        self._publish_slice_liveness(slice_live)
+        state, losses = self.epoch_fn(
+            state, *batch, live_dev, attack_dev, slice_dev
+        )
         return state, np.asarray(losses)
 
     @staticmethod
@@ -1095,13 +1150,16 @@ class FederatedTrainer:
                 # far — 0.0 on single-slice runs
                 dcn_bytes=float(t.get("dcn_bytes", [0.0])[0]),
                 rounds=int(t["rounds"][0]),
+                # slice-quorum holds (r19): rounds the min_slices floor
+                # declined so far — 0 off sliced/fault-free runs
+                held_rounds=int(t.get("held_rounds", [0])[0]),
             )
         else:  # epoch rows keep one schema even if metrics are absent
             row.update(
                 site_grad_sq_last=[], site_grad_sq_sum=[],
                 site_grad_sq_max=[], site_residual_sq_sum=[],
                 update_sq_last=0.0, payload_bytes=0.0, dcn_bytes=0.0,
-                rounds=0,
+                rounds=0, held_rounds=0,
             )
         self._fit_tel.append(row)
 

@@ -301,6 +301,7 @@ def make_train_epoch_fn(
     robust_agg: str = "none",
     reputation_z: float = 2.0,
     reputation_rounds: int = 8,
+    min_slices: int = 1,
 ):
     """Build the jitted epoch function.
 
@@ -425,6 +426,27 @@ def make_train_epoch_fn(
     legacy program (S005 "robust-off"); the mask input is rejected unless
     an attack plan was given.
 
+    Slice elasticity (r19 — robustness/faults.py slice windows): on a
+    sliced mesh the epoch accepts an optional ``slice_live [num_slices,
+    rounds]`` TRACED input (replicated — it is tiny), the whole-slice twin
+    of ``live``. Each round, every member multiplies its own slice's gate
+    into the site-level contribute mask, so a dead slice's members are
+    excluded from every engine's aggregate, sync-BN, the round loss and
+    the weight renormalization EXACTLY as if the mask had zeroed its sites
+    outright — bit-identical params, per engine, packed and unpacked
+    (tests/test_multislice.py pins it). ``min_slices`` is the slice-quorum
+    floor: a round with fewer live slices HOLDS — params, optimizer,
+    engine state, health, buffers, stats and the overlap stash all freeze,
+    the loss reports NaN, and (telemetry on) the per-site ``held_rounds``
+    accumulator counts it — rather than training on a rump cohort. The
+    quorum count is a local reduction of the replicated mask, so slice
+    faults add ZERO collectives to the program (the wire proofs — S002 —
+    hold unchanged on slice-fault cells). ``slice_live=None`` compiles the
+    exact r18 program (S005 "slicefaults-off"), and since ``×1.0`` is
+    exact an all-slices-live mask reproduces it value-for-value; changing
+    WHICH slices die WHEN never retraces. The mask is rejected on unsliced
+    topologies (there is no slice tier to fault).
+
     Site-axis realization (all forms run the *same* per-site program):
 
     - ``mesh`` given → ``shard_map`` over the mesh's ``site`` axis, with
@@ -491,6 +513,18 @@ def make_train_epoch_fn(
         from ..robustness.attacks import make_attack_fn
 
         atk = make_attack_fn(attack_plan)
+    if min_slices < 1:
+        raise ValueError(f"min_slices must be >= 1, got {min_slices}")
+    if min_slices > 1 and not sliced:
+        raise ValueError(
+            f"min_slices={min_slices} needs a sliced mesh (num_slices > 1) "
+            "— there is no slice quorum on a single-slice topology"
+        )
+    if min_slices > 1 and min_slices > n_slices:
+        raise ValueError(
+            f"min_slices={min_slices} exceeds the mesh's {n_slices} slices "
+            "— every round would hold"
+        )
     if overlap and buffered:
         raise ValueError(
             "overlap_rounds and staleness_bound > 0 are mutually exclusive: "
@@ -520,7 +554,7 @@ def make_train_epoch_fn(
 
     def epoch_over_sites(state: TrainState, x, y, w, live, site_axes,
                          inner_axis, inventory=None, poison=None,
-                         attack=None):
+                         attack=None, slice_live=None):
         """Run one epoch for the k in-device sites in ``x [k, steps, B, ...]``.
 
         Device pipeline (``inventory`` given): ``x`` is the ``[k, steps, B]``
@@ -610,6 +644,40 @@ def make_train_epoch_fn(
             else attack[:, :rounds].astype(jnp.int32)
         )
         attack_on = attack_rounds is not None
+        # slice-liveness gate (r19, robustness/faults.py slice windows): the
+        # [num_slices, rounds] whole-slice mask arrives REPLICATED (it is
+        # tiny); each member reads its OWN slice's row by axis index — no
+        # collective — and multiplies it into the per-round site gate, so a
+        # dead slice's members mask out exactly like site-level drops. The
+        # per-round live-slice count (a local reduction of the replicated
+        # mask, again no collective) drives the min_slices quorum hold.
+        # Trace-time presence branch like `live`: slice_live=None compiles
+        # the exact r18 program, and changing WHO dies WHEN never retraces.
+        if slice_live is not None and not sliced:
+            raise ValueError(
+                "a slice_live mask was fed on an unsliced topology — slice "
+                "faults need a (slice, site, model) mesh "
+                "(TrainConfig.num_slices > 1)"
+            )
+        if slice_live is not None and slice_live.shape[0] != n_slices:
+            # a wrong-row-count mask would otherwise be silently accepted:
+            # XLA clamps the out-of-bounds own-row gather, so extra slices
+            # would inherit the LAST row's liveness instead of erroring
+            raise ValueError(
+                f"slice_live has {slice_live.shape[0]} slice rows but the "
+                f"mesh has {n_slices} slices"
+            )
+        slice_gate = slice_live is not None
+        # quorum machinery exists iff a floor above 1 is configured AND the
+        # mask is fed — min_slices with no mask adds nothing (S005
+        # "slicefaults-off")
+        quorum_on = slice_gate and min_slices > 1
+        sl_own_rounds = quorum_rounds = None
+        if slice_gate:
+            sl_full = slice_live[:, :rounds].astype(jnp.float32)
+            sl_own_rounds = sl_full[jax.lax.axis_index(SLICE_AXIS)]
+            if quorum_on:
+                quorum_rounds = jnp.sum(sl_full, axis=0)
         # trace-time static gate: the fault machinery (isfinite reduction over
         # the gradient tree, where-freezes/selects on engine state, params,
         # opt state, BN stats) compiles in only when quarantine is enabled OR
@@ -622,7 +690,7 @@ def make_train_epoch_fn(
         # does an attack mask (an attacked round must be skippable/scorable)
         guard = (
             quarantine_rounds >= 0 or live is not None or buffered or overlap
-            or reputation or attack_on
+            or reputation or attack_on or slice_gate
         )
         health = state.health  # filled by epoch_fn before any shard_map
         # trace-time static: telemetry accumulators exist iff the epoch was
@@ -667,6 +735,9 @@ def make_train_epoch_fn(
                 "grad_sq_last": gsq,
                 "grad_sq_max": jnp.maximum(ts["grad_sq_max"], gsq_f),
                 "grad_sq_sum": ts["grad_sq_sum"] + gsq_f,
+                # held rounds are counted at the quorum-hold select in
+                # one_round (this whole update reverts on a held round)
+                "held_rounds": ts["held_rounds"],
                 "payload_bytes": ts["payload_bytes"] + wire_b,
                 "residual_sq_sum": ts["residual_sq_sum"]
                 + jnp.where(jnp.isfinite(rsq), rsq, 0.0),
@@ -693,6 +764,8 @@ def make_train_epoch_fn(
                     else jnp.ones((k,), jnp.float32)
                 )
                 ab = parts.pop(0) if attack_on else None
+                sl_t = parts.pop(0) if slice_gate else None
+                q_t = parts.pop(0) if quorum_on else None
             else:
                 xb, yb, wb = (
                     jax.lax.dynamic_index_in_dim(a, xs, axis=1, keepdims=False)
@@ -708,6 +781,30 @@ def make_train_epoch_fn(
                     jax.lax.dynamic_index_in_dim(
                         attack_rounds, xs, axis=1, keepdims=False
                     ) if attack_on else None
+                )
+                sl_t = (
+                    jax.lax.dynamic_index_in_dim(
+                        sl_own_rounds, xs, axis=0, keepdims=False
+                    ) if slice_gate else None
+                )
+                q_t = (
+                    jax.lax.dynamic_index_in_dim(
+                        quorum_rounds, xs, axis=0, keepdims=False
+                    ) if quorum_on else None
+                )
+            if slice_gate:
+                # a dead slice == its sites dead: ×1.0 is exact, ×0 masks —
+                # everything downstream (engine aggregate, sync-BN, loss,
+                # weight renormalization) then excludes the slice exactly
+                # like a site-level mask zeroing its band
+                lb = lb * sl_t
+            if quorum_on:
+                # the quorum HOLD gate, decided before any compute: the
+                # round's results are computed and then select-reverted —
+                # branchless, so any slice-fault pattern is one program
+                held = q_t < jnp.float32(min_slices)
+                hold_prev = (
+                    batch_stats, engine_state, health, telem_st, buffers, ov,
                 )
             if overlap:
                 # overlapped rounds: tie the stashed (previous-round) payload
@@ -1242,6 +1339,36 @@ def make_train_epoch_fn(
                 batch_stats = jax.tree.map(lambda a: a[0], stats_k)
                 loss_round = loss_k[0]
                 total_live = tl_k[0] if guard else None
+            if quorum_on:
+                # slice-quorum HOLD (r19): below min_slices live slices the
+                # round never happened — every carried piece reverts to its
+                # pre-round value (params/opt freeze through the zeroed
+                # total_live below), the loss reports NaN like an all-dead
+                # round, and the per-site held_rounds accumulator counts it
+                def _hold(new, old):
+                    return jax.tree.map(
+                        lambda n, o: jnp.where(held, o, n), new, old
+                    )
+
+                st0, es0, hs0, ts0, bf0, ov0 = hold_prev
+                batch_stats = _hold(batch_stats, st0)
+                engine_state = _hold(engine_state, es0)
+                health = _hold(health, hs0)
+                if telem_k is not None:
+                    telem_k = _hold(telem_k, ts0)
+                    telem_k = {
+                        **telem_k,
+                        "held_rounds": telem_k["held_rounds"]
+                        + held.astype(jnp.int32),
+                    }
+                if buffers is not None:
+                    buffers = _hold(buffers, bf0)
+                if ov is not None:
+                    ov = _hold(ov, ov0)
+                loss_round = jnp.where(held, jnp.nan, loss_round)
+                total_live = jnp.where(
+                    held, jnp.zeros_like(total_live), total_live
+                )
             updates, new_opt_state = optimizer.update(agg, opt_state, params)
             new_params = optax.apply_updates(params, updates)
             if guard:
@@ -1317,6 +1444,12 @@ def make_train_epoch_fn(
                 xs = xs + (jnp.moveaxis(live_rounds, 1, 0),)
             if attack_rounds is not None:
                 xs = xs + (jnp.moveaxis(attack_rounds, 1, 0),)
+            if slice_gate:
+                # own-slice gate + (quorum on) live-slice count, one scalar
+                # each per round — already rounds-leading
+                xs = xs + (sl_own_rounds,)
+                if quorum_on:
+                    xs = xs + (quorum_rounds,)
         else:
             xs = jnp.arange(rounds)
         (params, stats, opt_state, engine_state, health, telem_out, buf_out,
@@ -1428,16 +1561,23 @@ def make_train_epoch_fn(
     if pipeline == "device" and mesh is not None:
 
         def epoch_fn_impl(state: TrainState, inv_x, inv_y, idx, live=None,
-                          poison=None, attack=None):
+                          poison=None, attack=None, slice_live=None):
             state = _ensure_health(state, idx)
             specs = _state_specs(state, site_part)
-            # optional traced inputs (liveness / NaN gate / attack codes):
-            # trace-time presence branches, one compiled program per form —
-            # a fit feeds a fixed form, so the compile counter still sees
-            # one program
+            # optional traced inputs (liveness / NaN gate / attack codes /
+            # slice mask): trace-time presence branches, one compiled
+            # program per form — a fit feeds a fixed form, so the compile
+            # counter still sees one program
             extras = [a for a in (live, poison, attack) if a is not None]
+            extra_specs = [P(site_part)] * len(extras)
+            if slice_live is not None:
+                # the [num_slices, rounds] whole-slice mask rides
+                # REPLICATED (tiny); members index their own slice's row
+                extras.append(slice_live)
+                extra_specs.append(P())
             has_live, has_poison = live is not None, poison is not None
             has_attack = attack is not None
+            has_slice = slice_live is not None
             axes = (
                 (SLICE_AXIS, SITE_AXIS, FOLD_AXIS) if sliced
                 else (SITE_AXIS, FOLD_AXIS)
@@ -1448,17 +1588,18 @@ def make_train_epoch_fn(
                 lv = opt.pop(0) if has_live else None
                 pz = opt.pop(0) if has_poison else None
                 ak = opt.pop(0) if has_attack else None
+                sm = opt.pop(0) if has_slice else None
                 return epoch_over_sites(
                     st, ix, None, None, lv, site_axes=axes,
                     inner_axis=FOLD_AXIS, inventory=(ex, ey), poison=pz,
-                    attack=ak,
+                    attack=ak, slice_live=sm,
                 )
 
             return shard_map(
                 wrapped,
                 mesh=mesh,
                 in_specs=(specs, P(site_part), P(site_part), P(site_part))
-                + (P(site_part),) * len(extras),
+                + tuple(extra_specs),
                 out_specs=(specs, P()),
                 check_vma=False,
             )(state, inv_x, inv_y, idx, *extras)
@@ -1468,13 +1609,16 @@ def make_train_epoch_fn(
     elif pipeline == "device":
 
         def epoch_fn_impl(state: TrainState, inv_x, inv_y, idx, live=None,
-                          poison=None, attack=None):
+                          poison=None, attack=None, slice_live=None):
             # all S sites fold onto the local device: the inner vmap IS the
             # site axis; the gather vmaps over the same leading site dim
+            # (slice_live is rejected inside epoch_over_sites — the vmap
+            # fold has no slice tier)
             return epoch_over_sites(
                 _ensure_health(state, idx), idx, None, None, live,
                 site_axes=SITE_AXIS, inner_axis=SITE_AXIS,
                 inventory=(inv_x, inv_y), poison=poison, attack=attack,
+                slice_live=slice_live,
             )
 
         epoch_fn = jax.jit(epoch_fn_impl, **jit_kw)
@@ -1482,10 +1626,11 @@ def make_train_epoch_fn(
     elif mesh is not None:
 
         def epoch_fn_impl(state: TrainState, inputs, labels, weights,
-                          live=None, attack=None):
+                          live=None, attack=None, slice_live=None):
             state = _ensure_health(state, inputs)
             specs = _state_specs(state, site_part)
             has_live, has_attack = live is not None, attack is not None
+            has_slice = slice_live is not None
             axes = (
                 (SLICE_AXIS, SITE_AXIS, FOLD_AXIS) if sliced
                 else (SITE_AXIS, FOLD_AXIS)
@@ -1501,15 +1646,20 @@ def make_train_epoch_fn(
                 opt = list(opt)
                 lv = opt.pop(0) if has_live else None
                 ak = opt.pop(0) if has_attack else None
+                sm = opt.pop(0) if has_slice else None
                 return epoch_over_sites(
                     st, x, y, w, lv, site_axes=axes,
-                    inner_axis=FOLD_AXIS, attack=ak,
+                    inner_axis=FOLD_AXIS, attack=ak, slice_live=sm,
                 )
 
             extras = [a for a in (live, attack) if a is not None]
+            extra_specs = [P(site_part)] * len(extras)
+            if slice_live is not None:
+                extras.append(slice_live)
+                extra_specs.append(P())
             in_specs = (
                 (specs, P(site_part), P(site_part), P(site_part))
-                + (P(site_part),) * len(extras)
+                + tuple(extra_specs)
             )
             return shard_map(
                 shard_wrapped,
@@ -1524,12 +1674,13 @@ def make_train_epoch_fn(
     else:
 
         def epoch_fn_impl(state: TrainState, inputs, labels, weights,
-                          live=None, attack=None):
+                          live=None, attack=None, slice_live=None):
             # all S sites fold onto the local device: the inner vmap IS the
-            # site axis
+            # site axis (slice_live is rejected inside epoch_over_sites)
             return epoch_over_sites(
                 _ensure_health(state, inputs), inputs, labels, weights, live,
                 site_axes=SITE_AXIS, inner_axis=SITE_AXIS, attack=attack,
+                slice_live=slice_live,
             )
 
         epoch_fn = jax.jit(epoch_fn_impl, **jit_kw)

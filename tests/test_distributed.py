@@ -85,6 +85,71 @@ def test_put_site_batch_single_process_commits_site_sharding():
     assert str(cast.dtype) == "bfloat16"
 
 
+def test_coordinator_join_deadline_fails_fast(monkeypatch):
+    """Satellite regression (r19): the DCN coordinator-join path keeps its
+    with_retry(deadline_s=) contract — a coordinator that never comes up
+    fails the worker within the wall-clock budget instead of retrying
+    forever (the hung-coordinator fail-fast PR 8 gave
+    jax.distributed.initialize)."""
+    import time
+
+    from dinunet_implementations_tpu.parallel import distributed as dist
+
+    calls = {"n": 0}
+
+    def refused(**kw):
+        calls["n"] += 1
+        raise ConnectionRefusedError("coordinator not up")
+
+    monkeypatch.setattr(dist.jax.distributed, "initialize", refused)
+    monkeypatch.setattr(dist.jax.distributed, "shutdown", lambda: None)
+    monkeypatch.setattr(dist, "_jax_distributed_client", lambda: None)
+    monkeypatch.setattr(dist, "_initialized", False)
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionRefusedError):
+        dist.distributed_init(
+            coordinator_address="127.0.0.1:1", num_processes=2,
+            process_id=1, join_deadline_s=0.6, join_timeout_s=None,
+        )
+    elapsed = time.monotonic() - t0
+    # at least one retry happened, and the deadline capped the total —
+    # never the unbounded 3-attempt exponential backoff
+    assert calls["n"] >= 2
+    assert elapsed < 5.0
+    assert dist._initialized is False
+
+
+def test_coordinator_join_attempt_timeout_is_fatal(monkeypatch):
+    """A join attempt that HANGS (wedged coordinator accepting the TCP
+    connect and never completing the handshake) is abandoned after
+    join_timeout_s and FAILS the operation — a timed-out attempt's zombie
+    thread may still be mutating jax's global distributed state, so
+    retrying would race it (distributed_init retry_on_timeout=False)."""
+    import time
+
+    from dinunet_implementations_tpu.parallel import distributed as dist
+    from dinunet_implementations_tpu.robustness.retry import RetryTimeout
+    from dinunet_implementations_tpu.telemetry.bus import global_bus
+
+    def hung(**kw):
+        time.sleep(30)
+
+    monkeypatch.setattr(dist.jax.distributed, "initialize", hung)
+    monkeypatch.setattr(dist, "_jax_distributed_client", lambda: None)
+    monkeypatch.setattr(dist, "_initialized", False)
+    t0 = time.monotonic()
+    with pytest.raises(RetryTimeout):
+        dist.distributed_init(
+            coordinator_address="127.0.0.1:1", num_processes=2,
+            process_id=1, join_deadline_s=30.0, join_timeout_s=0.3,
+        )
+    assert time.monotonic() - t0 < 5.0
+    assert dist._initialized is False
+    # the dcn_timeout observability: the failure landed on the live bus
+    counters = global_bus().snapshot().get("counters", {})
+    assert any("dcn_join_timeouts_total" in k for k in counters)
+
+
 def test_fetch_site_outputs_single_process_is_numpy_identity():
     from dinunet_implementations_tpu.parallel.distributed import (
         fetch_site_outputs,
@@ -252,6 +317,68 @@ def test_two_process_multislice_smoke(tmp_path):
     np.testing.assert_array_equal(r0["epoch_losses"], r1["epoch_losses"])
     # process-0-only output contract survives the sliced topology
     assert r0["n_log_writes"] > 0 and r1["n_log_writes"] == 0
+
+
+@pytest.mark.slow
+def test_supervised_chaos_kill_one_worker_completes(tmp_path):
+    """r19 chaos smoke (the tier-1 mirror of the CI multislice job): a
+    2-process supervised multi-slice run whose FaultPlan SIGKILLs slice
+    1's worker mid-run. The supervisor must record the death (liveness
+    spool + flight dump carrying the slice id and heartbeat age), restart
+    the fleet from the cross-slice checkpoint consensus, and complete —
+    with final params bit-identical to a no-fault reference run (resume
+    is bit-exact, so the surviving-slice trajectory reconverges on the
+    uninterrupted one). Skips on jaxlibs without multiprocess CPU
+    collectives (rc 66)."""
+    import glob
+    import subprocess
+    import sys
+
+    from dinunet_implementations_tpu.data.demo import make_demo_tree
+    from dinunet_implementations_tpu.runner.supervisor import (
+        read_slice_liveness,
+    )
+
+    data = tmp_path / "demo"
+    make_demo_tree(str(data))  # 4 sites → 2 per slice
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+
+    def supervised(out, rep, faults=None):
+        argv = [
+            sys.executable, "-m",
+            "dinunet_implementations_tpu.runner.dcn_worker",
+            "--supervise", "--num-processes", "2", "--slices", "2",
+            "--epochs", "4", "--data-path", str(data),
+            "--out-dir", str(out), "--report", str(rep),
+            "--heartbeat-timeout-s", "120",
+        ]
+        if faults:
+            argv += ["--faults", faults]
+        return subprocess.run(
+            argv, env=env, capture_output=True, text=True, timeout=900,
+        )
+
+    chaos = supervised(
+        tmp_path / "chaos", tmp_path / "chaos_rep.json",
+        faults='{"kill_slice_at":[[1,2]]}',
+    )
+    if chaos.returncode == 66:
+        pytest.skip("multiprocess CPU collectives unsupported (rc 66)")
+    assert chaos.returncode == 0, chaos.stdout[-4000:] + chaos.stderr[-4000:]
+    events = read_slice_liveness(str(tmp_path / "chaos" / "slice_liveness"))
+    kinds = [(e["event"], e["slice"]) for e in events]
+    assert ("dead", 1) in kinds and ("alive", 1) in kinds, kinds
+    dumps = glob.glob(str(tmp_path / "chaos" / "flight_*.json"))
+    reasons = [json.load(open(p))["reason"] for p in dumps]
+    assert any(r.startswith("slice-death:slice=1") for r in reasons), reasons
+
+    ref = supervised(tmp_path / "ref", tmp_path / "ref_rep.json")
+    assert ref.returncode == 0, ref.stdout[-4000:] + ref.stderr[-4000:]
+    r_chaos = json.load(open(tmp_path / "chaos_rep_p0.json"))
+    r_ref = json.load(open(tmp_path / "ref_rep_p0.json"))
+    assert r_chaos["restart_generation"] == 2  # the rejoined incarnation
+    assert r_chaos["params_sha256"] == r_ref["params_sha256"] is not None
 
 
 @pytest.mark.slow

@@ -150,6 +150,86 @@ def test_three_level_psum_fused_is_bit_exact_with_flat():
     )
 
 
+def test_three_level_psum_slice_live_gate_excludes_slice():
+    """The r19 primitive contract: a dead slice's partial is gated out of
+    the DCN reduce — fused AND split forms — and the result equals the
+    reduce over the surviving slice's members alone (×1.0 exact, ×0 is
+    exclusion). weighted_tree_sum renormalizes over survivors when the
+    dead slice's weights are zeroed with it."""
+    from dinunet_implementations_tpu.parallel.collectives import (
+        site_weight_scale,
+        weighted_tree_sum,
+    )
+
+    rng = np.random.default_rng(2)
+    vals = jnp.asarray(rng.normal(size=(16, 5)).astype(np.float32))
+    K = 2
+    m_sl = sliced_site_mesh(2, 8, K)
+    sl_ax = PackedAxis(SITE_AXIS, K, slice_name=SLICE_AXIS)
+    dcn = resolve_dcn_codec(dcn_wire_quant="int8")
+
+    def gated(dcn_wire):
+        # slice 1 dead: its members' partials gate to zero
+        def f(v):
+            own = jax.lax.axis_index(SLICE_AXIS)
+            live = jnp.where(own == 0, 1.0, 0.0)
+            return three_level_psum(
+                v, sl_ax, dcn_wire=dcn_wire, slice_live=live
+            )
+
+        return jax.jit(shard_map(
+            f, mesh=m_sl, in_specs=P((SLICE_AXIS, SITE_AXIS)),
+            out_specs=P(), check_vma=False,
+        ))(vals)
+
+    def masked_reduce(dcn_wire):
+        # the equivalence baseline: the SAME collective with the dead
+        # slice's member values zeroed outright (identical reduction tree,
+        # so gating == exclusion must hold bit-for-bit)
+        masked = jnp.concatenate([vals[:8], jnp.zeros_like(vals[8:])])
+        return jax.jit(shard_map(
+            lambda v: three_level_psum(v, sl_ax, dcn_wire=dcn_wire),
+            mesh=m_sl, in_specs=P((SLICE_AXIS, SITE_AXIS)), out_specs=P(),
+            check_vma=False,
+        ))(masked)
+
+    # the surviving slice owns the FIRST 8 virtual sites (slice-major)
+    np.testing.assert_array_equal(
+        np.asarray(gated(None)), np.asarray(masked_reduce(None))
+    )
+    np.testing.assert_allclose(
+        np.asarray(gated(None)), np.asarray(vals[:8].sum(axis=0)),
+        rtol=1e-6,
+    )
+    # split form: the survivor's partial still re-quantizes through the
+    # codec; the dead slice contributes exactly zero to the slice psum
+    np.testing.assert_array_equal(
+        np.asarray(gated(dcn)), np.asarray(masked_reduce(dcn))
+    )
+
+    # weighted_tree_sum: zero the dead slice's weights alongside the gate
+    # — the weighted mean renormalizes over the surviving slice only
+    w = np.ones((16,), np.float32)
+    w[8:] = 0.0  # slice 1's members carry no weight
+
+    def wsum(v, wv):
+        own = jax.lax.axis_index(SLICE_AXIS)
+        live = jnp.where(own == 0, 1.0, 0.0)
+        scale = site_weight_scale(wv, sl_ax)
+        return weighted_tree_sum(
+            {"g": v}, scale, sl_ax, dcn_wire=None, slice_live=live
+        )["g"]
+
+    out = jax.jit(shard_map(
+        wsum, mesh=m_sl,
+        in_specs=(P((SLICE_AXIS, SITE_AXIS)), P((SLICE_AXIS, SITE_AXIS))),
+        out_specs=P(), check_vma=False,
+    ))(vals, jnp.asarray(w))
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(vals[:8].mean(axis=0)), rtol=1e-6
+    )
+
+
 def test_sliced_gather_matches_flat_order():
     from dinunet_implementations_tpu.parallel.collectives import (
         site_all_gather,
@@ -518,6 +598,7 @@ def test_dcn_worker_cli_parsing():
     from dinunet_implementations_tpu.runner.dcn_worker import (
         _config_overrides,
         _parse,
+        _slice_of,
     )
 
     args = _parse([
@@ -528,3 +609,191 @@ def test_dcn_worker_cli_parsing():
     assert args.slices == 2 and args.process_id == 1
     ov = _config_overrides(args.overrides)
     assert ov == {"wire_quant": "int8", "staleness_bound": 2}
+    # r19 supervision flags parse, with sane defaults
+    args = _parse([
+        "--data-path", "/x", "--supervise", "--slices", "2",
+        "--num-processes", "4", "--faults", '{"kill_slice_at":[[1,2]]}',
+        "--resume", "--heartbeat-timeout-s", "15",
+    ])
+    assert args.supervise and args.resume
+    assert args.heartbeat_timeout_s == 15 and args.max_restarts == 2
+    # processes are contiguous slice granules
+    assert [_slice_of(r, 4, 2) for r in range(4)] == [0, 0, 1, 1]
+    assert _slice_of(3, 4, 1) == 0
+
+
+# ---------------------------------------------------------------------------
+# slice elasticity (r19): liveness mask, quorum holds, supervision-free
+# equivalence gates
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("engine", ["dSGD", "rankDAD", "powerSGD"])
+@pytest.mark.parametrize("pack", [1, 2])
+def test_slice_drop_matches_site_exclusion_bit_exact(engine, pack):
+    """THE r19 equivalence gate: a round with slice j masked (the
+    [num_slices, rounds] slice-liveness input) produces params, losses AND
+    per-site engine state BIT-IDENTICAL to the same program fed a
+    site-level mask excluding slice j's sites outright — per engine,
+    packed (K=2) and unpacked. ×1.0 is exact and ×0 is exclusion, so
+    nothing in the math may move."""
+    S = 8 * pack
+    data = _data(S)
+    mesh = sliced_site_mesh(2, S // 2, pack)
+    # slice 1 dead in round 0, everyone back in round 1
+    slice_live = jnp.asarray([[1.0, 1.0], [0.0, 1.0]], jnp.float32)
+    site_live = np.ones((S, 2), np.float32)
+    site_live[S // 2:, 0] = 0.0  # slice 1's slot band (slice-major layout)
+    site_live = jnp.asarray(site_live)
+    fn, st = _build(engine, mesh, S)
+    s_sl, l_sl = fn(st, *data, None, None, slice_live)
+    s_site, l_site = fn(st, *data, site_live, None, None)
+    np.testing.assert_array_equal(np.asarray(l_sl), np.asarray(l_site))
+    for tree_sl, tree_site in (
+        (s_sl.params, s_site.params),
+        (s_sl.engine_state, s_site.engine_state),
+        (s_sl.health, s_site.health),
+    ):
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            tree_sl, tree_site,
+        )
+
+
+def test_slice_drop_matches_flat_mesh_site_exclusion():
+    """The same dead slice, compared across TOPOLOGIES: the sliced run
+    with slice 1 masked equals the FLAT single-mesh run with slice 1's
+    site band masked — slice elasticity composes with the r18
+    sliced==unsliced bit-exactness, so the whole chain is anchored to the
+    legacy program."""
+    S = 8
+    data = _data(S)
+    slice_live = jnp.asarray([[1.0, 1.0], [0.0, 1.0]], jnp.float32)
+    site_live = np.ones((S, 2), np.float32)
+    site_live[S // 2:, 0] = 0.0
+    site_live = jnp.asarray(site_live)
+    fn_s, st_s = _build("dSGD", sliced_site_mesh(2, S // 2, 1), S)
+    fn_f, st_f = _build("dSGD", packed_site_mesh(S, 1), S)
+    s_s, l_s = fn_s(st_s, *data, None, None, slice_live)
+    s_f, l_f = fn_f(st_f, *data, site_live)
+    np.testing.assert_array_equal(np.asarray(l_s), np.asarray(l_f))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        s_s.params, s_f.params,
+    )
+
+
+def test_slice_quorum_holds_round():
+    """min_slices=2 with one slice dead: the round HOLDS — params /
+    optimizer / engine state / health / telemetry all frozen, NaN loss,
+    held_rounds counted — and the next round (quorum restored) trains
+    normally. min_slices=1 on the same mask trains the surviving slice
+    instead (diverging params): the floor is what declines the round, not
+    the mask."""
+    S = 8
+    data = _data(S)
+    mesh = sliced_site_mesh(2, S // 2, 1)
+    slice_live = jnp.asarray([[1.0, 1.0], [0.0, 1.0]], jnp.float32)
+    fn_q, st_q = _build("dSGD", mesh, S, telemetry=True, min_slices=2)
+    s_h, l_h = fn_q(st_q, *data, None, None, slice_live)
+    losses = np.asarray(l_h)
+    assert np.isnan(losses[0]) and np.isfinite(losses[1])
+    t = jax.tree.map(np.asarray, s_h.telemetry)
+    assert t["held_rounds"][0] == 1 and t["rounds"][0] == 1
+    # a held round is nobody's fault: health counters frozen, no skips
+    assert np.asarray(s_h.health["skips"]).sum() == 0
+    # the no-hold arm trains round 0 on the surviving slice — different
+    # trajectory (and round 0 has a real loss)
+    fn_1, st_1 = _build("dSGD", mesh, S, telemetry=True, min_slices=1)
+    s_1, l_1 = fn_1(st_1, *data, None, None, slice_live)
+    assert np.isfinite(np.asarray(l_1)).all()
+    assert not np.array_equal(
+        np.asarray(jax.tree.leaves(s_h.params)[0]),
+        np.asarray(jax.tree.leaves(s_1.params)[0]),
+    )
+
+
+def test_slice_churn_never_retraces():
+    """CompileGuard (r19): a drop → hold → rejoin scenario across epochs
+    — three different slice-fault masks through one epoch fn — compiles
+    the epoch exactly once; churn reaches the program only through traced
+    inputs."""
+    from dinunet_implementations_tpu.checks.sanitize import jit_cache_size
+
+    S = 8
+    data = _data(S)
+    mesh = sliced_site_mesh(2, S // 2, 1)
+    fn, st = _build("dSGD", mesh, S, min_slices=2)
+    masks = (
+        [[1.0, 1.0], [0.0, 1.0]],  # drop: slice 1 out round 0
+        [[0.0, 0.0], [1.0, 1.0]],  # hold: slice 0 out both rounds
+        [[1.0, 1.0], [1.0, 1.0]],  # rejoin: everyone back
+    )
+    # two warmup calls reach the steady-state layout (the freshly-built
+    # state is uncommitted; its first output is mesh-committed — the known
+    # one-time layout recompile the trainer's _place_state avoids)
+    s, _ = fn(st, *data, None, None, jnp.asarray(masks[0], jnp.float32))
+    s, _ = fn(s, *data, None, None, jnp.asarray(masks[0], jnp.float32))
+    n0 = jit_cache_size(fn)
+    for m in masks[1:]:
+        s, _ = fn(s, *data, None, None, jnp.asarray(m, jnp.float32))
+    # the drop → hold → rejoin chain adds ZERO compiles
+    assert jit_cache_size(fn) == n0
+
+
+def test_slice_mask_rejected_on_unsliced_topologies():
+    S = 8
+    data = _data(S)
+    mask = jnp.ones((2, 2), jnp.float32)
+    fn_flat, st_flat = _build("dSGD", packed_site_mesh(S, 1), S)
+    with pytest.raises(ValueError, match="unsliced"):
+        fn_flat(st_flat, *data, None, None, mask)
+    fn_vmap, st_vmap = _build("dSGD", None, S)
+    with pytest.raises(ValueError, match="unsliced"):
+        fn_vmap(st_vmap, *data, None, None, mask)
+    # and a quorum floor without a sliced mesh is a config error
+    with pytest.raises(ValueError, match="min_slices"):
+        _build("dSGD", packed_site_mesh(S, 1), S, min_slices=2)
+    # a wrong slice-row count is a shape error, not a silently-clamped
+    # own-row gather (XLA would clamp the out-of-bounds index)
+    fn_s, st_s = _build("dSGD", sliced_site_mesh(2, S // 2, 1), S)
+    with pytest.raises(ValueError, match="slice rows"):
+        fn_s(st_s, *data, None, None, jnp.ones((3, 2), jnp.float32))
+
+
+def test_slice_fault_plan_through_trainer(tmp_path):
+    """End to end through FederatedTrainer (device pipeline): a FaultPlan
+    with slice windows renders into the traced mask, the run completes
+    with one epoch compile, and the slice-dead rounds show in the site
+    health exactly like the equivalent site-level plan."""
+    from dinunet_implementations_tpu import TrainConfig
+    from dinunet_implementations_tpu.checks.sanitize import jit_cache_size
+    from dinunet_implementations_tpu.data.api import SiteArrays
+    from dinunet_implementations_tpu.robustness.faults import FaultPlan
+    from dinunet_implementations_tpu.trainer import FederatedTrainer
+
+    S = 8
+    rng = np.random.default_rng(0)
+    sites = []
+    for s in range(S):
+        y = (rng.random(8) > 0.5).astype(np.int64)
+        x = rng.normal(size=(8, 6)).astype(np.float32) + y[:, None]
+        sites.append(SiteArrays(x, y, np.arange(8)))
+    cfg = TrainConfig(
+        task_id="FS-Classification", batch_size=4, epochs=2,
+        validation_epochs=1, patience=10, num_slices=2,
+    )
+    model = MSANNet(in_size=6, hidden_sizes=(8,), out_size=2)
+    mesh = sliced_site_mesh(2, S // 2, 1)
+    plan = FaultPlan(slice_drop_at=[[1, 0, 0]])
+    tr = FederatedTrainer(cfg, model, mesh=mesh, fault_plan=plan)
+    res = tr.fit(sites, sites, sites, verbose=False)
+    assert jit_cache_size(tr.epoch_fn) == 1
+    # slice 1's band skipped round 0; slice 0's sites never skipped
+    skips = res["site_health"]["site_skipped_rounds"]
+    assert all(v >= 1 for v in skips[S // 2:])
+    assert all(v == 0 for v in skips[: S // 2])

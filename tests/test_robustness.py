@@ -99,6 +99,76 @@ def test_fault_plan_liveness_deterministic_and_chunk_independent():
     assert (forever[0] == 0.0).all() and (forever[1] == 1.0).all()
 
 
+def test_slice_fault_plan_roundtrip_and_validation():
+    """r19 slice-tier windows: JSON/CLI round-trip like every other plan
+    field, arity/range validation, and the kill lookup the supervised
+    worker's self-kill arm keys on."""
+    plan = FaultPlan(
+        slice_drop_at=((1, 0, 2), (0, 5, -1)),
+        slice_delay_at=((2, 3, 2),),
+        kill_slice_at=((1, 4), (1, 9), (3, 2)),
+    )
+    assert FaultPlan.from_json(plan.to_json()) == plan
+    assert FaultPlan.from_json(json.dumps(plan.to_json())) == plan
+    assert parse_fault_plan(json.dumps(plan.to_json())) == plan
+    assert plan.injects_slice_faults()
+    assert not plan.injects_faults()  # slice windows are not site windows
+    # the earliest kill round per slice (dcn_worker's deterministic arm)
+    assert plan.kill_round_for_slice(1) == 4
+    assert plan.kill_round_for_slice(3) == 2
+    assert plan.kill_round_for_slice(0) is None
+    with pytest.raises(ValueError, match="slice_drop_at"):
+        FaultPlan(slice_drop_at=((0, 5),))
+    with pytest.raises(ValueError, match="slice_drop_at"):
+        FaultPlan(slice_drop_at=((0, 9, 5),))
+    with pytest.raises(ValueError, match="slice_delay_at"):
+        FaultPlan(slice_delay_at=((0, 1, 0),))
+    with pytest.raises(ValueError, match="kill_slice_at"):
+        FaultPlan(kill_slice_at=((-1, 2),))
+
+
+def test_slice_liveness_windows_chunk_independent():
+    """slice_liveness is a pure function of GLOBAL rounds (resume/chunking
+    never changes the pattern), kills hold to the end of every window, and
+    include_kills=False leaves the process-arm faults out of the mask."""
+    from dinunet_implementations_tpu.robustness.faults import (
+        slice_fault_window,
+    )
+
+    plan = FaultPlan(
+        slice_drop_at=((0, 2, 3),), slice_delay_at=((1, 5, 2),),
+        kill_slice_at=((2, 4),),
+    )
+    whole = plan.slice_liveness(3, 0, 10)
+    chunked = np.concatenate(
+        [plan.slice_liveness(3, 0, 4), plan.slice_liveness(3, 4, 6)], axis=1
+    )
+    np.testing.assert_array_equal(whole, chunked)
+    # drop window inclusive; delay covers [round, round+delay)
+    assert whole[0, 1] == 1.0 and whole[0, 2] == 0.0
+    assert whole[0, 3] == 0.0 and whole[0, 4] == 1.0
+    assert whole[1, 4] == 1.0 and whole[1, 5] == 0.0
+    assert whole[1, 6] == 0.0 and whole[1, 7] == 1.0
+    # a killed slice stays dead to the end of the mask (only a supervisor
+    # restart, which re-renders without the kill, revives it)
+    assert (whole[2, 4:] == 0.0).all() and (whole[2, :4] == 1.0).all()
+    # the process-kill arm: mask rendered without kills
+    nokill = plan.slice_liveness(3, 0, 10, include_kills=False)
+    assert (nokill[2] == 1.0).all()
+    kill_only = FaultPlan(kill_slice_at=((0, 1),))
+    assert kill_only.injects_slice_faults()
+    assert not kill_only.injects_slice_faults(include_kills=False)
+    # the shared window helper mirrors fault_window's None contract
+    assert slice_fault_window(None, 2, 0, 4) is None
+    assert slice_fault_window(plan, 1, 0, 4) is None  # no slice tier
+    assert slice_fault_window(
+        kill_only, 2, 0, 4, include_kills=False
+    ) is None
+    np.testing.assert_array_equal(
+        slice_fault_window(plan, 3, 2, 4), plan.slice_liveness(3, 2, 4)
+    )
+
+
 def test_fault_plan_nan_mask_and_poisoning():
     plan = FaultPlan(nan_at=((2, 1), (5, 0)))
     mask = plan.nan_mask(2, 0, 4)  # window covers round 2 only

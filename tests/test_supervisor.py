@@ -1,0 +1,322 @@
+"""Slice-tier supervision tests (r19, runner/supervisor.py): heartbeats,
+the shared liveness spool, cross-slice checkpoint consensus, and the
+restart state machine driven end to end with stub workers — fast enough
+for tier-1 (the full jax.distributed chaos smoke lives in
+tests/test_distributed.py behind the slow marker and the rc-66 skip)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dinunet_implementations_tpu.runner.supervisor import (
+    SUPERVISOR_GAVE_UP_RC,
+    Heartbeat,
+    SliceSupervisor,
+    consensus_round,
+    heartbeat_age_s,
+    heartbeat_path,
+    mark_slice_alive,
+    mark_slice_dead,
+    read_heartbeat,
+    read_slice_liveness,
+    slice_ckpt_candidates,
+    slice_ckpt_dir,
+)
+from dinunet_implementations_tpu.trainer.checkpoint import save_checkpoint
+from dinunet_implementations_tpu.trainer.steps import TrainState
+
+
+# ---------------------------------------------------------------------------
+# heartbeats
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_pulse_and_age(tmp_path):
+    path = heartbeat_path(str(tmp_path), 3)
+    assert read_heartbeat(path) is None and heartbeat_age_s(path) is None
+    hb = Heartbeat(path, 3, interval_s=0.05)
+    hb.beat(epoch=7, round=14)
+    pulse = read_heartbeat(path)
+    assert pulse["slice"] == 3 and pulse["pid"] == os.getpid()
+    assert pulse["epoch"] == 7 and pulse["round"] == 14
+    assert heartbeat_age_s(path) < 5.0
+    # the background thread keeps pulsing (and keeps the manual extras)
+    hb.start()
+    t0 = pulse["time_unix"]
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        p = read_heartbeat(path)
+        if p and p["time_unix"] > t0:
+            break
+        time.sleep(0.02)
+    else:
+        pytest.fail("background heartbeat never pulsed")
+    assert read_heartbeat(path)["epoch"] == 7
+    hb.stop()
+
+
+# ---------------------------------------------------------------------------
+# the liveness spool
+# ---------------------------------------------------------------------------
+
+
+def test_liveness_spool_event_order_and_fields(tmp_path):
+    d = str(tmp_path / "liveness")
+    assert read_slice_liveness(d) == []
+    mark_slice_dead(d, 1, "exit rc=-9 (signal 9)", heartbeat_age=3.2,
+                    generation=1)
+    mark_slice_alive(d, 1, 2)
+    mark_slice_dead(d, 0, "heartbeat stale", heartbeat_age=31.0,
+                    generation=2)
+    events = read_slice_liveness(d)
+    assert [(e["event"], e["slice"]) for e in events] == [
+        ("dead", 1), ("alive", 1), ("dead", 0),
+    ]
+    assert events[0]["heartbeat_age_s"] == 3.2
+    assert events[1]["generation"] == 2
+    assert all("time_unix" in e for e in events)
+
+
+# ---------------------------------------------------------------------------
+# cross-slice checkpoint consensus
+# ---------------------------------------------------------------------------
+
+
+def _mini_state(v: float) -> TrainState:
+    return TrainState(
+        params={"w": jnp.full((3,), float(v))}, batch_stats={},
+        opt_state={}, engine_state={}, rng=jax.random.PRNGKey(0),
+        round=jnp.asarray(int(v), jnp.int32),
+    )
+
+
+def _seal(ckpt_dir: str, rnd: int, sha: str) -> None:
+    save_checkpoint(
+        os.path.join(ckpt_dir, "checkpoint_latest.msgpack"),
+        _mini_state(rnd),
+        meta={"round": rnd, "epoch": rnd // 2, "params_sha256": sha},
+        rotate=True,
+    )
+
+
+def test_consensus_picks_newest_agreed_round(tmp_path):
+    dirs = {sl: slice_ckpt_dir(str(tmp_path), sl) for sl in (0, 1)}
+    for d in dirs.values():
+        _seal(d, 4, "sha4")
+        _seal(d, 8, "sha8")
+    rnd, sha, path = consensus_round(dirs)
+    assert (rnd, sha) == (8, "sha8") and os.path.exists(path)
+    # both generations are SEPARATE candidates
+    assert set(slice_ckpt_candidates(dirs[0])) == {4, 8}
+
+
+def test_consensus_falls_to_common_round_when_a_slice_lags(tmp_path):
+    dirs = {sl: slice_ckpt_dir(str(tmp_path), sl) for sl in (0, 1)}
+    _seal(dirs[0], 4, "sha4")
+    _seal(dirs[0], 8, "sha8")
+    _seal(dirs[1], 4, "sha4")  # slice 1 died before sealing round 8
+    rnd, sha, _ = consensus_round(dirs)
+    assert (rnd, sha) == (4, "sha4")
+
+
+def test_consensus_requires_digest_agreement(tmp_path):
+    dirs = {sl: slice_ckpt_dir(str(tmp_path), sl) for sl in (0, 1)}
+    _seal(dirs[0], 4, "sha4")
+    _seal(dirs[1], 4, "DIVERGED")
+    assert consensus_round(dirs) is None
+    # a slice with NO checkpoint at all: no consensus either
+    dirs[2] = slice_ckpt_dir(str(tmp_path), 2)
+    assert consensus_round(dirs) is None
+
+
+def test_consensus_survives_torn_latest_via_prev(tmp_path):
+    """The PR 2 contract one tier up: a torn primary on one slice is not a
+    candidate, but its intact .prev generation still reaches agreement."""
+    dirs = {sl: slice_ckpt_dir(str(tmp_path), sl) for sl in (0, 1)}
+    for d in dirs.values():
+        _seal(d, 4, "sha4")
+        _seal(d, 8, "sha8")
+    torn = os.path.join(dirs[0], "checkpoint_latest.msgpack")
+    with open(torn, "r+b") as fh:
+        fh.seek(24)
+        fh.write(b"XXXX")  # corrupt the payload past the CRC
+    assert set(slice_ckpt_candidates(dirs[0])) == {4}
+    rnd, sha, _ = consensus_round(dirs)
+    assert (rnd, sha) == (4, "sha4")
+
+
+# ---------------------------------------------------------------------------
+# the restart state machine (stub workers — no jax.distributed needed)
+# ---------------------------------------------------------------------------
+
+_STUB = textwrap.dedent("""
+    import json, os, signal, sys, time
+    out, rank, gen, die_rank = sys.argv[1], int(sys.argv[2]), \\
+        int(sys.argv[3]), int(sys.argv[4])
+    hb = os.path.join(out, "heartbeats", f"slice_{rank}.json")
+    os.makedirs(os.path.dirname(hb), exist_ok=True)
+    # exit cleanly on SIGTERM like a drained worker
+    signal.signal(signal.SIGTERM, lambda *a: sys.exit(143))
+    for i in range(100):
+        tmp = hb + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"pid": os.getpid(), "slice": rank,
+                       "time_unix": time.time()}, fh)
+        os.replace(tmp, hb)
+        if gen == 1 and rank == die_rank and i == 2:
+            os.kill(os.getpid(), signal.SIGKILL)
+        if i >= 6:
+            sys.exit(0)
+        time.sleep(0.05)
+""")
+
+
+def _stub_spawn(tmp_path, die_rank: int):
+    stub = tmp_path / "stub.py"
+    stub.write_text(_STUB)
+
+    def spawn(rank, generation):
+        return subprocess.Popen([
+            sys.executable, str(stub), str(tmp_path), str(rank),
+            str(generation), str(die_rank),
+        ])
+
+    return spawn
+
+
+class _RingFlight:
+    def __init__(self):
+        self.notes = []
+        self.dumps = []
+
+    def note(self, name, **attrs):
+        self.notes.append({"name": name, **attrs})
+
+    def dump(self, reason):
+        self.dumps.append(reason)
+        return reason
+
+
+def test_supervisor_restarts_dead_slice_and_completes(tmp_path):
+    flight = _RingFlight()
+    consensus_calls = []
+    sup = SliceSupervisor(
+        _stub_spawn(tmp_path, die_rank=1), num_processes=2,
+        out_dir=str(tmp_path), heartbeat_timeout_s=10.0, max_restarts=2,
+        poll_s=0.1, grace_s=5.0, flight=flight,
+        on_consensus=lambda g, dead: consensus_calls.append((g, dead)),
+    )
+    assert sup.run() == 0
+    assert sup.restarts == 1 and consensus_calls == [(1, 1)]
+    events = read_slice_liveness(os.path.join(tmp_path, "slice_liveness"))
+    assert [(e["event"], e["slice"]) for e in events] == [
+        ("dead", 1), ("alive", 1),
+    ]
+    assert "signal 9" in events[0]["reason"]
+    # the flight dump's reason carries slice id + last heartbeat age
+    assert len(flight.dumps) == 1
+    assert "slice-death:slice=1" in flight.dumps[0]
+    assert "hb_age=" in flight.dumps[0]
+    names = [n["name"] for n in flight.notes]
+    assert names.count("fleet-launch") == 2
+    assert "slice-death" in names and "fleet-complete" in names
+
+
+def test_supervisor_gives_up_after_max_restarts(tmp_path):
+    stub = tmp_path / "always_die.py"
+    stub.write_text(textwrap.dedent("""
+        import os, signal, sys, time
+        time.sleep(0.1)
+        os.kill(os.getpid(), signal.SIGKILL)
+    """))
+
+    def spawn(rank, generation):
+        return subprocess.Popen([sys.executable, str(stub)])
+
+    sup = SliceSupervisor(
+        spawn, num_processes=1, out_dir=str(tmp_path),
+        heartbeat_timeout_s=10.0, max_restarts=1, poll_s=0.1, grace_s=2.0,
+    )
+    rc = sup.run()
+    # a signal death propagates as the shell's 128+signum, never negative
+    assert rc in (128 + signal.SIGKILL, SUPERVISOR_GAVE_UP_RC)
+    assert sup.restarts == 2  # 1 allowed restart + the give-up detection
+    deaths = [
+        e for e in read_slice_liveness(
+            os.path.join(tmp_path, "slice_liveness")
+        ) if e["event"] == "dead"
+    ]
+    assert len(deaths) == 2
+
+
+def test_supervisor_passthrough_rc_skips_restart(tmp_path):
+    """The rc-66 capability skip must propagate verbatim without burning a
+    restart — CI skips, it does not churn."""
+    stub = tmp_path / "unsupported.py"
+    stub.write_text("import sys; sys.exit(66)")
+
+    def spawn(rank, generation):
+        return subprocess.Popen([sys.executable, str(stub)])
+
+    sup = SliceSupervisor(
+        spawn, num_processes=2, out_dir=str(tmp_path),
+        poll_s=0.1, grace_s=2.0, passthrough_rcs=(66,),
+    )
+    assert sup.run() == 66
+    assert sup.restarts == 0
+    assert read_slice_liveness(
+        os.path.join(tmp_path, "slice_liveness")
+    ) == []
+
+
+def test_supervisor_detects_wedged_worker_by_heartbeat(tmp_path):
+    """A worker that stops beating but never exits (wedged in a collective
+    whose peer died) is killed and restarted — the heartbeat-staleness
+    path, with the with_retry deadline giving a fresh pulse every chance
+    to appear first."""
+    stub = tmp_path / "wedge.py"
+    stub.write_text(textwrap.dedent("""
+        import json, os, sys, time
+        out, rank, gen = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+        hb = os.path.join(out, "heartbeats", f"slice_{rank}.json")
+        os.makedirs(os.path.dirname(hb), exist_ok=True)
+        tmp = hb + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"pid": os.getpid(), "slice": rank,
+                       "time_unix": time.time()}, fh)
+        os.replace(tmp, hb)
+        if gen == 1 and rank == 0:
+            time.sleep(600)  # wedged: alive, never beats again
+        sys.exit(0)
+    """))
+
+    def spawn(rank, generation):
+        return subprocess.Popen([
+            sys.executable, str(stub), str(tmp_path), str(rank),
+            str(generation),
+        ])
+
+    flight = _RingFlight()
+    sup = SliceSupervisor(
+        spawn, num_processes=1, out_dir=str(tmp_path),
+        heartbeat_timeout_s=1.0, max_restarts=2, poll_s=0.2, grace_s=2.0,
+        flight=flight,
+    )
+    assert sup.run() == 0
+    assert sup.restarts == 1
+    deaths = [
+        e for e in read_slice_liveness(
+            os.path.join(tmp_path, "slice_liveness")
+        ) if e["event"] == "dead"
+    ]
+    assert len(deaths) == 1 and "heartbeat" in deaths[0]["reason"]
+    assert deaths[0]["heartbeat_age_s"] is not None
+    assert any("hb_age=" in d for d in flight.dumps)
