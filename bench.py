@@ -269,7 +269,7 @@ def _flagship_arm(engine_name: str = "dSGD", engine_kw: dict | None = None,
 
 def _setup_epoch(engine_name: str = "dSGD", engine_kw: dict | None = None,
                  fused_bidir: bool | None = None, dims: dict | None = None,
-                 fault_plan=None):
+                 fault_plan=None, epoch_kw: dict | None = None):
     """Build the compiled flagship epoch for one bench arm.
 
     Returns ``(run_chain, samples_per_epoch)``: ``run_chain(k)`` times a
@@ -277,7 +277,9 @@ def _setup_epoch(engine_name: str = "dSGD", engine_kw: dict | None = None,
     call ``run_chain(1)`` once to warm up before timing). ``dims`` overrides
     the flagship model/data dims (``--small`` harness-validation mode).
     ``fault_plan`` (a robustness.FaultPlan) measures the fault-masked round:
-    its epoch-0 liveness mask feeds every chained epoch."""
+    its epoch-0 liveness mask feeds every chained epoch. ``epoch_kw``
+    threads extra ``make_train_epoch_fn`` kwargs (the r20 privacy arms:
+    dp_clip / dp_noise_multiplier / personalize)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -305,7 +307,10 @@ def _setup_epoch(engine_name: str = "dSGD", engine_kw: dict | None = None,
     state0 = init_train_state(
         task, engine, opt, jax.random.PRNGKey(0), x[0, 0], num_sites=S
     )
-    epoch_fn = make_train_epoch_fn(task, engine, opt, mesh=None, local_iterations=1)
+    epoch_fn = make_train_epoch_fn(
+        task, engine, opt, mesh=None, local_iterations=1,
+        **(epoch_kw or {}),
+    )
     live = None
     if fault_plan is not None and fault_plan.injects_faults():
         # rounds == steps at local_iterations=1; the first epoch's window
@@ -679,6 +684,103 @@ def measure_attacks_ab(attack_plan, robust: str = "trimmed_mean",
     return records
 
 
+def measure_privacy_ab(dp_noise: float = 0.5, dp_clip: float = 1.0,
+                       secure_mode: str = "mask", obs: int = 5,
+                       n: int = TIMED_EPOCHS, dims: dict | None = None,
+                       engine_name: str = "dSGD") -> list[dict]:
+    """Privacy-plane A/B (``--dp-noise`` / ``--secure-agg``, r20): paired
+    interleaved arms of the flagship federated round —
+
+    - ``clean``        : the legacy program (the baseline);
+    - ``dp``           : in-scan DP-SGD (clip ``dp_clip`` + ``dp_noise``·C
+      Gaussian noise per site per round, privacy/dpsgd.py) — the
+      mechanism-cost arm, with the RDP accountant's ``epsilon_final`` for
+      the timed chain length recorded next to the throughput;
+    - ``dp+secureagg`` : the same mechanism with the masked fixed-point
+      wire on top at ``secure_mode`` ("mask", or "mask-nopads" — the
+      verification arm — recorded VERBATIM in the record; "off" drops the
+      arm). Without DP noise the masked arm runs standalone
+      (``secureagg``).
+
+    Each record carries throughput stats, the modeled per-device wire bytes
+    (the figure S002 proves — int32 grid == f32 bytes for the masked
+    arms), the spent ε at the recorded chain length, and the privacy knobs
+    verbatim. The accuracy-floor gates live in tests/test_golden.py; this
+    artifact records the measured arms a claim can cite
+    (docs/bench_privacy_ab_r20.jsonl)."""
+    import jax
+
+    from dinunet_implementations_tpu.engines import make_engine
+    from dinunet_implementations_tpu.privacy import (
+        RdpAccountant,
+        effective_noise_multiplier,
+        sampling_fraction,
+    )
+    from dinunet_implementations_tpu.telemetry.metrics import payload_bytes_of
+
+    from dinunet_implementations_tpu.privacy import secure_agg_enabled
+
+    secure = secure_agg_enabled(secure_mode)  # validates the mode string
+    dp_kw = dict(dp_clip=dp_clip, dp_noise_multiplier=dp_noise)
+    arms = {"clean": ({}, {})}
+    if dp_noise > 0:
+        arms["dp"] = ({}, dp_kw)
+        if secure:
+            arms["dp+secureagg"] = ({"secure_agg": secure_mode}, dp_kw)
+    elif secure:
+        arms["secureagg"] = ({"secure_agg": secure_mode}, {})
+
+    chains = {}
+    samples = None
+    byte_model = {}
+    params = _flagship_params_template(engine_name, dims)  # arm-invariant
+    for arm, (eng_kw, epoch_kw) in arms.items():
+        chains[arm], samples = _setup_epoch(
+            engine_name, eng_kw, dims=dims, epoch_kw=epoch_kw
+        )
+        chains[arm](1)  # compile + warm up before any timing starts
+        byte_model[arm] = int(
+            payload_bytes_of(make_engine(engine_name, **eng_kw), params)
+        )
+    dists = interleaved_ab(chains, n, obs=obs)
+    d = dict(sites=NUM_SITES, steps=STEPS_PER_EPOCH, batch=BATCH_PER_SITE)
+    d.update(dims or {})
+    # the synthetic flagship pool: each site holds steps·batch examples and
+    # each round consumes batch of them — the accountant's q for the arm
+    q = sampling_fraction(d["batch"], 1, [d["steps"] * d["batch"]])
+    records = []
+    for arm, (eng_kw, epoch_kw) in arms.items():
+        eps = None
+        if epoch_kw.get("dp_noise_multiplier", 0) > 0:
+            acct = RdpAccountant().step(
+                effective_noise_multiplier(epoch_kw["dp_noise_multiplier"]),
+                q, steps=n * d["steps"],
+            )
+            eps = round(acct.epsilon(1e-5)[0], 4)
+        rec = {
+            "metric": "samples/sec/chip (ICA-LSTM federated round, "
+                      "privacy-plane A/B)",
+            "arm": arm,
+            "engine": engine_name,
+            "dp_clip": epoch_kw.get("dp_clip", 0.0),
+            "dp_noise_multiplier": epoch_kw.get("dp_noise_multiplier", 0.0),
+            "secure_agg": eng_kw.get("secure_agg", "off"),
+            "epsilon_final": eps,
+            "dp_delta": 1e-5 if eps is not None else None,
+            "sampling_fraction": round(q, 6),
+            "sites": (dims or {}).get("sites", NUM_SITES),
+            "backend": jax.default_backend(),
+            "chain_epochs": n,
+            "samples_per_sec": throughput_stats(dists[arm], samples),
+            "unit": "samples/sec/chip",
+            "wire_bytes_per_device_round": byte_model[arm],
+        }
+        if dims:
+            rec["dims"] = dims
+        records.append(rec)
+    return records
+
+
 def _setup_pipeline_arm(arm: str, dims: dict | None = None,
                         donate: bool = True):
     """One input-pipeline A/B arm (``--pipeline``): unlike the steady-state
@@ -871,7 +973,7 @@ def _setup_packed_epoch(S: int, K: int, engine_name: str = "dSGD",
                         dims: dict | None = None, fault_plan=None,
                         staleness_bound: int = 0, attack_plan=None,
                         robust_agg: str = "none", slices: int = 1,
-                        dcn_quant: str = ""):
+                        dcn_quant: str = "", epoch_kw: dict | None = None):
     """One sites-scaling arm: S virtual sites packed K per device on a real
     ``(site,)`` mesh — the full federated round as ONE compiled SPMD program
     with two-level aggregation (trainer/steps.py packed path). Epoch inputs
@@ -996,6 +1098,8 @@ def _setup_packed_epoch(S: int, K: int, engine_name: str = "dSGD",
         task, engine, opt, mesh=mesh, local_iterations=1,
         staleness_bound=staleness_bound, attack_plan=attack_plan,
         robust_agg=robust_agg,
+        # r20 privacy arms: dp_clip / dp_noise_multiplier via --dp-noise
+        **(epoch_kw or {}),
     )
 
     from dinunet_implementations_tpu.checks.sanitize import (
@@ -1028,7 +1132,8 @@ def measure_sites_scaling(sites_list, packs=None, obs: int = 3,
                           engine_kw: dict | None = None, fault_plan=None,
                           staleness_bound: int = 0, attack_plan=None,
                           robust_agg: str = "none",
-                          slices_list=None, dcn_quant: str = "") -> list[dict]:
+                          slices_list=None, dcn_quant: str = "",
+                          epoch_kw: dict | None = None) -> list[dict]:
     """The sites-scaling sweep (``--sites``): for each virtual site count S,
     run the packed federated round on the available device mesh and emit one
     JSON record with ``sites`` / ``sites_per_chip`` / ``pack_factor`` — the
@@ -1064,7 +1169,7 @@ def measure_sites_scaling(sites_list, packs=None, obs: int = 3,
                 dims=dims, fault_plan=fault_plan,
                 staleness_bound=staleness_bound,
                 attack_plan=attack_plan, robust_agg=robust_agg,
-                slices=slices, dcn_quant=dcn_quant,
+                slices=slices, dcn_quant=dcn_quant, epoch_kw=epoch_kw,
             )
             run_chain(1)  # compile + warm up outside the timing
             pairs = [
@@ -1139,6 +1244,30 @@ def measure_sites_scaling(sites_list, packs=None, obs: int = 3,
                 rec["attacks"] = attack_plan.to_json()
             if robust_agg != "none":
                 rec["robust_agg"] = robust_agg
+            # r20 privacy composition (--sites --dp-noise / --secure-agg):
+            # the sweep records the mechanism knobs + the spent ε for the
+            # timed chain, next to the (S002-proven) wire figures
+            sigma = (epoch_kw or {}).get("dp_noise_multiplier", 0.0)
+            if sigma > 0:
+                from dinunet_implementations_tpu.privacy import (
+                    RdpAccountant,
+                    effective_noise_multiplier,
+                    sampling_fraction,
+                )
+
+                steps = (dims or {}).get("steps", STEPS_PER_EPOCH)
+                batch = (dims or {}).get("batch", BATCH_PER_SITE)
+                q = sampling_fraction(batch, 1, [steps * batch])
+                rec["dp_clip"] = (epoch_kw or {}).get("dp_clip", 0.0)
+                rec["dp_noise_multiplier"] = sigma
+                rec["epsilon_final"] = round(
+                    RdpAccountant()
+                    .step(effective_noise_multiplier(sigma), q,
+                          steps=n * steps)
+                    .epsilon(1e-5)[0], 4,
+                )
+            if (engine_kw or {}).get("secure_agg", "off") != "off":
+                rec["secure_agg"] = engine_kw["secure_agg"]
             records.append(rec)
     return records
 
@@ -1499,11 +1628,33 @@ def main():
             ]
         dcn_quant = (sys.argv[sys.argv.index("--dcn-wire-quant") + 1]
                      if "--dcn-wire-quant" in sys.argv else "")
+        # privacy composition (r20): `--dp-noise SIGMA [--dp-clip C]`
+        # threads in-scan DP-SGD through the packed round (records gain
+        # the mechanism knobs + epsilon_final) and `--secure-agg MODE`
+        # switches the engine to the masked fixed-point wire — the CI
+        # privacy smoke's path, one compiled program under --sanitize
+        epoch_kw = None
+        if "--dp-noise" in sys.argv:
+            epoch_kw = {
+                "dp_noise_multiplier": float(
+                    sys.argv[sys.argv.index("--dp-noise") + 1]
+                ),
+                "dp_clip": (
+                    float(sys.argv[sys.argv.index("--dp-clip") + 1])
+                    if "--dp-clip" in sys.argv else 1.0
+                ),
+            }
+        if "--secure-agg" in sys.argv:
+            engine_kw = {
+                **(engine_kw or {}),
+                "secure_agg": sys.argv[sys.argv.index("--secure-agg") + 1],
+            }
         for rec in measure_sites_scaling(
             sites_list, packs=packs, obs=obs, n=n, dims=dims,
             engine_name=engine_name, engine_kw=engine_kw, fault_plan=plan,
             staleness_bound=staleness, attack_plan=attack,
             robust_agg=robust, slices_list=slices_list, dcn_quant=dcn_quant,
+            epoch_kw=epoch_kw,
         ):
             print(json.dumps(rec), flush=True)
         return
@@ -1577,6 +1728,39 @@ def main():
         for rec in measure_pipeline_ab(
             mode=mode, obs=obs, n=n, dims=dims,
             donate="--no-donate" not in sys.argv,
+        ):
+            print(json.dumps(rec), flush=True)
+        return
+    if "--dp-noise" in sys.argv or "--secure-agg" in sys.argv:
+        if "--attacks" in sys.argv:
+            # without this guard the privacy branch would return before the
+            # attacks branch and the plan would be silently dropped
+            raise SystemExit(
+                "--dp-noise/--secure-agg and --attacks are separate "
+                "standalone A/B modes; compose them through the packed "
+                "sweep instead (--sites ... --attacks ... --dp-noise ...) "
+                "or run two invocations"
+            )
+        # privacy-plane A/B (r20): clean vs dp vs dp+secureagg paired
+        # interleaved arms — throughput (the clip/noise + masked-wire
+        # cost) plus the accountant's epsilon_final for the timed chain,
+        # one JSON line per arm (docs/bench_privacy_ab_r20.jsonl; regen on
+        # TPU with the same command). --secure-agg alone runs the
+        # clean-vs-masked pair. (With --sites these flags instead thread
+        # into the packed sweep — handled above.)
+        sigma = (float(sys.argv[sys.argv.index("--dp-noise") + 1])
+                 if "--dp-noise" in sys.argv else 0.0)
+        clip = (float(sys.argv[sys.argv.index("--dp-clip") + 1])
+                if "--dp-clip" in sys.argv else 1.0)
+        obs = int(sys.argv[sys.argv.index("--obs") + 1]) if "--obs" in sys.argv else 5
+        n = (int(sys.argv[sys.argv.index("--epochs") + 1])
+             if "--epochs" in sys.argv else TIMED_EPOCHS)
+        dims = SMALL_DIMS if "--small" in sys.argv else None
+        mode = (sys.argv[sys.argv.index("--secure-agg") + 1]
+                if "--secure-agg" in sys.argv else "off")
+        for rec in measure_privacy_ab(
+            dp_noise=sigma, dp_clip=clip,
+            secure_mode=mode, obs=obs, n=n, dims=dims,
         ):
             print(json.dumps(rec), flush=True)
         return

@@ -33,7 +33,7 @@ from .parallel.mesh import (
     sliced_site_mesh,
 )
 
-__version__ = "0.14.0"
+__version__ = "0.15.0"
 
 
 def __getattr__(name):
@@ -55,6 +55,10 @@ def __getattr__(name):
         from . import robustness
 
         return getattr(robustness, name)
+    if name in ("RdpAccountant", "SECURE_AGGS"):
+        from . import privacy
+
+        return getattr(privacy, name)
     if name in ("SpanTracer", "FitTelemetry"):
         from . import telemetry
 

@@ -871,6 +871,13 @@ class TraceCell:
     # S002's ICI proof and the DCN-tier check verify the same figures as
     # the fault-free sliced cells
     slice_faults: bool = False
+    # r20 privacy plane: extra make_train_epoch_fn kwargs for cells whose
+    # machinery lives in the epoch BUILDER rather than the engine
+    # (dp_clip / dp_noise_multiplier / personalize) — sorted (key, value)
+    # pairs like engine_kw; the personalize patterns also thread into the
+    # cell's state init (per-site head rows) and shrink the wire template
+    # to the shared subtree
+    epoch_kw: tuple = ()
     # free-form label suffix for cells distinguished only by engine_kw
     # (e.g. "+fused" for the Pallas power-iteration corner) — labels key
     # the semantic baseline, so they must stay unique per cell
@@ -919,6 +926,10 @@ class CellProgram:
     # hardcoded by the rule driver): 1 / 0 on unsliced cells
     slices: int = 1
     sites_per_slice: int = 0
+    # the params template the wire models charge (r20): the SHARED subtree
+    # on personalized cells — head leaves never ship, so charging them
+    # would make S002's proof vacuous — the full tree otherwise
+    wire_template: object = None
 
 
 def build_cell_inputs(cell: TraceCell, engine=None) -> tuple:
@@ -976,6 +987,7 @@ def build_cell_inputs(cell: TraceCell, engine=None) -> tuple:
         staleness_bound=cell.staleness,
         overlap_rounds=cell.overlap,
         reputation=cell.robust != "none",
+        personalize=dict(cell.epoch_kw).get("personalize", ()),
     )
     rng = np.random.default_rng(0)
     if cell.pipeline == "device":
@@ -1016,6 +1028,8 @@ def trace_cell(cell: TraceCell, engine=None) -> CellProgram:
         # slice-fault cells trace the FULL r19 machinery (mask gate +
         # quorum hold) so the wire proofs cover it
         min_slices=2 if cell.slice_faults else 1,
+        # privacy-plane cells (r20): dp / personalize live in the builder
+        **dict(cell.epoch_kw),
     )
     closed, _, comp = epoch_program_artifacts(fn, *args, compiled=cell.donate)
     S = args[1].shape[0]
@@ -1023,12 +1037,24 @@ def trace_cell(cell: TraceCell, engine=None) -> CellProgram:
     from ..parallel.mesh import slice_count
 
     slices = slice_count(mesh)
+    # personalized cells charge the SHARED subtree only — exactly what the
+    # traced program ships (trainer/steps.py _eng_grads)
+    wire_tmpl = state.params
+    pers = dict(cell.epoch_kw).get("personalize", ())
+    if pers:
+        from ..privacy.personalize import head_leaf_paths, strip_tree
+
+        wire_tmpl = strip_tree(
+            state.params, head_leaf_paths(state.params, pers),
+            keep_head=False,
+        )
     return CellProgram(
         cell=cell, engine=engine, state=state, args=args, block=block,
         audit=audit_jaxpr(closed), compiled=comp,
         path=f"trace://{cell.label}",
         slices=slices,
         sites_per_slice=S // slices if slices > 1 else 0,
+        wire_template=wire_tmpl,
     )
 
 
@@ -1222,6 +1248,36 @@ def default_matrix() -> list:
         TraceCell("dSGD", "sliced4", "device", wire_quant="int8",
                   dcn_quant="int8", slice_faults=True),
     ]
+    # secure-aggregation masked wires (r20, privacy/secure_agg.py): S002
+    # must prove the int32 grid model — the SAME dense shapes as the legacy
+    # psum at 4 B/element, the masked partial K-invariant under packing —
+    # against the traced padded program (the per-leaf amax pmax scalars are
+    # genuine collectives but carry () operands, outside payload
+    # accounting), S001 must keep the whole pad→psum chain inside the
+    # rounds scan, and the sliced cell must show the fused exact
+    # (slice, site) int32 reduce covering the DCN model with no
+    # slice-boundary re-quantization.
+    cells += [
+        TraceCell("dSGD", "mesh", "host",
+                  engine_kw=(("secure_agg", "mask"),), tag="+secureagg"),
+        TraceCell("dSGD", "fold4", "device",
+                  engine_kw=(("secure_agg", "mask"),), tag="+secureagg"),
+        TraceCell("dSGD", "vmap", "device", donate=True,
+                  engine_kw=(("secure_agg", "mask"),), tag="+secureagg"),
+        TraceCell("dSGD", "sliced", "host",
+                  engine_kw=(("secure_agg", "mask"),), tag="+secureagg"),
+    ]
+    # DP-SGD + personalized heads (r20): the mechanism/partition live in
+    # the epoch builder, not the engine — their wire impact is proven on
+    # dedicated cells below via epoch_kw (dp adds ZERO collectives; the
+    # personalized cell's wire model covers the SHARED subtree only)
+    cells += [
+        TraceCell("dSGD", "fold4", "device", tag="+dp",
+                  epoch_kw=(("dp_clip", 1.0),
+                            ("dp_noise_multiplier", 0.5))),
+        TraceCell("dSGD", "mesh", "host", tag="+personal",
+                  epoch_kw=(("personalize", ("fc_out",)),)),
+    ]
     return cells
 
 
@@ -1273,6 +1329,20 @@ IDENTITY_CASES = {
         dict(robust_agg="norm_clip", engine=dict(robust_agg="norm_clip")),
         False,
     ),
+    # privacy plane (r20): every off-form must compile the EXACT legacy
+    # program — dp_clip=dp_noise_multiplier=0 (privacy/dpsgd.py),
+    # secure_agg="off" (privacy/secure_agg.py, an engine knob) and
+    # personalize=() (privacy/personalize.py) — and each on-form must
+    # genuinely inject its machinery (the inverse gate: a dp-on program
+    # that stops diverging is a mechanism that silently stopped running,
+    # and every ε it reports is a lie)
+    "dp-off": (dict(dp_clip=0.0, dp_noise_multiplier=0.0), True),
+    "dp-on": (dict(dp_clip=1.0, dp_noise_multiplier=0.5), False),
+    "dp-clip-only": (dict(dp_clip=1.0), False),
+    "secureagg-off": (dict(engine=dict(secure_agg="off")), True),
+    "secureagg-on": (dict(engine=dict(secure_agg="mask")), False),
+    "personalize-off": (dict(personalize=()), True),
+    "personalize-on": (dict(personalize=("fc_out",)), False),
 }
 
 #: the rankDAD corner's cases — the fused power-iteration kernel only
@@ -1587,11 +1657,11 @@ def run_semantic_checks(cells=None) -> list:
                     if tuple(c.named_axes) != (SLICE_AXIS,)
                 ]
             findings += check_wire_bytes(
-                ici_colls, prog.engine, prog.state.params,
+                ici_colls, prog.engine, prog.wire_template,
                 prog.block, prog.path, stats_shapes=stats_shapes,
             )
             findings += check_precision_flow(
-                ici_colls, prog.engine, prog.state.params,
+                ici_colls, prog.engine, prog.wire_template,
                 prog.block, prog.path,
                 require_lowp_dot=(
                     cell.precision_bits == "16"
@@ -1602,7 +1672,7 @@ def run_semantic_checks(cells=None) -> list:
             )
             if cell.sliced:
                 findings += check_dcn_wire(
-                    prog.audit.collectives, prog.engine, prog.state.params,
+                    prog.audit.collectives, prog.engine, prog.wire_template,
                     prog.block, prog.sites_per_slice, prog.path,
                     stats_shapes=stats_shapes, slices=prog.slices,
                 )

@@ -393,6 +393,45 @@ class TrainConfig:
     # out at (S_live-1)/sqrt(S_live), so small cohorts need a lower z.
     reputation_z: float = 2.0
     reputation_rounds: int = 8
+    # --- privacy plane (r20, privacy/) ---------------------------------
+    # in-scan DP-SGD (privacy/dpsgd.py): dp_clip > 0 clips each site's
+    # round-gradient L2 norm to this C inside the per-site phase (before
+    # engine compression); dp_noise_multiplier > 0 then adds σ·C Gaussian
+    # noise per leaf, counter-keyed by (dp_seed, site, round) so replays
+    # are chunk/resume/packing-independent. Both 0 (default) statically
+    # compiles the mechanism out — the epoch program is bit-identical to
+    # the legacy one (S005 "dp-off"). Noise needs a clip (rejected
+    # otherwise: unbounded sensitivity has no DP guarantee).
+    dp_clip: float = 0.0
+    dp_noise_multiplier: float = 0.0
+    dp_seed: int = 0
+    # δ for the reported (ε, δ); the RDP accountant (privacy/accounting.py)
+    # surfaces ε per epoch in telemetry rows, logs.json, the report CLI and
+    # the train_epsilon /statusz gauge
+    dp_delta: float = 1e-5
+    # > 0: stop the fit cleanly once the accountant's ε reaches this budget
+    # — the epoch completes, its rotating checkpoint lands, a "dp-budget"
+    # event is recorded, and the fit proceeds to best-state test (the
+    # Preempted-style checkpointed exit, minus the nonzero exit code)
+    dp_epsilon_budget: float = 0.0
+    # secure-aggregation masked wires (privacy/secure_agg.py, dSGD only):
+    # "mask" encodes each site's weighted delta on a shared fixed-point
+    # grid and one-time-pads it with pairwise antisymmetric int32 masks
+    # that cancel EXACTLY (integer arithmetic) in the unchanged psum-shaped
+    # wire — masked == unmasked bit-exact, wire bytes unchanged
+    # (S002-proven), int8/fp8 codecs refused (float grids shred the pads;
+    # bf16 composes by pre-rounding the payload). "mask-nopads" is the
+    # pads-zeroed VERIFICATION arm the bit-exactness claim is asserted
+    # against; "off" (default) is the bit-identical legacy program
+    # (S005 "secureagg-off").
+    secure_agg: str = "off"
+    secure_agg_seed: int = 0
+    # personalized per-site heads (privacy/personalize.py): param-path
+    # substring patterns naming head leaves kept OUT of aggregation
+    # entirely — per-site head rows ride TrainState.personal (P(site),
+    # checkpointed, rejoin-reset), each site trains and evaluates its own
+    # head. () (default) compiles none of it (S005 "personalize-off").
+    personalize: tuple = ()
 
     # -- helpers ---------------------------------------------------------
 

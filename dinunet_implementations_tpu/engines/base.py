@@ -7,10 +7,13 @@ it inside the remote aggregator across ``num_reducers`` worker processes
 
 - ``init(grads) -> state`` — per-site engine state pytree (zeros; lives in
   the training state alongside optimizer state);
-- ``aggregate(grads, state, weight, axis_name, live=None) -> (agg_grads,
-  new_state)`` — maps per-site gradients to the globally-aggregated gradient
-  via collectives over the ``site`` mesh axis. ``weight`` is the site's
-  example count for this round (heterogeneous sites), so dSGD == pooled SGD.
+- ``aggregate(grads, state, weight, axis_name, live=None, rnd=None) ->
+  (agg_grads, new_state)`` — maps per-site gradients to the globally-
+  aggregated gradient via collectives over the ``site`` mesh axis.
+  ``weight`` is the site's example count for this round (heterogeneous
+  sites), so dSGD == pooled SGD. ``rnd`` (r20) is the traced global round
+  counter the trainer threads in; engines keying per-round wire material
+  off it (dSGD's secure-aggregation pads) consume it, the rest ignore it.
   ``live`` is the per-round liveness mask scalar (robustness/): 0 for a site
   that is dropped, non-finite, or quarantined this round — the engine zeroes
   that site's payload (``jnp.where``, NOT multiplication: the gradient may be
@@ -180,7 +183,13 @@ def staleness_weights(age, staleness_bound: int, staleness_decay: float):
 class Engine:
     name: str
     init: Callable  # grads -> state
-    # (grads, state, weight, axis_name, live=None) -> (agg, state).
+    # (grads, state, weight, axis_name, live=None, rnd=None) -> (agg,
+    # state). ``rnd`` (r20) is the traced GLOBAL round counter the trainer
+    # always threads in — engines that key per-round material off it
+    # (dSGD's secure-aggregation pads, privacy/secure_agg.py: masks seeded
+    # per (pair, round), so replays are chunk/resume-independent) consume
+    # it; the rest ignore it, and the legacy call shape (rnd omitted)
+    # stays valid for tests/external callers.
     # axis_name may be a str/tuple (per-member form: one site per collective
     # member, leaves unbatched) or a PackedAxis (packed form: leaves carry a
     # leading [K] virtual-site axis, reductions are two-level — see

@@ -42,7 +42,36 @@ from .base import (
 @register_engine("dSGD")
 def make_dsgd(precision_bits="32", wire_quant="none", wire_stochastic=False,
               robust_agg="none", robust_trim_frac=0.2, robust_clip_mult=2.5,
-              dcn_wire_quant="", **_unused) -> Engine:
+              dcn_wire_quant="", secure_agg="off", secure_agg_seed=0,
+              **_unused) -> Engine:
+    # secure-aggregation masked wires (r20, privacy/secure_agg.py): the
+    # dense psum exchange becomes a shared-fixed-point, one-time-padded
+    # int32 sum — composition refusals are documented + tested:
+    #  - int8/fp8 wire codecs re-quantize the psum operand through a float
+    #    grid, shredding the integer pads (bf16 composes: the PAYLOAD is
+    #    pre-rounded to bf16, the wire stays the int32 grid);
+    #  - any DCN codec would re-quantize the per-slice partial the same way
+    #    (the fused exact (slice, site) reduce is the only sliced form);
+    #  - the gather-based robust reducers need per-site payloads in the
+    #    clear (norm_clip composes — it bounds norms BEFORE masking and
+    #    keeps the psum wire).
+    from ..privacy.secure_agg import secure_agg_enabled
+
+    secure = secure_agg_enabled(secure_agg)
+    if secure and wire_quant in ("int8", "fp8"):
+        raise ValueError(
+            f"secure_agg={secure_agg!r} cannot compose with wire_quant="
+            f"{wire_quant!r}: a float codec grid on the wire destroys the "
+            "integer pad cancellation (bf16 and the plain precision_bits "
+            "wires compose — the payload pre-rounds, the wire stays int32)"
+        )
+    if secure and robust_agg in ("trimmed_mean", "coordinate_median"):
+        raise ValueError(
+            f"secure_agg={secure_agg!r} cannot compose with robust_agg="
+            f"{robust_agg!r}: the gather-based reducers need every site's "
+            "payload in the clear (norm_clip composes — it runs before "
+            "masking on the unchanged psum wire)"
+        )
     # the wire codec (parallel/collectives.py, r14): "none" keeps the legacy
     # precision_bits payload cast byte-for-byte; int8/fp8 quantize each
     # site's payload (scale-per-payload) before the collective and the
@@ -56,6 +85,18 @@ def make_dsgd(precision_bits="32", wire_quant="none", wire_stochastic=False,
     dcn = resolve_dcn_codec(
         precision_bits, wire_quant, dcn_wire_quant, wire_stochastic
     )
+    if secure:
+        if dcn_wire_quant not in ("", "none"):
+            raise ValueError(
+                f"secure_agg={secure_agg!r} cannot compose with a DCN wire "
+                f"codec (dcn_wire_quant={dcn_wire_quant!r}): re-quantizing "
+                "the per-slice int32 partial through a float grid destroys "
+                "pad cancellation — set dcn_wire_quant='none' (the fused "
+                "exact (slice, site) reduce)"
+            )
+        # ""-follows-wire_quant would inherit a bf16 DCN codec; the masked
+        # wire always takes the fused exact slice form instead
+        dcn = None
     ddtype = np.dtype(dcn.dtype) if dcn is not None else None
     if robust_agg not in ROBUST_AGGS:
         raise ValueError(
@@ -69,6 +110,12 @@ def make_dsgd(precision_bits="32", wire_quant="none", wire_stochastic=False,
     def init(grads):
         return {}
 
+    # the secure-agg wire ships the SAME dense shapes as the legacy psum,
+    # one int32 grid value per f32 element — byte-for-byte identical totals
+    # at every pack factor (the masked partial stays K-invariant), which is
+    # exactly what the +secureagg semantic cells prove (S002)
+    sdtype = np.dtype(np.int32)
+
     def wire_bytes(grads, pack: int = 1) -> int:
         # dSGD ships every gradient leaf whole, cast to the payload dtype.
         # Pack-INVARIANT: under site packing the K virtual sites' weighted
@@ -76,7 +123,8 @@ def make_dsgd(precision_bits="32", wire_quant="none", wire_stochastic=False,
         # the device ships one dense partial regardless of K. Robust gather
         # modes instead ship the device's whole [pack, ...] per-site block
         # per leaf (×pack) plus the bookkeeping gathers; norm_clip keeps the
-        # psum wire and adds only the two tiny norm/weight gathers.
+        # psum wire and adds only the two tiny norm/weight gathers. The
+        # secure-agg int32 grid matches the f32 wire byte-for-byte.
         import math
 
         extras = sum(
@@ -85,6 +133,13 @@ def make_dsgd(precision_bits="32", wire_quant="none", wire_stochastic=False,
         )
         if gather_mode:
             return pack * dense_wire_bytes(grads, itemsize) + extras
+        if secure:
+            # + the [pack] f32 liveness-vector gather (privacy/secure_agg.py
+            # _gather_live): survivors must agree on which pads to exclude,
+            # so the round's live vector is gathered like norm_clip's
+            # bookkeeping (the guarded round form — the production default)
+            return (dense_wire_bytes(grads, sdtype.itemsize) + 4 * pack
+                    + extras)
         return dense_wire_bytes(grads, itemsize) + extras
 
     def wire_shapes(grads, pack: int = 1):
@@ -92,7 +147,8 @@ def make_dsgd(precision_bits="32", wire_quant="none", wire_stochastic=False,
         # before the f32-accumulating collective (parallel/collectives.py).
         # Same shapes at every pack factor (see wire_bytes). Robust gather
         # modes list one [pack, ...] gathered block per leaf instead, plus
-        # the bookkeeping gathers. Must sum to wire_bytes (S002).
+        # the bookkeeping gathers; the secure-agg wire lists the same dense
+        # leaves at int32. Must sum to wire_bytes (S002).
         extras = robust_gather_wire(pack, robust_agg)
         if gather_mode:
             import jax
@@ -101,6 +157,11 @@ def make_dsgd(precision_bits="32", wire_quant="none", wire_stochastic=False,
                 ((pack,) + tuple(g.shape), pdtype)
                 for g in jax.tree.leaves(grads)
             ] + extras
+        if secure:
+            import numpy as _np
+
+            return (dense_wire_shapes(grads, sdtype)
+                    + [((pack,), _np.dtype(_np.float32))] + extras)
         return dense_wire_shapes(grads, pdtype) + extras
 
     def dcn_wire_shapes(grads, pack: int = 1, sites_per_slice: int = 1):
@@ -123,6 +184,16 @@ def make_dsgd(precision_bits="32", wire_quant="none", wire_stochastic=False,
                 ((sites_per_slice,) + tuple(g.shape), d)
                 for g in jax.tree.leaves(grads)
             ] + extras
+        if secure:
+            # fused exact (slice, site) reduce: the per-slice partial
+            # crosses DCN on the int32 grid, never re-quantized; + the
+            # liveness gather's slice leg (the slice's assembled
+            # [sites_per_slice] f32 vector, like norm_clip's bookkeeping)
+            import numpy as _np
+
+            return (dense_wire_shapes(grads, sdtype)
+                    + [((sites_per_slice,), _np.dtype(_np.float32))]
+                    + extras)
         if ddtype is not None:
             total = sum(
                 math.prod(g.shape) for g in jax.tree.leaves(grads)
@@ -133,7 +204,7 @@ def make_dsgd(precision_bits="32", wire_quant="none", wire_stochastic=False,
     def dcn_bytes(grads, pack: int = 1, sites_per_slice: int = 1) -> int:
         return wire_shapes_bytes(dcn_wire_shapes(grads, pack, sites_per_slice))
 
-    def aggregate(grads, state, weight, axis_name, live=None):
+    def aggregate(grads, state, weight, axis_name, live=None, rnd=None):
         # dead/quarantined sites: payload zeroed, weight zeroed — the
         # weighted mean renormalizes over live weight only (robustness/).
         # Buffered-async rounds (engines/base.py, r13): `grads` is each
@@ -182,6 +253,27 @@ def make_dsgd(precision_bits="32", wire_quant="none", wire_stochastic=False,
                 payload,
             )
             return payload_uncast(agg, grads), state
+        if secure:
+            # secure-aggregation masked wire (r20, privacy/secure_agg.py):
+            # the payload round-trips the configured PAYLOAD dtype first
+            # (bf16 / precision_bits compose by narrowing what the grid
+            # encodes — the wire itself is the int32 grid), then the
+            # one-time-padded fixed-point weighted mean runs through the
+            # engine's unchanged psum shape. Masks are keyed per (pair,
+            # round) from the traced round counter.
+            from ..privacy.secure_agg import masked_weighted_mean
+
+            payload = jax.tree.map(
+                lambda g: codec.compress(g, batched=packed_ax), grads
+            )
+            agg = masked_weighted_mean(
+                payload, weight, axis_name,
+                # factory kwarg, never a tracer: the static config seed
+                seed=int(secure_agg_seed),  # jaxlint: disable=R005
+                rnd=rnd, live=live,
+                pads=secure_agg != "mask-nopads",  # jaxlint: disable=R005
+            )
+            return payload_uncast(agg, grads), state
         if codec.quant == "none":
             # legacy precision_bits wire, program-identical to pre-r14
             # (S005-gated: the disabled codec must compile the exact legacy
@@ -209,6 +301,9 @@ def make_dsgd(precision_bits="32", wire_quant="none", wire_stochastic=False,
         return payload_uncast(agg, grads), state
 
     return Engine("dSGD", init, aggregate, wire_bytes=wire_bytes,
-                  wire_shapes=wire_shapes, wire_dtype=pdtype,
+                  wire_shapes=wire_shapes,
+                  # the masked wire carries the int32 grid, not the float
+                  # payload dtype — telemetry/S004 fallbacks must say so
+                  wire_dtype=sdtype if secure else pdtype,
                   dcn_bytes=dcn_bytes, dcn_wire_shapes=dcn_wire_shapes,
                   dcn_dtype=ddtype)

@@ -86,8 +86,21 @@ def make_rankdad(
     robust_trim_frac=0.2,
     robust_clip_mult=2.5,
     dcn_wire_quant="",
+    secure_agg="off",
     **_unused,
 ) -> Engine:
+    # secure-aggregation masked wires (r20) are a dense-psum construct:
+    # this engine ships low-rank factor GATHERS — per-site payloads in the
+    # clear by design — so the mode is refused, not silently ignored
+    # (privacy/secure_agg.py; dSGD is the masked-wire engine)
+    from ..privacy.secure_agg import secure_agg_enabled
+
+    if secure_agg_enabled(secure_agg):
+        raise ValueError(
+            f"secure_agg={secure_agg!r} is only supported by the dSGD "
+            "engine: the low-rank engines gather per-site factors, which "
+            "a masked psum wire cannot carry"
+        )
     if robust_agg not in ROBUST_AGGS:
         raise ValueError(
             f"robust_agg must be one of {ROBUST_AGGS}, got {robust_agg!r}"
@@ -226,7 +239,7 @@ def make_rankdad(
     def dcn_bytes(grads, pack: int = 1, sites_per_slice: int = 1) -> int:
         return wire_shapes_bytes(dcn_wire_shapes(grads, pack, sites_per_slice))
 
-    def aggregate(grads, state, weight, axis_name, live=None):
+    def aggregate(grads, state, weight, axis_name, live=None, rnd=None):
         # Dead-site round: G zeroed (NaN-safe where) + weight zeroed — the
         # site still factorizes (same program, no recompile) but its Q·scale
         # payload is 0, so the gathered reconstruction is the live sites'

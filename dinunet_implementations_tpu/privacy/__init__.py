@@ -1,0 +1,51 @@
+"""Privacy plane (r20) — the scenario axis the source system exists for.
+
+The reference trains across hospitals *without centralizing patient data*;
+this package adds the machinery that makes that claim quantitative, built
+the way every other scenario shipped (faults r7, packing r12, attacks r17):
+traced, retrace-free program inputs over the site axis, statically compiled
+out when off (S005-gated), with wire costs proven by S002 rather than
+asserted.
+
+- :mod:`.dpsgd` — per-site DP-SGD inside the rounds scan: gradient clipping
+  + calibrated Gaussian noise, counter-keyed by ``(seed, site, round)`` so
+  replays are chunk/resume/packing-independent;
+- :mod:`.accounting` — the host-side RDP accountant (subsampled-Gaussian
+  moments) surfacing (ε, δ) per epoch in telemetry/logs/report/statusz,
+  with a clean checkpointed stop at ``dp_epsilon_budget``;
+- :mod:`.secure_agg` — secure-aggregation masked wires for dSGD: pairwise
+  antisymmetric one-time pads over the site axis on a shared fixed-point
+  grid, canceling EXACTLY (integer arithmetic) in the weighted site sum;
+- :mod:`.personalize` — FedProx-style personalized per-site heads: a
+  param-path partition mask keeps designated leaves out of aggregation
+  entirely; per-site head rows ride ``TrainState.personal`` P(site)-sharded
+  like health.
+"""
+
+from .accounting import (
+    RdpAccountant,
+    effective_noise_multiplier,
+    sampling_fraction,
+)
+from .dpsgd import dp_enabled, make_dp_fn
+from .personalize import (
+    head_leaf_paths,
+    merge_head,
+    personal_row_template,
+    strip_tree,
+)
+from .secure_agg import SECURE_AGGS, secure_agg_enabled
+
+__all__ = [
+    "RdpAccountant",
+    "SECURE_AGGS",
+    "dp_enabled",
+    "effective_noise_multiplier",
+    "head_leaf_paths",
+    "make_dp_fn",
+    "merge_head",
+    "personal_row_template",
+    "sampling_fraction",
+    "secure_agg_enabled",
+    "strip_tree",
+]

@@ -1,0 +1,107 @@
+"""In-scan DP-SGD — per-site clipping + calibrated Gaussian noise.
+
+The transform runs inside the per-site phase of the rounds scan
+(trainer/steps.py ``site_micro``), on the site's finished round gradient,
+BEFORE any engine compression and before a hostile site's AttackPlan
+transform (an attacker lies about what it ships; an honest site's DP
+mechanism runs first): clip the gradient's global L2 norm to
+``dp_clip`` (C), then add ``dp_noise_multiplier·C`` (σ·C) of Gaussian noise
+per leaf. What leaves the site — the engine payload, dense or factored —
+is then a bounded-sensitivity, noised quantity; the accountant
+(privacy/accounting.py) converts the (σ, q, rounds) trajectory to (ε, δ)
+— composing at the CONSERVATIVE effective multiplier σ/2, because this
+mechanism clips the round-MEAN gradient (record-level sensitivity of
+clip(mean) is 2C), not the textbook per-example-clipped sum
+(accounting.py MEAN_CLIP_SENSITIVITY_FACTOR).
+
+Determinism contract (the AttackPlan-noise pattern, robustness/attacks.py):
+noise is drawn from counter-based keys ``fold_in(fold_in(fold_in(
+PRNGKey(dp_seed), site), round), leaf)`` — ``site`` the GLOBAL virtual site
+id (``jax.lax.axis_index`` over the bound site axes, identical under
+packing and the vmap fold) and ``round`` the global round counter — so the
+noise replays bit-identically regardless of epoch chunking, resume point,
+or site-packing factor.
+
+Off-state contract: ``dp_clip == 0 and dp_noise_multiplier == 0`` builds no
+transform at all — the epoch program is lowering-identical to the legacy
+one (S005 "dp-off", checks/semantic.py). Noise without clipping has no
+finite sensitivity, hence no DP guarantee: ``dp_noise_multiplier > 0``
+REQUIRES ``dp_clip > 0`` (rejected at build). Clipping alone
+(``dp_noise_multiplier == 0``) is allowed — a robustness transform with
+ε = ∞, reported as such.
+
+Personalized heads (privacy/personalize.py): leaves named by the partition
+mask never leave the site, so the mechanism skips them — the clip norm is
+computed over, and noise added to, the SHARED (shipped) leaves only.
+"""
+
+from __future__ import annotations
+
+
+def dp_enabled(dp_clip: float, dp_noise_multiplier: float) -> bool:
+    """Whether the DP transform exists in the program (trace-time static)."""
+    if float(dp_noise_multiplier) < 0.0:
+        raise ValueError(
+            f"dp_noise_multiplier must be >= 0, got {dp_noise_multiplier}"
+        )
+    if float(dp_clip) < 0.0:
+        raise ValueError(f"dp_clip must be >= 0, got {dp_clip}")
+    if float(dp_noise_multiplier) > 0.0 and float(dp_clip) <= 0.0:
+        raise ValueError(
+            "dp_noise_multiplier > 0 needs dp_clip > 0: noise without a "
+            "clipped sensitivity carries no DP guarantee (set dp_clip)"
+        )
+    return float(dp_clip) > 0.0
+
+
+def make_dp_fn(dp_clip: float, dp_noise_multiplier: float, dp_seed: int = 0,
+               skip_paths: frozenset = frozenset()):
+    """Build the traced per-site DP transform, or ``None`` when off.
+
+    Returns ``dp(site_grad, rnd, site_ix) -> site_grad`` on ONE site's
+    (unbatched) gradient pytree: ``rnd`` the global round counter,
+    ``site_ix`` the global virtual site id — both traced; the clip norm and
+    noise scale are trace-time statics closed over from the config.
+    ``skip_paths`` names personalized-head leaves (tuple-of-keys paths,
+    privacy/personalize.py) excluded from both the clip norm and the noise
+    — they never ship, so the mechanism has nothing to protect there."""
+    if not dp_enabled(dp_clip, dp_noise_multiplier):
+        return None
+    import jax
+    import jax.numpy as jnp
+
+    clip = float(dp_clip)
+    sigma = float(dp_noise_multiplier)
+    seed = int(dp_seed)
+
+    def dp(site_grad, rnd, site_ix):
+        from .personalize import leaf_path_of
+
+        leaves_p, treedef = jax.tree_util.tree_flatten_with_path(site_grad)
+        shared = [
+            (i, kp, g) for i, (kp, g) in enumerate(leaves_p)
+            if leaf_path_of(kp) not in skip_paths
+        ]
+        gsq = jnp.zeros((), jnp.float32)
+        for _, _, g in shared:
+            gsq = gsq + jnp.sum(jnp.square(g.astype(jnp.float32)))
+        norm = jnp.sqrt(gsq)
+        # multiplicative clip: min(1, C/‖g‖); the max() guard keeps a zero
+        # gradient at scale 1 instead of 0/0
+        scale = jnp.minimum(1.0, clip / jnp.maximum(norm, 1e-30))
+        out = [g for _, g in leaves_p]
+        if sigma > 0.0:
+            key = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(seed), site_ix), rnd
+            )
+        for i, _, g in shared:
+            v = (g.astype(jnp.float32) * scale)
+            if sigma > 0.0:
+                v = v + sigma * clip * jax.random.normal(
+                    jax.random.fold_in(key, i), g.shape, jnp.float32
+                )
+            out[i] = v.astype(g.dtype)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    return dp
+

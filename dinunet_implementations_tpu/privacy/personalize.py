@@ -1,0 +1,164 @@
+"""Personalized per-site heads — a param-path partition mask (FedProx-style).
+
+``TrainConfig.personalize`` names head leaves by path-substring patterns
+(e.g. ``("fc_out",)`` for MSANNet's classifier, ``("cls_fc3",)`` for the
+ICA-LSTM head). Matched leaves are PARTITIONED OUT of aggregation entirely:
+
+- the global ``TrainState.params`` tree keeps its full structure (optimizer
+  state and checkpoints stay schema-stable), but matched leaves FREEZE at
+  init — the aggregated gradient carries exact zeros there, so Adam's
+  moments stay zero and the global copy never moves;
+- each site's REAL head lives in ``TrainState.personal`` — ``{"params":
+  head-subtree with [S, ...] leaves, "opt": per-site optimizer state}`` —
+  sharded ``P(site)`` like health, checkpointed (R006 covers the field),
+  rejoin-reset via ``reset_slot_state`` (a new generation restarts from
+  the CURRENT global head copy with a fresh optimizer row, never a
+  previous tenant's personalized one), and donation-safe distinct
+  buffers;
+- the per-site forward runs on ``merge_head(global, personal_row)``; the
+  head gradient updates the site's own row with its own optimizer instance
+  (same optimizer family/learning rate as the global one), gated on the
+  round's contribute mask exactly like engine state — a dead site's head
+  freezes;
+- engines aggregate (and model wire bytes for) the SHARED subtree only —
+  the head bytes leave the wire entirely, proven by S002 when a
+  personalized cell is traced;
+- eval is per-site by construction: ``make_eval_fn`` merges each site's row
+  before the forward, and the per-site scores land in each
+  ``local{i}/logs.json`` via the existing per-site test metrics.
+
+``personalize=()`` (default) builds none of this — the epoch program is
+lowering-identical to the legacy one (S005 "personalize-off").
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def leaf_path_of(keypath) -> tuple:
+    """THE jax-keypath → tuple-of-string-keys normalizer every privacy/
+    membership consumer shares (dpsgd's skip paths, the rejoin head
+    lookup) — one definition, so path matching cannot drift between
+    modules."""
+    out = []
+    for k in keypath:
+        out.append(str(getattr(k, "key", getattr(k, "idx", k))))
+    return tuple(out)
+
+
+_path_of = leaf_path_of
+
+
+def head_leaf_paths(params, patterns) -> frozenset:
+    """The partition mask: leaf paths (tuples of keys) whose "/"-joined form
+    contains any pattern substring. Rejects a mask that matches nothing
+    (silent no-op) or everything (no shared model left to federate)."""
+    patterns = tuple(p for p in patterns if p)
+    if not patterns:
+        return frozenset()
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    all_paths = [_path_of(kp) for kp, _ in leaves]
+    hit = frozenset(
+        p for p in all_paths if any(pat in "/".join(p) for pat in patterns)
+    )
+    if not hit:
+        raise ValueError(
+            f"personalize patterns {patterns} match no parameter leaf "
+            f"(have e.g. {['/'.join(p) for p in all_paths[:6]]})"
+        )
+    if len(hit) == len(all_paths):
+        raise ValueError(
+            f"personalize patterns {patterns} match EVERY parameter leaf — "
+            "nothing would be federated"
+        )
+    return hit
+
+
+def strip_tree(tree, paths: frozenset, keep_head: bool):
+    """The head subtree (``keep_head=True``) or the shared subtree
+    (``keep_head=False``) of a params-shaped tree, as a nested dict
+    containing only the kept leaves — empty branches pruned, so the engine
+    and wire models see exactly the shipped structure."""
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out: dict = {}
+    for kp, leaf in leaves:
+        path = _path_of(kp)
+        if (path in paths) != keep_head:
+            continue
+        node = out
+        for k in path[:-1]:
+            node = node.setdefault(k, {})
+        node[path[-1]] = leaf
+    return out
+
+
+def merge_head(full_tree, head_subtree):
+    """Full params with the head subtree's leaves swapped in (one site's
+    row). The subtree's nesting mirrors :func:`strip_tree`'s output."""
+    leaves = jax.tree_util.tree_flatten_with_path(head_subtree)[0]
+    merged = full_tree
+    for kp, leaf in leaves:
+        merged = _set_path(merged, _path_of(kp), leaf)
+    return merged
+
+
+def _set_path(tree, path: tuple, leaf):
+    if len(path) == 1:
+        return {**tree, path[0]: leaf}
+    return {**tree, path[0]: _set_path(tree[path[0]], path[1:], leaf)}
+
+
+def zero_head(full_tree, paths: frozenset):
+    """Full tree with head leaves replaced by zeros — the aggregated
+    gradient's form, so the global optimizer provably never moves the
+    frozen global head copies (zero grad → zero Adam moments → zero
+    update)."""
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(full_tree)
+    out = [
+        jnp.zeros_like(leaf) if _path_of(kp) in paths else leaf
+        for kp, leaf in leaves
+    ]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def graft_shared(full_template, shared_subtree, paths: frozenset):
+    """Rebuild a full-structure tree from the engine's SHARED-subtree
+    aggregate: shared leaves from the aggregate, head leaves zero (see
+    :func:`zero_head`)."""
+    import jax.numpy as jnp
+
+    shared_leaves = {
+        _path_of(kp): leaf
+        for kp, leaf in jax.tree_util.tree_flatten_with_path(shared_subtree)[0]
+    }
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(full_template)
+    out = []
+    for kp, leaf in leaves:
+        path = _path_of(kp)
+        out.append(
+            jnp.zeros_like(leaf) if path in paths
+            else shared_leaves[path].astype(leaf.dtype)
+        )
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def personal_row_template(params, paths: frozenset, optimizer):
+    """One site's fresh personal state: the head subtree (initialized from
+    the global init, so personalization starts from the common model) plus
+    its own optimizer state. Stacked per site by
+    :func:`default_personal`."""
+    head = strip_tree(params, paths, keep_head=True)
+    return {"params": head, "opt": optimizer.init(head)}
+
+
+def default_personal(num_sites: int, params, paths: frozenset, optimizer):
+    """Fresh ``TrainState.personal``: every leaf stacked to the ``[S, ...]``
+    per-site axis — distinct arrays, so state donation never aliases a
+    buffer twice."""
+    import jax.numpy as jnp
+
+    row = personal_row_template(params, paths, optimizer)
+    return jax.tree.map(lambda a: jnp.stack([a] * num_sites), row)

@@ -1,0 +1,222 @@
+"""Secure-aggregation masked wires — pairwise antisymmetric one-time pads
+over the site axis, canceling EXACTLY in the weighted site sum.
+
+Why fixed point: real secure aggregation (Bonawitz et al.) operates in
+``ℤ_R`` for a reason — floating-point addition is not associative, so float
+pads can never cancel bit-exactly through a reduction. This module keeps
+that structure: each site's weighted delta ``y_s = scale_s·g_s`` is encoded
+onto a SHARED power-of-two fixed-point grid (per payload leaf, per round),
+masked by int32 pads that are antisymmetric per unordered pair
+(``pad(i,j) = −pad(j,i)``, drawn from counter keys ``(seed, i, j, round,
+leaf)``), and summed by the engine's UNCHANGED psum-shaped collective —
+int32 arithmetic wraps mod 2³², where pad cancellation is exact in ANY
+reduction order. Decoding the summed grid value is a cast and a
+power-of-two multiply. Consequences, all tested:
+
+- **masked == unmasked bit-exact**: ``secure_agg="mask"`` and the
+  pads-zeroed verification arm (``"mask-nopads"``) produce BIT-IDENTICAL
+  trajectories at any liveness pattern, topology, or pack factor — the
+  pads provably never touch the result (tests/test_privacy.py; the CI
+  smoke asserts params sha256 equality).
+- **wire bytes unchanged**: the wire carries one int32 grid value per f32
+  element — 4 bytes either way, K-invariant under packing exactly like the
+  legacy psum partial; S002 proves the int32 model against the traced
+  program on the ``+secureagg`` matrix cells. On the wire the masked value
+  is ``q + pad mod 2³²`` with full-range uniform pads — a one-time pad;
+  only the per-leaf magnitude scale (a cross-site max) is public, exactly
+  like the quantized-wire codecs' scale scalar.
+- **dead sites renormalize**: pads are gated per PAIR on the round's
+  liveness (both partners exclude a pair with a dead member — every member
+  knows the traced liveness vector, gathered like norm_clip's bookkeeping),
+  so cancellation is exact over the SURVIVING masked cohort and the
+  weighted mean renormalizes over live weight per the existing contract.
+- **codec refusal**: int8/fp8 wire codecs re-quantize the psum operand
+  through a float grid — that would shred the integer pads, so the
+  combination is REFUSED at engine construction (tested); "bf16" (and
+  ``precision_bits="16"``) compose by rounding the PAYLOAD to bf16 before
+  fixed-point encoding (the wire itself stays the int32 grid). The DCN
+  tier must stay the fused exact form: any ``dcn_wire_quant`` codec is
+  refused too.
+
+The mode itself is NOT value-identical to the legacy float program — the
+fixed-point grid quantizes the aggregate to ``~2^-fb`` of each leaf's
+cross-site amax (``fb = 30 − ⌈log2 S⌉`` fractional bits, so the int32 sum
+cannot overflow at S sites) — which is why the ICA hard-SNR golden floor is
+re-measured under the full privacy stack (tests/test_golden.py) instead of
+asserted by identity. ``secure_agg="off"`` lowers the bit-identical legacy
+program (S005 "secureagg-off").
+"""
+
+from __future__ import annotations
+
+import math
+
+#: accepted TrainConfig.secure_agg values. "off" keeps the legacy program
+#: byte-for-byte (S005-gated). "mask" is the real mode. "mask-nopads" is the
+#: VERIFICATION arm: the identical fixed-point program with the pads zeroed
+#: — the masked==unmasked bit-exactness claim is asserted by comparing fits
+#: of the two (CI privacy smoke, tests/test_privacy.py); never deploy it.
+SECURE_AGGS = ("off", "mask", "mask-nopads")
+
+
+def secure_agg_enabled(secure_agg: str) -> bool:
+    if secure_agg not in SECURE_AGGS:
+        raise ValueError(
+            f"secure_agg must be one of {SECURE_AGGS}, got {secure_agg!r}"
+        )
+    return secure_agg != "off"
+
+
+def fraction_bits(total_sites: int) -> int:
+    """Fixed-point fractional bits for an S-site cohort: the sum of S grid
+    values bounded by ±2^fb must stay inside int32, so
+    ``fb = 30 − ⌈log2 S⌉`` (floored at 8 — a cohort past ~4M sites has
+    bigger problems than grid resolution)."""
+    s = max(int(total_sites), 1)
+    return max(30 - math.ceil(math.log2(max(s, 2))), 8)
+
+
+def _global_site_ids(axis_name):
+    """Global virtual site ids for this member's rows: a scalar under the
+    classic vmapped axes, the ``[K]`` id vector under a PackedAxis — the
+    same device-major order every other per-site input uses."""
+    import jax.numpy as jnp
+
+    from ..parallel.collectives import PackedAxis, site_index
+
+    if isinstance(axis_name, PackedAxis):
+        return site_index(axis_name) + jnp.arange(axis_name.pack)
+    return site_index(axis_name)
+
+
+def _site_max(local, axis_name):
+    """Cross-site max of a per-member scalar (exact — max is associative):
+    the shared grid scale must be identical on every member."""
+    import jax
+
+    from ..parallel.collectives import PackedAxis
+
+    if isinstance(axis_name, PackedAxis):
+        if axis_name.name is None:
+            return local
+        return jax.lax.pmax(local, axis_name.reduce_axes())
+    return jax.lax.pmax(local, axis_name)
+
+
+def _gather_live(live, axis_name, total: int):
+    """The round's ``[S]`` liveness vector, known to every member (the
+    secure-agg dropout contract: survivors must agree on which pads to
+    exclude). ``None`` live = all-live, no gather (and no extra wire)."""
+    import jax.numpy as jnp
+
+    from ..parallel.collectives import site_all_gather
+
+    if live is None:
+        return None
+    vec = jnp.asarray(live, jnp.float32)
+    if vec.ndim == 0:
+        vec = vec[None]
+    return site_all_gather(vec, axis_name).reshape(total)
+
+
+def _pair_pads(shape, leaf_ix: int, s_ix, live_all, seed: int, rnd,
+               total: int):
+    """One site's summed pairwise pads for one leaf: ``Σ_{j>s} P(s,j) −
+    Σ_{j<s} P(j,s)`` in int32 wraparound arithmetic, each ``P`` drawn
+    full-range uniform from the counter key ``(seed, min, max, round,
+    leaf)``. A ``lax.fori_loop`` over partners keeps the program size
+    O(1) in the cohort size. ``live_all`` gates each pair on BOTH members'
+    liveness (None = all live)."""
+    import jax
+    import jax.numpy as jnp
+
+    base = jax.random.fold_in(jax.random.PRNGKey(seed), leaf_ix)
+    s = jnp.asarray(s_ix, jnp.int32)
+
+    def body(j, acc):
+        lo = jnp.minimum(s, j)
+        hi = jnp.maximum(s, j)
+        key = jax.random.fold_in(
+            jax.random.fold_in(jax.random.fold_in(base, lo), hi), rnd
+        )
+        bits = jax.lax.bitcast_convert_type(
+            jax.random.bits(key, shape, jnp.uint32), jnp.int32
+        )
+        sign = jnp.where(j > s, jnp.int32(1),
+                         jnp.where(j < s, jnp.int32(-1), jnp.int32(0)))
+        if live_all is not None:
+            gate = (live_all[s] > 0) & (live_all[j] > 0)
+            sign = jnp.where(gate, sign, jnp.int32(0))
+        return acc + sign * bits
+
+    return jax.lax.fori_loop(
+        0, total, body, jnp.zeros(shape, jnp.int32)
+    )
+
+
+def masked_weighted_mean(tree, weight, axis_name, seed: int, rnd, live=None,
+                         pads: bool = True):
+    """The secure-aggregation replacement for
+    :func:`~..parallel.collectives.site_weighted_mean` on dSGD's dense
+    exchange: weighted deltas fixed-point-encoded on a shared per-leaf grid,
+    pad-masked, summed through the engine's unchanged psum-shaped collective
+    (int32 on the wire), decoded after. Dead sites arrive zero-weighted
+    (mask_dead_site upstream) and pad-excluded; the scale renormalizes over
+    live weight exactly like the legacy mean. ``rnd`` is the traced global
+    round counter (mask keys are chunk/resume-independent); ``pads=False``
+    is the "mask-nopads" verification arm — the IDENTICAL program with the
+    pad accumulator zeroed."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.collectives import (
+        PackedAxis,
+        _bcast,
+        site_count,
+        site_weight_scale,
+        two_level_psum,
+    )
+
+    if rnd is None:
+        raise ValueError(
+            "secure aggregation needs the traced round counter (rnd=) — "
+            "masks are keyed per (pair, round)"
+        )
+    total = site_count(axis_name)
+    fb = fraction_bits(total)
+    packed = isinstance(axis_name, PackedAxis)
+    scale = site_weight_scale(weight, axis_name)
+    ids = _global_site_ids(axis_name)
+    live_all = _gather_live(live, axis_name, total)
+
+    leaves, treedef = jax.tree.flatten(tree)
+    out = []
+    for leaf_ix, g in enumerate(leaves):
+        y = g.astype(jnp.float32)
+        y = y * _bcast(scale, y) if packed else y * scale
+        # shared power-of-two grid: exp2(ceil(log2 amax)) ≥ amax, so
+        # |y/Δ| ≤ 2^fb; all-zero / non-finite amax falls back to 1.0 (the
+        # codec's guard — never a 0/0)
+        amax = _site_max(jnp.max(jnp.abs(y)), axis_name)
+        ok = jnp.isfinite(amax) & (amax > 0)
+        ex = jnp.where(ok, jnp.exp2(jnp.ceil(jnp.log2(jnp.where(ok, amax, 1.0)))), 1.0)
+        delta = ex * jnp.float32(2.0 ** -fb)
+        q = jnp.round(y / delta).astype(jnp.int32)
+        if pads:
+            if packed:
+                pad = jax.vmap(
+                    lambda s: _pair_pads(
+                        g.shape[1:], leaf_ix, s, live_all, seed, rnd, total
+                    )
+                )(ids)
+            else:
+                pad = _pair_pads(
+                    g.shape, leaf_ix, ids, live_all, seed, rnd, total
+                )
+            q = q + pad
+        if packed:
+            tot = two_level_psum(q, axis_name)
+        else:
+            tot = jax.lax.psum(q, axis_name)
+        out.append(tot.astype(jnp.float32) * delta)
+    return jax.tree.unflatten(treedef, out)

@@ -286,7 +286,22 @@ def reset_slot_state(state, slot: int, engine=None):
     from ..engines.base import ASYNC_NEVER_AGE
 
     if engine is not None and state.engine_state is not None:
-        fresh = engine.init(state.params)
+        init_tmpl = state.params
+        if getattr(state, "personal", None) is not None:
+            # personalized runs (r20): engine state was built on the
+            # SHARED subtree (head leaves never reach the engine), so the
+            # fresh row must be too — a full-tree init would mismatch the
+            # carried structure and fail the row surgery
+            from ..privacy.personalize import strip_tree
+
+            init_tmpl = strip_tree(
+                state.params,
+                frozenset(p for p, _ in _leaf_paths(
+                    state.personal["params"]
+                )),
+                keep_head=False,
+            )
+        fresh = engine.init(init_tmpl)
         state = state.replace(engine_state=jax.tree.map(
             lambda leaf, row: _set_row(leaf, slot, row),
             state.engine_state, fresh,
@@ -310,7 +325,48 @@ def reset_slot_state(state, slot: int, engine=None):
         bufs["weight"] = _set_row(bufs["weight"], slot, 0.0)
         bufs["age"] = _set_row(bufs["age"], slot, ASYNC_NEVER_AGE)
         state = state.replace(buffers=bufs)
+    if getattr(state, "personal", None) is not None:
+        # personalized head rows (r20, privacy/personalize.py): a rejoining
+        # site starts its new generation from the CURRENT global head copy
+        # (the common model), never a previous tenant's personalized one —
+        # and with a fresh optimizer row. The cohort's privacy ledger (the
+        # RDP accountant, trainer-side) is untouched: ε is a property of
+        # the mechanism's history, not of any slot's state.
+        from ..privacy.personalize import strip_tree
+
+        head_paths = frozenset(
+            p for p, _ in _leaf_paths(state.personal["params"])
+        )
+        fresh_head = strip_tree(
+            state.params,
+            frozenset(head_paths), keep_head=True,
+        )
+        personal = dict(state.personal)
+        personal["params"] = jax.tree.map(
+            lambda leaf, row: _set_row(leaf, slot, row),
+            personal["params"], fresh_head,
+        )
+        personal["opt"] = jax.tree.map(
+            lambda leaf: _set_row(
+                leaf, slot, jnp.zeros(leaf.shape[1:], leaf.dtype)
+            ),
+            personal["opt"],
+        )
+        state = state.replace(personal=personal)
     return state
+
+
+def _leaf_paths(tree):
+    """(path-tuple, leaf) pairs in the ONE shared path convention
+    (privacy/personalize.py leaf_path_of)."""
+    import jax
+
+    from ..privacy.personalize import leaf_path_of
+
+    return [
+        (leaf_path_of(kp), leaf)
+        for kp, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]
+    ]
 
 
 def move_slot_state(state, src: int, dst: int, engine=None):
@@ -330,6 +386,12 @@ def move_slot_state(state, src: int, dst: int, engine=None):
         state = state.replace(telemetry=mv(state.telemetry))
     if state.buffers is not None:
         state = state.replace(buffers=mv(state.buffers))
+    if getattr(state, "personal", None) is not None:
+        # personalized head rows (r20) move WITH their site: the same
+        # incarnation keeps its own trained head + optimizer moments at the
+        # new slot (the src reset below then clears the vacated row, so no
+        # site ever inherits another tenant's head)
+        state = state.replace(personal=mv(state.personal))
     return reset_slot_state(state, src, engine=engine)
 
 

@@ -211,6 +211,38 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fused Pallas power-iteration kernel for the "
                         "rankDAD subspace iteration (default auto: on for "
                         "the TPU backend; ops/poweriter_pallas.py)")
+    p.add_argument("--dp-clip", type=float, default=None, metavar="C",
+                   help="privacy plane (r20, privacy/dpsgd.py): clip each "
+                        "site's round-gradient L2 norm to C inside the "
+                        "rounds scan (before engine compression); 0 = off")
+    p.add_argument("--dp-noise", type=float, default=None, metavar="SIGMA",
+                   help="DP-SGD noise multiplier σ: adds σ·C Gaussian "
+                        "noise per site per round, counter-keyed by "
+                        "(dp_seed, site, round). Needs --dp-clip > 0. The "
+                        "RDP accountant surfaces (ε, δ) per epoch in "
+                        "telemetry, logs.json, the report CLI and the "
+                        "train_epsilon /statusz gauge")
+    p.add_argument("--dp-epsilon-budget", type=float, default=None,
+                   metavar="EPS",
+                   help="stop the fit cleanly (checkpointed, best-state "
+                        "test still runs) once the accountant's ε reaches "
+                        "this budget; 0 = unbounded")
+    p.add_argument("--secure-agg", default=None,
+                   choices=["off", "mask", "mask-nopads"],
+                   help="secure-aggregation masked wires (r20, "
+                        "privacy/secure_agg.py, dSGD only): 'mask' "
+                        "one-time-pads each site's fixed-point delta with "
+                        "pairwise antisymmetric int32 masks that cancel "
+                        "EXACTLY in the unchanged psum wire; "
+                        "'mask-nopads' is the pads-zeroed verification "
+                        "arm (bit-identical params — the CI smoke asserts "
+                        "it). Refuses int8/fp8 wire codecs")
+    p.add_argument("--personalize", default=None, metavar="PATTERNS",
+                   help="personalized per-site heads (r20, "
+                        "privacy/personalize.py): comma-separated "
+                        "param-path substrings (e.g. 'cls_fc3' for the "
+                        "ICA-LSTM classifier) kept OUT of aggregation — "
+                        "each site trains and evaluates its own head row")
     p.add_argument("--set", dest="overrides", action="append", default=[],
                    metavar="KEY=VALUE",
                    help="override any TrainConfig / task-args field "
@@ -241,6 +273,14 @@ def main(argv: list[str] | None = None) -> int:
         ("fused_poweriter", (
             None if args.fused_poweriter in (None, "auto")
             else args.fused_poweriter == "on"
+        )),
+        ("dp_clip", args.dp_clip),
+        ("dp_noise_multiplier", args.dp_noise),
+        ("dp_epsilon_budget", args.dp_epsilon_budget),
+        ("secure_agg", args.secure_agg),
+        ("personalize", (
+            None if args.personalize is None
+            else tuple(p for p in args.personalize.split(",") if p)
         )),
     ):
         if val is not None:

@@ -155,6 +155,21 @@ def render_fit(dirpath: str) -> None:
             f"update‖·‖ last={_norm(last.get('update_sq_last', 0)):.5f} · "
             f"prefetch_stall_s={summary.get('prefetch_stall_s', 'n/a')}"
         )
+        # privacy plane (r20): the spent (ε, δ) trail — rendered whenever
+        # the manifest says a DP mechanism ran, so a noiseless/off run
+        # stays terse
+        priv = manifest.get("privacy")
+        if priv and priv.get("dp_noise_multiplier", 0) > 0:
+            eps = last.get("dp_epsilon")
+            eps_s = "inf" if eps is None else f"{float(eps):.4f}"
+            print(
+                f"-- privacy: ε={eps_s} at δ={priv.get('dp_delta')} "
+                f"(σ={priv.get('dp_noise_multiplier')}, "
+                f"C={priv.get('dp_clip')}, "
+                f"budget={priv.get('dp_epsilon_budget') or 'none'}, "
+                f"secure_agg={priv.get('secure_agg')}, "
+                f"personalize={priv.get('personalize') or '[]'})"
+            )
     serve = next(
         (r for r in rows if r.get("kind") == "serve_summary"), None
     )

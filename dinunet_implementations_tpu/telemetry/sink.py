@@ -42,11 +42,14 @@ TRACE_CHROME_FILE = "trace.chrome.json"
 #: REQUIRED (null when no chaos/attack was injected): a fault- or
 #: attack-arm's artifact must be reproducible from the manifest alone —
 #: before r17 only the config hash landed there and the active plan JSON
-#: lived in the shell history.
+#: lived in the shell history. privacy (r20) is the same contract for the
+#: DP/secure-agg/personalization knobs: a DP run's artifact carries the
+#: exact mechanism parameters its ε claim depends on (null when the whole
+#: privacy plane is off).
 MANIFEST_REQUIRED = frozenset({
     "schema_version", "config_hash", "task_id", "agg_engine", "num_sites",
     "pipeline", "fold", "jax_version", "jaxlib_version", "backend", "mesh",
-    "package_version", "git_rev", "fault_plan", "attack_plan",
+    "package_version", "git_rev", "fault_plan", "attack_plan", "privacy",
 })
 
 #: required metrics.jsonl keys by row kind
@@ -57,6 +60,9 @@ ROW_REQUIRED = {
         "site_residual_sq_sum", "update_sq_last", "payload_bytes",
         # r18 per-tier wire split: inter-slice (DCN) bytes, 0.0 off-slice
         "dcn_bytes", "rounds",
+        # r20 privacy plane: spent ε so far (null = DP off/noiseless) —
+        # required, so a DP run's per-epoch ε trail cannot silently vanish
+        "dp_epsilon",
     }),
     "event": frozenset({"kind", "name"}),
     "summary": frozenset({
@@ -129,6 +135,34 @@ def mesh_topology(mesh) -> dict | None:
     return {str(k): int(v) for k, v in dict(mesh.shape).items()}
 
 
+def privacy_manifest(cfg) -> dict | None:
+    """The active privacy-plane configuration, verbatim (r20) — ``None``
+    when the whole plane is off (dp off, secure_agg off, no personalized
+    heads), so a legacy run's manifest reads exactly like before with one
+    extra null key. The dict carries every knob the artifact's ε /
+    masked-wire / personalization claims depend on: a DP run is
+    reproducible from the manifest alone."""
+    dp_clip = float(getattr(cfg, "dp_clip", 0.0) or 0.0)
+    dp_noise = float(getattr(cfg, "dp_noise_multiplier", 0.0) or 0.0)
+    secure = getattr(cfg, "secure_agg", "off") or "off"
+    personalize = tuple(getattr(cfg, "personalize", ()) or ())
+    if dp_clip <= 0.0 and dp_noise <= 0.0 and secure == "off" \
+            and not personalize:
+        return None
+    return {
+        "dp_clip": dp_clip,
+        "dp_noise_multiplier": dp_noise,
+        "dp_seed": int(getattr(cfg, "dp_seed", 0) or 0),
+        "dp_delta": float(getattr(cfg, "dp_delta", 1e-5)),
+        "dp_epsilon_budget": float(
+            getattr(cfg, "dp_epsilon_budget", 0.0) or 0.0
+        ),
+        "secure_agg": secure,
+        "secure_agg_seed": int(getattr(cfg, "secure_agg_seed", 0) or 0),
+        "personalize": list(personalize),
+    }
+
+
 def build_manifest(cfg, mesh=None, fold: int = 0, fault_plan=None,
                    attack_plan=None) -> dict:
     import jax
@@ -156,6 +190,9 @@ def build_manifest(cfg, mesh=None, fold: int = 0, fault_plan=None,
         "attack_plan": (
             attack_plan.to_json() if attack_plan is not None else None
         ),
+        # the active privacy-plane knobs, verbatim (r20; null = plane off):
+        # DP runs are reproducible from the artifact alone
+        "privacy": privacy_manifest(cfg),
         "config": cfg.to_dict(),
     }
 

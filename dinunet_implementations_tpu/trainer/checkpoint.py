@@ -130,6 +130,10 @@ def save_checkpoint(
         # flight when the fit checkpoints — resume applies it instead of
         # dropping one round of data
         "overlap": state.overlap if state.overlap is not None else {},
+        # personalized per-site head rows (r20, privacy/personalize.py): a
+        # resumed personalized fit must keep each site's own head — losing
+        # them would silently reset every site to the common model
+        "personal": state.personal if state.personal is not None else {},
         # meta rides INSIDE the msgpack so state+meta are one atomic unit (a
         # kill between two separate files would pair epoch-N state with
         # epoch-(N-1) bookkeeping and resume from the wrong epoch)
@@ -178,6 +182,7 @@ def load_checkpoint(path: str, like: TrainState, with_meta: bool = False,
     telemetry_raw = raw.pop("telemetry", None)
     buffers_raw = raw.pop("buffers", None)
     overlap_raw = raw.pop("overlap", None)
+    personal_raw = raw.pop("personal", None)
     restored = flax.serialization.from_state_dict(template, raw)
     restored["meta_json"] = meta_json
     try:
@@ -248,6 +253,22 @@ def load_checkpoint(path: str, like: TrainState, with_meta: bool = False,
                 "match the current run (site count or model changed?); "
                 "resuming with an empty stash."
             )
+    # personalized head rows restore the same tolerant way: absent in
+    # pre-0.15 checkpoints (or when the resuming run is unpersonalized) →
+    # fresh common-model rows / None, never a failed resume
+    personal = like.personal
+    if personal_raw and like.personal is not None:
+        try:
+            personal = flax.serialization.from_state_dict(
+                like.personal, personal_raw
+            )
+        except (KeyError, TypeError, ValueError):
+            warnings.warn(
+                f"[warn] checkpoint {path}: stored personalized-head rows "
+                "do not match the current run (site count or partition "
+                "patterns changed?); resuming with fresh common-model "
+                "heads."
+            )
     state = TrainState(
         params=restored["params"],
         batch_stats=restored["batch_stats"],
@@ -259,6 +280,7 @@ def load_checkpoint(path: str, like: TrainState, with_meta: bool = False,
         telemetry=telemetry,
         buffers=buffers,
         overlap=overlap,
+        personal=personal,
     )
     if with_meta:
         meta = restored.get("meta_json")

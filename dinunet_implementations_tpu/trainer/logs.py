@@ -168,6 +168,19 @@ def telemetry_log_fields(summary: dict | None, site_index: int | None = None) ->
     }
 
 
+def privacy_log_fields(results: dict) -> dict:
+    """``logs.json`` fields for the spent differential privacy (r20,
+    privacy/accounting.py): the fit's final (ε, δ) next to the health and
+    telemetry rollups — absent entirely when the DP mechanism was off or
+    noiseless (no guarantee to misreport)."""
+    if "dp_epsilon" not in results:
+        return {}
+    return {
+        "dp_epsilon": results["dp_epsilon"],
+        "dp_delta": results["dp_delta"],
+    }
+
+
 def write_test_metrics_csv(dirpath: str, fold: int, metrics: dict) -> str:
     """``metrics``: mapping name → value; accuracy and f1 must be present (the
     notebook indexes columns 1 and 2)."""

@@ -50,6 +50,7 @@ from .logs import (
     health_log_fields,
     log_info,
     log_warning,
+    privacy_log_fields,
     telemetry_log_fields,
     write_logs_json,
     write_test_metrics_csv,
@@ -99,8 +100,34 @@ class FederatedTrainer:
             robust_trim_frac=cfg.robust_trim_frac,
             robust_clip_mult=cfg.robust_clip_mult,
             dcn_wire_quant=cfg.dcn_wire_quant,
+            secure_agg=cfg.secure_agg,
+            secure_agg_seed=cfg.secure_agg_seed,
             **task_args
         )
+        # privacy plane (r20, privacy/): validate the DP knobs up front
+        # (noise without a clip is rejected — no sensitivity, no guarantee)
+        # and open the host-side RDP ledger when the mechanism is noisy.
+        # The accountant lives on the trainer so both the batch fit and the
+        # daemon's epoch loop step ONE ledger; _fit_impl round-trips it
+        # through the checkpoint meta so a resumed fit continues ε
+        # accumulation exactly (no double count, no reset).
+        from ..privacy import RdpAccountant, dp_enabled
+
+        self._dp_on = dp_enabled(cfg.dp_clip, cfg.dp_noise_multiplier)
+        self._dp_noisy = self._dp_on and cfg.dp_noise_multiplier > 0.0
+        if not 0.0 < cfg.dp_delta < 1.0:
+            raise ValueError(f"dp_delta must be in (0, 1), got {cfg.dp_delta}")
+        if cfg.dp_epsilon_budget < 0.0:
+            raise ValueError(
+                f"dp_epsilon_budget must be >= 0, got {cfg.dp_epsilon_budget}"
+            )
+        if cfg.dp_epsilon_budget > 0.0 and not self._dp_noisy:
+            raise ValueError(
+                "dp_epsilon_budget needs dp_noise_multiplier > 0 — a "
+                "noiseless mechanism never exhausts any finite ε budget"
+            )
+        self.dp_accountant = RdpAccountant() if self._dp_noisy else None
+        self._dp_epsilon = None  # last reported ε (None = dp off/noiseless)
         # modeled per-round inter-slice (DCN) bytes for the bus rollup —
         # filled at fit time once the site count / pack factor are known;
         # stays 0.0 on single-slice meshes (r18, telemetry/metrics.py)
@@ -162,8 +189,14 @@ class FederatedTrainer:
             reputation_z=cfg.reputation_z,
             reputation_rounds=cfg.reputation_rounds,
             min_slices=cfg.min_slices,
+            dp_clip=cfg.dp_clip,
+            dp_noise_multiplier=cfg.dp_noise_multiplier,
+            dp_seed=cfg.dp_seed,
+            personalize=tuple(cfg.personalize),
         )
-        self.eval_fn = make_eval_fn(self.task, mesh)
+        self.eval_fn = make_eval_fn(
+            self.task, mesh, personalize=tuple(cfg.personalize)
+        )
         self._inventory = None  # device-resident site inventory, one per fit
         self._inventory_src = None  # content fingerprint it was built from
         # ship inputs to the device pre-cast to the model's compute dtype
@@ -225,6 +258,7 @@ class FederatedTrainer:
             staleness_bound=self.cfg.staleness_bound,
             overlap_rounds=self.cfg.overlap_rounds,
             reputation=self.cfg.robust_agg != "none",
+            personalize=tuple(self.cfg.personalize),
         )
         from ..parallel.mesh import SITE_AXIS, pack_factor, slice_count
 
@@ -426,7 +460,9 @@ class FederatedTrainer:
             state, losses = self.epoch_fn(
                 state, inv_x, inv_y, idx, live, poison, attack, slice_live
             )
-            return state, np.asarray(losses)
+            return state, self._account_epoch(
+                train_sites, np.asarray(losses), batch_size
+            )
         fb = plan_epoch(
             train_sites,
             batch_size or self.cfg.batch_size,
@@ -493,7 +529,35 @@ class FederatedTrainer:
         state, losses = self.epoch_fn(
             state, *batch, live_dev, attack_dev, slice_dev
         )
-        return state, np.asarray(losses)
+        return state, self._account_epoch(
+            train_sites, np.asarray(losses), batch_size
+        )
+
+    def _account_epoch(self, train_sites, losses, batch_size=None):
+        """Step the RDP ledger by this epoch's executed rounds and publish
+        ε (r20, privacy/accounting.py) — run_epoch is the one place both
+        the batch fit and the daemon's serve loop train an epoch, so both
+        surfaces share ONE ledger. The conversion runs host-side on values
+        the loop already has; no device sync."""
+        if self.dp_accountant is None:
+            return losses
+        from ..privacy import effective_noise_multiplier, sampling_fraction
+
+        q = sampling_fraction(
+            batch_size or self.cfg.batch_size, self.cfg.local_iterations,
+            [len(s) for s in train_sites],
+        )
+        # clip-of-mean sensitivity is 2C, not C — compose conservatively
+        # at σ/2 (privacy/accounting.py MEAN_CLIP_SENSITIVITY_FACTOR)
+        self.dp_accountant.step(
+            effective_noise_multiplier(self.cfg.dp_noise_multiplier), q,
+            steps=len(losses),
+        )
+        eps, _ = self.dp_accountant.epsilon(self.cfg.dp_delta)
+        self._dp_epsilon = float(eps)
+        # the (ε, δ) /statusz surface: train_epsilon next to train_loss
+        self.bus.gauge("train_epsilon", self._dp_epsilon)
+        return losses
 
     @staticmethod
     def _new_metrics(num_class: int):
@@ -765,6 +829,18 @@ class FederatedTrainer:
             # continue the cumulative wall-clock line from its stored total
             if cum:
                 t_start = time.perf_counter() - cum[-1]
+            # privacy ledger (r20): resume continues ε accumulation EXACTLY
+            # — the checkpointed RDP state replaces the fresh ledger, so an
+            # interrupted fit spends the same budget as an uninterrupted
+            # one (no double count, no reset; tests/test_privacy.py)
+            if self.dp_accountant is not None and meta.get("dp_accountant"):
+                from ..privacy import RdpAccountant
+
+                self.dp_accountant = RdpAccountant.from_json(
+                    meta["dp_accountant"]
+                )
+                eps, _ = self.dp_accountant.epsilon(cfg.dp_delta)
+                self._dp_epsilon = float(eps)
             # snapshot either way: a load falling back to template leaves
             # (engine-structure change) would otherwise alias `state`
             best_state = self._snapshot(
@@ -959,7 +1035,14 @@ class FederatedTrainer:
                                       "time_spent_on_computation": self._cache.get(
                                           "time_spent_on_computation", []),
                                       "cumulative_total_duration": self._cache.get(
-                                          "cumulative_total_duration", [])},
+                                          "cumulative_total_duration", []),
+                                      # the RDP ledger rides the atomically-
+                                      # paired meta (r20): resume continues
+                                      # ε exactly from this boundary
+                                      "dp_accountant": (
+                                          self.dp_accountant.to_json()
+                                          if self.dp_accountant is not None
+                                          else None)},
                                 rotate=True,
                             )
                     # -- preemption: a SIGTERM/SIGINT that landed during the
@@ -991,6 +1074,31 @@ class FederatedTrainer:
                                 epoch=epoch,
                             )
                         round_before = round_after
+                    # ε-budget exhaustion (r20): the Preempted-style
+                    # checkpointed exit, minus the nonzero exit code — the
+                    # epoch's rotating checkpoint is already on disk above,
+                    # so the fit stops cleanly here and proceeds to the
+                    # best-state test with the budget respected
+                    if (
+                        cfg.dp_epsilon_budget > 0.0
+                        and self._dp_epsilon is not None
+                        and self._dp_epsilon >= cfg.dp_epsilon_budget
+                    ):
+                        if self._fit_tel is not None:
+                            self._fit_tel.event(
+                                "dp-budget", epoch=epoch,
+                                epsilon=self._dp_epsilon,
+                                budget=cfg.dp_epsilon_budget,
+                            )
+                        if verbose:
+                            log_info(
+                                f"[fold {fold}] epoch {epoch}: privacy "
+                                f"budget exhausted (ε="
+                                f"{self._dp_epsilon:.3f} ≥ "
+                                f"{cfg.dp_epsilon_budget}); stopping"
+                            )
+                        stop_epoch = epoch
+                        break
                     if stop:
                         stop_epoch = epoch
                         break
@@ -1046,10 +1154,16 @@ class FederatedTrainer:
             results["site_telemetry"] = telemetry_summary(
                 fetch_site_outputs(state.telemetry, self.mesh)
             )
+        # privacy surfaces (r20): the spent (ε, δ) lands in the results
+        # dict, logs.json (via _write_outputs) and the telemetry summary
+        if self._dp_epsilon is not None:
+            results["dp_epsilon"] = self._dp_epsilon
+            results["dp_delta"] = cfg.dp_delta
         if self._fit_tel is not None:
             self._fit_summary.update(
                 best_val_epoch=int(best_epoch),
                 best_val_metric=best_metric,
+                dp_epsilon=self._dp_epsilon,
             )
             for key in ("site_skipped_rounds", "site_quarantined"):
                 if results.get("site_health"):
@@ -1131,6 +1245,11 @@ class FederatedTrainer:
             "train_loss": epoch_loss,
             "epoch_seconds": round(time.perf_counter() - e_start, 6),
             "transfer_bytes": self._last_transfer_bytes,
+            # spent privacy so far (r20, privacy/accounting.py): null when
+            # the DP mechanism is off or noiseless (ε = ∞ is reported as
+            # null by the strict-JSON contract anyway) — a REQUIRED epoch
+            # key, so a DP run's per-epoch ε trail is schema-guaranteed
+            "dp_epsilon": self._dp_epsilon,
         }
         t = (
             fetch_site_outputs(state.telemetry, self.mesh)
@@ -1215,6 +1334,9 @@ class FederatedTrainer:
             round=pre_state.round,
             health=state.health,
             telemetry=state.telemetry,
+            # personalized head rows (r20) survive the warm start untouched
+            # — they are fresh common-model rows at this point anyway
+            personal=state.personal,
         )
 
     def _write_outputs(self, results, iter_durations, best_state, fold):
@@ -1239,14 +1361,16 @@ class FederatedTrainer:
                 extra={"site_index": i, "pooled_test_metrics": results["test_metrics"],
                        "durations_shared_across_sites": True,
                        **health_log_fields(results.get("site_health"), i),
-                       **telemetry_log_fields(results.get("site_telemetry"), i)},
+                       **telemetry_log_fields(results.get("site_telemetry"), i),
+                       **privacy_log_fields(results)},
             )
         d = fold_dir(self.out_dir, "remote", cfg.task_id, fold)
         write_logs_json(
             d, cfg.agg_engine, results["test_metrics"], results["best_val_epoch"],
             cum, comp, iter_durations, side="remote",
             extra={**health_log_fields(results.get("site_health")),
-                   **telemetry_log_fields(results.get("site_telemetry"))},
+                   **telemetry_log_fields(results.get("site_telemetry")),
+                   **privacy_log_fields(results)},
         )
         write_test_metrics_csv(d, fold, results["test_scores"])
         save_checkpoint(

@@ -106,6 +106,17 @@ class TrainState:
     # carries no overlap ops at all (bitwise-equal legacy program,
     # S005-gated).
     overlap: Any = None
+    # PER-SITE personalized-head state (r20, privacy/personalize.py):
+    # {"params": head-subtree with [S, ...] leaves, "opt": the per-site
+    # optimizer state over it}. Head leaves named by TrainConfig.personalize
+    # are partitioned OUT of aggregation entirely — each site trains and
+    # evaluates its own row; the global params tree keeps full structure
+    # with those leaves frozen at init. Sharded P(site) like health,
+    # checkpointed (R006), rejoin-reset via reset_slot_state. None whenever
+    # personalization is off — the epoch program then carries no
+    # personalization ops at all (bitwise-equal legacy program,
+    # S005-gated).
+    personal: Any = None
 
 
 def _state_specs(state: TrainState, site_axis=SITE_AXIS):
@@ -128,6 +139,7 @@ def _state_specs(state: TrainState, site_axis=SITE_AXIS):
         telemetry=jax.tree.map(lambda _: P(site_axis), state.telemetry),
         buffers=jax.tree.map(lambda _: P(site_axis), state.buffers),
         overlap=jax.tree.map(lambda _: P(site_axis), state.overlap),
+        personal=jax.tree.map(lambda _: P(site_axis), state.personal),
     )
 
 
@@ -202,9 +214,25 @@ def init_train_state(
     staleness_bound: int = 0,
     overlap_rounds: bool = False,
     reputation: bool = False,
+    personalize: tuple = (),
 ) -> TrainState:
     params, batch_stats = task.init_variables(rng, sample_x)
-    site_state = engine.init(params)
+    # personalized heads (r20): the engine only ever aggregates (and its
+    # state/wire models only ever see) the SHARED subtree — head leaves
+    # never ship, so engine state must not carry rows for them
+    personal = None
+    if personalize:
+        from ..privacy.personalize import (
+            default_personal,
+            head_leaf_paths,
+            strip_tree,
+        )
+
+        paths = head_leaf_paths(params, personalize)
+        site_state = engine.init(strip_tree(params, paths, keep_head=False))
+        personal = default_personal(num_sites, params, paths, optimizer)
+    else:
+        site_state = engine.init(params)
     return TrainState(
         params=params,
         batch_stats=batch_stats,
@@ -235,6 +263,9 @@ def init_train_state(
             default_overlap_stash(num_sites, params, batch_stats)
             if overlap_rounds else None
         ),
+        # per-site head rows only when personalization is on (the telemetry
+        # structural reasoning: the carried state must match the program)
+        personal=personal,
     )
 
 
@@ -302,6 +333,10 @@ def make_train_epoch_fn(
     reputation_z: float = 2.0,
     reputation_rounds: int = 8,
     min_slices: int = 1,
+    dp_clip: float = 0.0,
+    dp_noise_multiplier: float = 0.0,
+    dp_seed: int = 0,
+    personalize: tuple = (),
 ):
     """Build the jitted epoch function.
 
@@ -513,6 +548,39 @@ def make_train_epoch_fn(
         from ..robustness.attacks import make_attack_fn
 
         atk = make_attack_fn(attack_plan)
+    # privacy plane (r20) trace-time statics: DP clip/noise parameters are
+    # closed over (noise is counter-keyed by (dp_seed, site, round), like
+    # AttackPlan noise — chunk/resume/packing-independent); the head
+    # partition patterns resolve to leaf paths at trace time from the real
+    # params structure. Both off (the defaults) build NOTHING — the epoch
+    # program is lowering-identical to the legacy one (S005 "dp-off" /
+    # "personalize-off").
+    from ..privacy.dpsgd import dp_enabled
+
+    dp_on = dp_enabled(dp_clip, dp_noise_multiplier)
+    # builder kwarg, never a tracer: the static TrainConfig.personalize
+    personal_on = bool(tuple(personalize))  # jaxlint: disable=R005
+    # rnd-aware engine dispatch (r20): the trainer always has the traced
+    # global round counter to offer, but legacy/fixture engines keep the
+    # pre-r20 aggregate signature — resolve from the signature like
+    # telemetry's _accepts_pack (never `except TypeError`, which would
+    # swallow a genuine TypeError raised inside an rnd-aware engine)
+    import inspect
+
+    try:
+        _agg_sig = inspect.signature(engine.aggregate).parameters
+    except (TypeError, ValueError):  # builtins/C callables: assume legacy
+        _agg_sig = {}
+    _agg_takes_rnd = "rnd" in _agg_sig or any(
+        p.kind is inspect.Parameter.VAR_KEYWORD for p in _agg_sig.values()
+    )
+
+    def engine_aggregate(grads, es, weight, axis, live, rnd):
+        if _agg_takes_rnd:
+            return engine.aggregate(grads, es, weight, axis, live=live,
+                                    rnd=rnd)
+        return engine.aggregate(grads, es, weight, axis, live=live)
+
     if min_slices < 1:
         raise ValueError(f"min_slices must be >= 1, got {min_slices}")
     if min_slices > 1 and not sliced:
@@ -605,6 +673,42 @@ def make_train_epoch_fn(
         )
         rounds = steps // local_iterations
         L = rounds * local_iterations
+        # privacy plane (r20): the head partition resolves against the REAL
+        # params structure at trace time; the DP transform (clip + counter-
+        # keyed noise) skips head leaves — they never ship, so the
+        # mechanism has nothing to protect there. Both are trace-time
+        # presence branches: off builds nothing (S005).
+        head_paths = frozenset()
+        if personal_on:
+            from ..privacy.personalize import (
+                graft_shared,
+                head_leaf_paths,
+                strip_tree,
+            )
+
+            head_paths = head_leaf_paths(state.params, personalize)
+        dp = None
+        if dp_on:
+            from ..privacy.dpsgd import make_dp_fn
+
+            dp = make_dp_fn(dp_clip, dp_noise_multiplier, dp_seed, head_paths)
+
+        def _eng_grads(tree):
+            """What the engine aggregates: the SHARED subtree under
+            personalization (head leaves never reach the wire), the full
+            tree otherwise."""
+            if not personal_on:
+                return tree
+            return strip_tree(tree, head_paths, keep_head=False)
+
+        def _full_agg(agg_shared):
+            """The optimizer-facing aggregate at full params structure:
+            shared leaves from the engine, head leaves exact zeros — the
+            frozen global head copies provably never move (zero grad →
+            zero Adam moments → zero update)."""
+            if not personal_on:
+                return agg_shared
+            return graft_shared(state.params, agg_shared, head_paths)
 
         # split the steps axis in place ([k, rounds, L, B, ...] — a free
         # reshape). Each round's block then arrives either as rounds-leading
@@ -703,8 +807,11 @@ def make_train_epoch_fn(
         # psum-shaped exchanges reduce over the packed axis before the wire
         # (k-invariant), only the factor gather scales with k — the model is
         # verified against the traced program by checks/semantic.py S002.
+        # under personalization the wire carries the SHARED subtree only —
+        # the model must charge exactly what ships (S002 proves it)
+        wire_tmpl = _eng_grads(state.params)
         wire_b = (
-            payload_bytes_of(engine, state.params, pack=k if packed else 1)
+            payload_bytes_of(engine, wire_tmpl, pack=k if packed else 1)
             if telem else 0.0
         )
         # per-tier split (r18): the inter-slice hop's modeled PER-SLICE
@@ -713,7 +820,7 @@ def make_train_epoch_fn(
         # semantic cells rather than merely modeled
         dcn_b = (
             dcn_bytes_of(
-                engine, state.params, pack=k,
+                engine, wire_tmpl, pack=k,
                 sites_per_slice=k * mesh_site_members, slices=n_slices,
             )
             if telem and packed else 0.0
@@ -748,7 +855,7 @@ def make_train_epoch_fn(
 
         def one_round(carry, xs):
             (params, batch_stats, opt_state, engine_state, health, telem_st,
-             buffers, ov, rng, rnd) = carry
+             buffers, ov, personal, rng, rnd) = carry
             pz = None
             if use_scan_xs:
                 parts = list(xs)
@@ -805,6 +912,7 @@ def make_train_epoch_fn(
                 held = q_t < jnp.float32(min_slices)
                 hold_prev = (
                     batch_stats, engine_state, health, telem_st, buffers, ov,
+                    personal,
                 )
             if overlap:
                 # overlapped rounds: tie the stashed (previous-round) payload
@@ -831,7 +939,7 @@ def make_train_epoch_fn(
                     xb, yb, wb = jax.vmap(_gather_batch)(inv_x, inv_y, ib, pz)
             rng, sub = jax.random.split(rng)
 
-            def site_micro(xs, ys, ws, ab_site=None):
+            def site_micro(xs, ys, ws, ab_site=None, pr_site=None):
                 """One site's micro-batch gradient phase — shared by the
                 packed and classic forms (always under the inner vmap;
                 ``axis_index`` linearizes to the global, device-major site id
@@ -840,14 +948,26 @@ def make_train_epoch_fn(
                 the round (robustness/attacks.py) — the byzantine transform
                 applies to the finished round gradient, before any engine
                 compression, keyed by the GLOBAL site id and round so the
-                attack replays bit-identically across topologies."""
+                attack replays bit-identically across topologies.
+                ``pr_site`` is this site's personalized head subtree (r20,
+                privacy/personalize.py) — the forward runs on the merged
+                params, so the gradient covers head AND shared leaves (the
+                apply half partitions them). The DP-SGD transform (r20,
+                privacy/dpsgd.py) runs on the finished round gradient
+                BEFORE the attack: an honest site privatizes what it ships,
+                a hostile one lies about the privatized quantity."""
                 site_ix = jax.lax.axis_index(site_axes)
+                p_site = params
+                if pr_site is not None:
+                    from ..privacy.personalize import merge_head
+
+                    p_site = merge_head(params, pr_site)
 
                 def micro(acc, mb):
                     g_sum, n_sum, stats = acc
                     xm, ym, wm, i = mb
                     key_i = jax.random.fold_in(jax.random.fold_in(sub, site_ix), i)
-                    (loss, new_stats), grads = grad_fn(params, stats, key_i, xm, ym, wm)
+                    (loss, new_stats), grads = grad_fn(p_site, stats, key_i, xm, ym, wm)
                     if model_axis is not None:
                         # assemble the full gradient (and un-mask the loss
                         # scalar) from the per-member pieces — see loss_fn
@@ -857,7 +977,7 @@ def make_train_epoch_fn(
                     g_sum = jax.tree.map(lambda a, g: a + g * n, g_sum, grads)
                     return (g_sum, n_sum + n, new_stats), loss * n
 
-                g0 = jax.tree.map(jnp.zeros_like, params)
+                g0 = jax.tree.map(jnp.zeros_like, p_site)
                 (g_sum, n_sum, new_stats), loss_sums = jax.lax.scan(
                     micro,
                     (g0, jnp.zeros(()), batch_stats),
@@ -866,6 +986,8 @@ def make_train_epoch_fn(
                 site_grad = jax.tree.map(
                     lambda g: g / jnp.maximum(n_sum, 1.0), g_sum
                 )
+                if dp is not None:
+                    site_grad = dp(site_grad, rnd, site_ix)
                 if attack_on:
                     site_grad = atk(site_grad, ab_site, rnd, site_ix)
                 return site_grad, n_sum, new_stats, loss_sums.sum()
@@ -873,15 +995,18 @@ def make_train_epoch_fn(
             def _ts_round_site(ts, site_grad, agg):
                 """Classic (in-vmap) accumulator update: scalar norms per
                 site, reduced in tree order (telemetry.metrics.tree_sq_sum —
-                the host-recompute tests depend on that order)."""
+                the host-recompute tests depend on that order). The residual
+                covers the SHARED (shipped) subtree — see packed_apply's
+                res_sq note; identical trees when personalization is off."""
                 if ts is None:
                     return None
                 return _ts_round(
                     ts,
                     tree_sq_sum(site_grad),
-                    tree_sq_sum(
-                        jax.tree.map(lambda g, a: g - a, site_grad, agg)
-                    ),
+                    tree_sq_sum(jax.tree.map(
+                        lambda g, a: g - a,
+                        _eng_grads(site_grad), _eng_grads(agg),
+                    )),
                 )
 
             def _rows_sq_sum(tree):
@@ -954,6 +1079,39 @@ def make_train_epoch_fn(
                     ),
                     "weight": jnp.where(arrived, n_sum, bf["weight"]),
                     "age": jnp.where(arrived, 0, bf["age"] + 1),
+                }
+
+            def _personal_apply(pr, site_grad, gate, batched):
+                """Per-site personalized-head update (r20): each site's own
+                optimizer row advances on its own head gradient — heads
+                never enter the engine aggregate. ``gate(leaf)`` broadcasts
+                the round's contribute mask like :func:`_freeze_dead`
+                (None = the unguarded program: always update); ``batched``
+                selects the packed [k]-leading form (rows vmapped) vs the
+                classic in-vmap scalar form."""
+                if pr is None:
+                    return pr
+                from ..privacy.personalize import strip_tree as _strip
+
+                hg = _strip(site_grad, head_paths, keep_head=True)
+
+                def upd(hp, ho, g):
+                    u, no = optimizer.update(g, ho, hp)
+                    return optax.apply_updates(hp, u), no
+
+                if batched:
+                    new_p, new_o = jax.vmap(upd)(pr["params"], pr["opt"], hg)
+                else:
+                    new_p, new_o = upd(pr["params"], pr["opt"], hg)
+                if gate is None:
+                    return {"params": new_p, "opt": new_o}
+
+                def keep(new, old):
+                    return jnp.where(gate(new), new, old)
+
+                return {
+                    "params": jax.tree.map(keep, new_p, pr["params"]),
+                    "opt": jax.tree.map(keep, new_o, pr["opt"]),
                 }
 
             def _round_loss(loss_sum, contribute, total_live, psum):
@@ -1039,20 +1197,25 @@ def make_train_epoch_fn(
                     "quarantined": quarantined, "anomaly": anomaly,
                 }
 
-            def packed_apply(hs, ts, bf, ls, es, site_grad, n_sum, stats_k,
-                             loss_site):
+            def packed_apply(hs, ts, bf, pr, ls, es, site_grad, n_sum,
+                             stats_k, loss_site):
                 """The communicate/apply half of the two-level round, on an
                 already-computed per-site payload: engine aggregate, sync-BN,
                 round loss and health on the [k]-batched block with
                 PackedAxis collectives — one cross-device collective per
                 payload, k-invariant psum wire. In the overlapped-rounds
                 mode the payload comes from the previous round's stash
-                instead of this round's fresh gradients."""
+                instead of this round's fresh gradients. Under
+                personalization the engine sees (and ships) the SHARED
+                subtree only; head gradients update each site's own
+                ``pr`` row."""
                 gsq = _rows_sq_sum(site_grad) if ts is not None else None
                 if not guard:
-                    agg, es_new = engine.aggregate(
-                        site_grad, es, n_sum, pax, live=None
+                    agg, es_new = engine_aggregate(
+                        _eng_grads(site_grad), es, n_sum, pax, None, rnd
                     )
+                    agg = _full_agg(agg)
+                    pr_new = _personal_apply(pr, site_grad, None, batched=True)
                     if task.has_batch_stats:
                         scale = site_weight_scale(n_sum, pax)
                         stats_out = jax.tree.map(
@@ -1071,11 +1234,13 @@ def make_train_epoch_fn(
                         else _ts_round(
                             ts, gsq,
                             _rows_sq_sum(jax.tree.map(
-                                lambda g, a: g - a[None], site_grad, agg
+                                lambda g, a: g - a[None],
+                                _eng_grads(site_grad), _eng_grads(agg),
                             )),
                         )
                     )
-                    return agg, es_new, hs, ts_new, bf, stats_out, loss_round, None
+                    return (agg, es_new, hs, ts_new, bf, pr_new, stats_out,
+                            loss_round, None)
                 finite, contribute = _liveness_gate(ls, site_grad, hs, rows=k)
                 n_eff = n_sum * contribute
                 if buffered:
@@ -1092,26 +1257,36 @@ def make_train_epoch_fn(
                         bf["age"], staleness_bound, staleness_decay
                     )
                     eff_w = bf["weight"] * stale_w
-                    agg, es_new = engine.aggregate(
-                        bf["grads"], es, eff_w, pax,
-                        live=(stale_w > 0).astype(jnp.float32),
+                    agg, es_new = engine_aggregate(
+                        _eng_grads(bf["grads"]), es, eff_w, pax,
+                        (stale_w > 0).astype(jnp.float32), rnd,
                     )
+                    agg = _full_agg(agg)
                     es_new = _freeze_dead(
                         es_new, es, lambda leaf: _per_site(stale_w > 0, leaf)
                     )
                     # params-hold gate: total in-bound buffered weight; the
-                    # loss/BN gates stay keyed on FRESH arrivals below
+                    # loss/BN gates stay keyed on FRESH arrivals below.
+                    # Heads update from FRESH arrivals only — they are not
+                    # buffered (a head never leaves its site, so there is
+                    # no in-flight copy to age).
                     total_live = two_level_psum(eff_w, pax)
                     total_fresh = two_level_psum(n_eff, pax)
                 else:
-                    agg, es_new = engine.aggregate(
-                        site_grad, es, n_sum, pax, live=contribute
+                    agg, es_new = engine_aggregate(
+                        _eng_grads(site_grad), es, n_sum, pax, contribute,
+                        rnd,
                     )
+                    agg = _full_agg(agg)
                     es_new = _freeze_dead(
                         es_new, es, lambda leaf: _per_site(contribute > 0, leaf)
                     )
                     total_live = two_level_psum(n_eff, pax)
                     total_fresh = total_live
+                pr_new = _personal_apply(
+                    pr, site_grad,
+                    lambda leaf: _per_site(contribute > 0, leaf), batched=True,
+                )
                 if task.has_batch_stats:
                     scale = site_weight_scale(n_eff, pax)
                     zeroed = jax.tree.map(
@@ -1139,43 +1314,58 @@ def make_train_epoch_fn(
                 hs_new = _health_round(hs, finite, contribute)
                 # ONE distance-to-aggregate figure serves both consumers:
                 # the reputation z-score and the telemetry residual
+                # ONE distance-to-aggregate figure serves both consumers —
+                # computed over the SHARED (shipped) subtree: under
+                # personalization a site's legitimately-divergent head
+                # gradient never reaches the engine, so it must count
+                # neither as compression residual nor as reputation anomaly
                 res_sq = (
                     _rows_sq_sum(jax.tree.map(
-                        lambda g, a: g - a[None], site_grad, agg
+                        lambda g, a: g - a[None],
+                        _eng_grads(site_grad), _eng_grads(agg),
                     ))
                     if (reputation or ts is not None) else None
                 )
                 if reputation:
                     hs_new = _reputation_round(
-                        hs, hs_new, res_sq, _rows_sq_sum(site_grad),
+                        hs, hs_new, res_sq,
+                        _rows_sq_sum(_eng_grads(site_grad)),
                         contribute, lambda v: two_level_psum(v, pax),
                     )
                 ts_new = (
                     None if ts is None else _ts_round(ts, gsq, res_sq)
                 )
-                return (agg, es_new, hs_new, ts_new, bf, stats_out, loss_round,
-                        total_live)
+                return (agg, es_new, hs_new, ts_new, bf, pr_new, stats_out,
+                        loss_round, total_live)
 
-            def packed_round(hs, ts, bf, ls, es):
+            def packed_round(hs, ts, bf, pr, ls, es):
                 """The two-level round: per-site grads under the inner vmap,
-                then :func:`packed_apply` on this round's fresh payload."""
+                then :func:`packed_apply` on this round's fresh payload.
+                (None arguments — no attack mask, no personal rows — are
+                empty pytrees; vmap maps nothing over them.)"""
                 site_grad, n_sum, stats_k, loss_site = jax.vmap(
                     site_micro, axis_name=inner_axis
-                )(xb, yb, wb, *(() if ab is None else (ab,)))
+                )(xb, yb, wb, ab, None if pr is None else pr["params"])
                 return packed_apply(
-                    hs, ts, bf, ls, es, site_grad, n_sum, stats_k, loss_site
+                    hs, ts, bf, pr, ls, es, site_grad, n_sum, stats_k,
+                    loss_site,
                 )
 
-            def site_apply(es, hs, ts, bf, ls, site_grad, n_sum, new_stats,
-                           loss_sum):
+            def site_apply(es, hs, ts, bf, pr, ls, site_grad, n_sum,
+                           new_stats, loss_sum):
                 """The communicate/apply half of the classic (in-vmap) round
                 on an already-computed per-site payload — the scalar twin of
                 :func:`packed_apply`."""
                 if not guard:
                     # fault machinery statically compiled out: the exact
                     # legacy round (no finite check, no selects, no counters)
-                    agg, es_new = engine.aggregate(
-                        site_grad, es, n_sum, site_axes, live=None
+                    agg, es_new = engine_aggregate(
+                        _eng_grads(site_grad), es, n_sum, site_axes, None,
+                        rnd,
+                    )
+                    agg = _full_agg(agg)
+                    pr_new = _personal_apply(
+                        pr, site_grad, None, batched=False
                     )
                     if task.has_batch_stats:
                         scale = site_weight_scale(n_sum, site_axes)
@@ -1187,7 +1377,7 @@ def make_train_epoch_fn(
                         loss_sum, site_axes
                     ) / jnp.maximum(jax.lax.psum(n_sum, site_axes), 1.0)
                     return (agg, es_new, hs, _ts_round_site(ts, site_grad, agg),
-                            bf, new_stats, loss_round, None)
+                            bf, pr_new, new_stats, loss_round, None)
                 # -- liveness: a poisoned batch (data corruption, overflow,
                 # fault injection) yields a non-finite site gradient; that
                 # site is skipped this round and its streak counter advances
@@ -1207,20 +1397,26 @@ def make_train_epoch_fn(
                         bf["age"], staleness_bound, staleness_decay
                     )
                     eff_w = bf["weight"] * stale_w
-                    agg, es_new = engine.aggregate(
-                        bf["grads"], es, eff_w, site_axes,
-                        live=(stale_w > 0).astype(jnp.float32),
+                    agg, es_new = engine_aggregate(
+                        _eng_grads(bf["grads"]), es, eff_w, site_axes,
+                        (stale_w > 0).astype(jnp.float32), rnd,
                     )
+                    agg = _full_agg(agg)
                     es_new = _freeze_dead(es_new, es, lambda _: stale_w > 0)
                     total_live = jax.lax.psum(eff_w, site_axes)
                     total_fresh = jax.lax.psum(n_eff, site_axes)
                 else:
-                    agg, es_new = engine.aggregate(
-                        site_grad, es, n_sum, site_axes, live=contribute
+                    agg, es_new = engine_aggregate(
+                        _eng_grads(site_grad), es, n_sum, site_axes,
+                        contribute, rnd,
                     )
+                    agg = _full_agg(agg)
                     es_new = _freeze_dead(es_new, es, lambda _: contribute > 0)
                     total_live = jax.lax.psum(n_eff, site_axes)
                     total_fresh = total_live
+                pr_new = _personal_apply(
+                    pr, site_grad, lambda _: contribute > 0, batched=False
+                )
                 # sync-BN: example-weighted average of FRESHLY-ARRIVED sites'
                 # running stats (dead sites' stats may be NaN → where-zeroed,
                 # and their weight is already 0); a round with no arrivals
@@ -1246,22 +1442,25 @@ def make_train_epoch_fn(
                 if reputation:
                     hs_new = _reputation_round(
                         hs, hs_new,
-                        tree_sq_sum(
-                            jax.tree.map(lambda g, a: g - a, site_grad, agg)
-                        ),
-                        tree_sq_sum(site_grad),
+                        tree_sq_sum(jax.tree.map(
+                            lambda g, a: g - a,
+                            _eng_grads(site_grad), _eng_grads(agg),
+                        )),
+                        tree_sq_sum(_eng_grads(site_grad)),
                         contribute,
                         lambda v: jax.lax.psum(v, site_axes),
                     )
                 return (agg, es_new, hs_new, _ts_round_site(ts, site_grad, agg),
-                        bf, new_stats, loss_round, total_live)
+                        bf, pr_new, new_stats, loss_round, total_live)
 
-            def site_part(es, hs, ts, bf, ls, xs, ys, ws, ab_site=None):
+            def site_part(es, hs, ts, bf, pr, ls, xs, ys, ws, ab_site=None):
                 site_grad, n_sum, new_stats, loss_sum = site_micro(
-                    xs, ys, ws, ab_site
+                    xs, ys, ws, ab_site,
+                    None if pr is None else pr["params"],
                 )
                 return site_apply(
-                    es, hs, ts, bf, ls, site_grad, n_sum, new_stats, loss_sum
+                    es, hs, ts, bf, pr, ls, site_grad, n_sum, new_stats,
+                    loss_sum,
                 )
 
             if overlap:
@@ -1274,23 +1473,26 @@ def make_train_epoch_fn(
                 # first round must not count skips or accumulate rounds.
                 fresh_grad, fresh_n, fresh_stats, fresh_loss = jax.vmap(
                     site_micro, axis_name=inner_axis
-                )(xb, yb, wb, *(() if ab is None else (ab,)))
+                )(xb, yb, wb, ab,
+                  None if personal is None else personal["params"])
                 ls_prev = ov["live"] * ov["valid"]
                 if packed:
-                    (agg, es_new, hs_new, ts_new, buffers, batch_stats,
-                     loss_round, total_live) = packed_apply(
-                        health, telem_st, buffers, ls_prev, engine_state,
+                    (agg, es_new, hs_new, ts_new, buffers, personal,
+                     batch_stats, loss_round, total_live) = packed_apply(
+                        health, telem_st, buffers, personal, ls_prev,
+                        engine_state,
                         ov["grads"], ov["weight"], ov["stats"], ov["loss"],
                     )
                 else:
-                    (agg, es_new, hs_new, ts_new, buffers, stats_k, loss_k,
-                     tl_k) = jax.vmap(
+                    (agg, es_new, hs_new, ts_new, buffers, personal, stats_k,
+                     loss_k, tl_k) = jax.vmap(
                         site_apply,
-                        in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0),
-                        out_axes=(0, 0, 0, 0, 0, 0, 0, 0),
+                        in_axes=(0,) * 10,
+                        out_axes=(0,) * 9,
                         axis_name=inner_axis,
-                    )(engine_state, health, telem_st, buffers, ls_prev,
-                      ov["grads"], ov["weight"], ov["stats"], ov["loss"])
+                    )(engine_state, health, telem_st, buffers, personal,
+                      ls_prev, ov["grads"], ov["weight"], ov["stats"],
+                      ov["loss"])
                     agg = jax.tree.map(lambda a: a[0], agg)
                     batch_stats = jax.tree.map(lambda a: a[0], stats_k)
                     loss_round = loss_k[0]
@@ -1321,18 +1523,17 @@ def make_train_epoch_fn(
                 # mesh topologies: the two-level form — engine/BN/loss
                 # collectives run ONCE per device on the [k]-batched block
                 # (agg/stats/loss come back unbatched and replicated)
-                (agg, engine_state, health, telem_k, buffers, batch_stats,
-                 loss_round, total_live) = packed_round(
-                    health, telem_st, buffers, lb, engine_state
+                (agg, engine_state, health, telem_k, buffers, personal,
+                 batch_stats, loss_round, total_live) = packed_round(
+                    health, telem_st, buffers, personal, lb, engine_state
                 )
             else:
-                n_in = 8 + (0 if ab is None else 1)
-                (agg, engine_state, health, telem_k, buffers, stats_k, loss_k,
-                 tl_k) = jax.vmap(
-                    site_part, in_axes=(0,) * n_in,
-                    out_axes=(0, 0, 0, 0, 0, 0, 0, 0), axis_name=inner_axis,
-                )(engine_state, health, telem_st, buffers, lb, xb, yb, wb,
-                  *(() if ab is None else (ab,)))
+                (agg, engine_state, health, telem_k, buffers, personal,
+                 stats_k, loss_k, tl_k) = jax.vmap(
+                    site_part, in_axes=(0,) * 10,
+                    out_axes=(0,) * 9, axis_name=inner_axis,
+                )(engine_state, health, telem_st, buffers, personal, lb,
+                  xb, yb, wb, ab)
                 # agg/stats/loss are psum'd over site_axes → identical across
                 # the k in-device rows; collapse to one copy and update once
                 agg = jax.tree.map(lambda a: a[0], agg)
@@ -1350,10 +1551,12 @@ def make_train_epoch_fn(
                         lambda n, o: jnp.where(held, o, n), new, old
                     )
 
-                st0, es0, hs0, ts0, bf0, ov0 = hold_prev
+                st0, es0, hs0, ts0, bf0, ov0, pr0 = hold_prev
                 batch_stats = _hold(batch_stats, st0)
                 engine_state = _hold(engine_state, es0)
                 health = _hold(health, hs0)
+                if personal is not None:
+                    personal = _hold(personal, pr0)
                 if telem_k is not None:
                     telem_k = _hold(telem_k, ts0)
                     telem_k = {
@@ -1401,7 +1604,7 @@ def make_train_epoch_fn(
                 }
             return (
                 params, batch_stats, opt_state, engine_state, health,
-                telem_k, buffers, ov, rng, rnd + 1,
+                telem_k, buffers, ov, personal, rng, rnd + 1,
             ), loss_round
 
         carry0 = (
@@ -1413,6 +1616,7 @@ def make_train_epoch_fn(
             state.telemetry,
             state.buffers,
             state.overlap,
+            state.personal,
             jax.random.fold_in(state.rng, state.round),
             state.round,
         )
@@ -1453,7 +1657,7 @@ def make_train_epoch_fn(
         else:
             xs = jnp.arange(rounds)
         (params, stats, opt_state, engine_state, health, telem_out, buf_out,
-         ov_out, rng, rnd), losses = jax.lax.scan(one_round, carry0, xs)
+         ov_out, pr_out, rng, rnd), losses = jax.lax.scan(one_round, carry0, xs)
         new_state = TrainState(
             params=params,
             batch_stats=stats,
@@ -1465,6 +1669,7 @@ def make_train_epoch_fn(
             telemetry=telem_out,
             buffers=buf_out,
             overlap=ov_out,
+            personal=pr_out,
         )
         return new_state, losses
 
@@ -1551,6 +1756,29 @@ def make_train_epoch_fn(
                     inputs.shape[0], state.params, state.batch_stats
                 )
             )
+        # personalized-head rows mirror the personalize patterns this epoch
+        # was built with, same trace-time normalization: off drops any
+        # carried rows (a personalized checkpoint resumed plain — the
+        # program stays legacy), on fills/resizes fresh rows seeded from
+        # the CURRENT global params (a new cohort size starts every head
+        # from the common model)
+        if not personal_on:
+            if state.personal is not None:
+                state = state.replace(personal=None)
+        elif (
+            state.personal is None
+            or jax.tree.leaves(state.personal["params"])[0].shape[0]
+            != inputs.shape[0]
+        ):
+            from ..privacy.personalize import (
+                default_personal,
+                head_leaf_paths,
+            )
+
+            state = state.replace(personal=default_personal(
+                inputs.shape[0], state.params,
+                head_leaf_paths(state.params, personalize), optimizer,
+            ))
         return state
 
     # donate the carried state's buffers to the epoch program: the update
@@ -1775,14 +2003,29 @@ def eval_forward(task: FederatedTask, params, batch_stats, x, y=None, w=None):
     return probs, ce
 
 
-def make_eval_fn(task: FederatedTask, mesh=None):
+def make_eval_fn(task: FederatedTask, mesh=None, personalize: tuple = ()):
     """Jitted full-pass eval: returns per-site ``probs [S, steps, B, C]``,
     ``loss_sum [S]``, ``weight_sum [S]`` — metric scalars are computed
     host-side (trainer/metrics.py). ``mesh=None`` folds sites via vmap, as in
     :func:`make_train_epoch_fn`. The per-batch forward is
-    :func:`eval_forward` — shared verbatim with the serving engine."""
+    :func:`eval_forward` — shared verbatim with the serving engine.
 
-    def per_site_eval(params, batch_stats, x, y, w):
+    ``personalize`` (r20, privacy/personalize.py): with patterns set AND a
+    state carrying ``personal`` rows, each site evaluates on its OWN merged
+    head — eval is per-site by construction, so the per-site scores in
+    ``logs.json`` measure each site's personalized model. A personalized
+    build fed a personal-less state (``mode="test"`` from a params-only
+    restore) evaluates the frozen global heads — a trace-time presence
+    branch, like every other optional input."""
+    # builder kwarg, never a tracer: the static TrainConfig.personalize
+    personal_on = bool(tuple(personalize))  # jaxlint: disable=R005
+
+    def per_site_eval(params, batch_stats, x, y, w, head=None):
+        if head is not None:
+            from ..privacy.personalize import merge_head
+
+            params = merge_head(params, head)
+
         def step(_, batch):
             xb, yb, wb = batch
             probs, ce = eval_forward(task, params, batch_stats, xb, yb, wb)
@@ -1796,11 +2039,19 @@ def make_eval_fn(task: FederatedTask, mesh=None):
 
         @jax.jit
         def eval_fn(state: TrainState, inputs, labels, weights):
+            heads = (
+                state.personal["params"]
+                if personal_on and state.personal is not None else None
+            )
+            extras = () if heads is None else (heads,)
+            extra_specs = () if heads is None else (
+                jax.tree.map(lambda _: P(part), heads),
+            )
             return shard_map(
                 # inner vmap over the device's site block (k ≥ 1 folded sites)
-                lambda p, s, x, y, w: jax.vmap(
-                    per_site_eval, in_axes=(None, None, 0, 0, 0)
-                )(p, s, x, y, w),
+                lambda p, s, x, y, w, *h: jax.vmap(
+                    per_site_eval, in_axes=(None, None, 0, 0, 0, 0)
+                )(p, s, x, y, w, h[0] if h else None),
                 mesh=mesh,
                 in_specs=(
                     jax.tree.map(lambda _: P(), state.params),
@@ -1808,17 +2059,22 @@ def make_eval_fn(task: FederatedTask, mesh=None):
                     P(part),
                     P(part),
                     P(part),
-                ),
+                ) + extra_specs,
                 out_specs=(P(part), P(part), P(part)),
                 check_vma=False,
-            )(state.params, state.batch_stats, inputs, labels, weights)
+            )(state.params, state.batch_stats, inputs, labels, weights,
+              *extras)
 
     else:
 
         @jax.jit
         def eval_fn(state: TrainState, inputs, labels, weights):
-            return jax.vmap(per_site_eval, in_axes=(None, None, 0, 0, 0))(
-                state.params, state.batch_stats, inputs, labels, weights
+            heads = (
+                state.personal["params"]
+                if personal_on and state.personal is not None else None
             )
+            return jax.vmap(
+                per_site_eval, in_axes=(None, None, 0, 0, 0, 0)
+            )(state.params, state.batch_stats, inputs, labels, weights, heads)
 
     return eval_fn
