@@ -1509,6 +1509,134 @@ def measure_serving(requests: int = 100, dims: dict | None = None,
     return records
 
 
+def measure_fleet(replicas_list=(1, 2, 4), requests: int = 120,
+                  swaps: int = 4, dims: dict | None = None):
+    """The serving-fleet arms (r21, serving/fleet.py + publish.py):
+
+    - ``fleet-scale`` (one record per replica count): the same mixed-bucket
+      request storm against a ReplicaSet of 1 → 2 → 4 replicas (one engine
+      per virtual device) — aggregate requests/s (the scale-out claim),
+      per-replica request occupancy (the least-loaded router spreading
+      work), and per-replica bucket hit-rate;
+    - ``fleet-swap`` (at the largest replica count): K donated hot-swaps
+      fired INTO live traffic — per-swap pause (max across replicas, the
+      publish-window figure), its p99 across the K publishes, and the p99
+      request latency of the swap-storm window vs the steady window before
+      it (``LogHistogram.delta`` between merged-bus snapshots), plus the
+      fleet-wide compiles-after-warmup count proving the guard held
+      through every publish.
+    """
+    import jax
+    import numpy as np
+
+    from dinunet_implementations_tpu.serving.fleet import ReplicaSet
+    from dinunet_implementations_tpu.telemetry.bus import MetricsBus
+
+    cfg, task, params, stats, (windows, comps, wlen) = _serving_setup(dims)
+    backend = jax.default_backend()
+    base = {
+        "unit": None, "backend": backend,
+        "dims": dims or {"windows": windows, "comps": comps, "wlen": wlen,
+                         "enc_out": ENC_OUT, "hidden": HIDDEN},
+    }
+    rng = np.random.default_rng(0)
+    sizes = (1, 2, 3, 4, 8)
+
+    def storm(fleet, n):
+        t0 = time.perf_counter()
+        futures = [
+            fleet.submit(rng.normal(
+                size=(sizes[i % len(sizes)], windows, comps, wlen)
+            ).astype(np.float32))
+            for i in range(n)
+        ]
+        for f in futures:
+            f.result()
+        return time.perf_counter() - t0
+
+    records = []
+    for n_replicas in replicas_list:
+        bus = MetricsBus()
+        fleet = ReplicaSet(
+            cfg, replicas=n_replicas, params=params, batch_stats=stats,
+            bus=bus, row_buckets=(1, 2, 4, 8), streaming=False,
+            max_delay_ms=1.0,
+        )
+        fleet.warmup()
+        try:
+            elapsed = storm(fleet, requests)
+            parts = [
+                e.summary() for e in fleet._engines if e is not None
+            ]
+            records.append({
+                **base,
+                "metric": "fleet aggregate throughput / per-replica "
+                          "occupancy vs replica count",
+                "arm": "fleet-scale", "unit": "req/s",
+                "replicas": n_replicas,
+                "requests": requests,
+                "requests_per_s": round(requests / elapsed, 2),
+                "per_replica_requests": [p["requests"] for p in parts],
+                "per_replica_bucket_hit_rate": [
+                    p["bucket_hit_rate"] for p in parts
+                ],
+                "compiles_after_warmup": sum(
+                    p["compiles_after_warmup"] for p in parts
+                ),
+            })
+        finally:
+            fleet.close()
+
+    # -- hot-swap under load, at the largest fleet
+    n_replicas = max(replicas_list)
+    bus = MetricsBus()
+    fleet = ReplicaSet(
+        cfg, replicas=n_replicas, params=params, batch_stats=stats,
+        bus=bus, row_buckets=(1, 2, 4, 8), streaming=False,
+        max_delay_ms=1.0,
+    )
+    fleet.warmup()
+    try:
+        storm(fleet, requests)  # steady window
+        steady = bus.merged_histogram("serving_request_latency_ms")
+        pauses = []
+        per_swap = max(requests // max(swaps, 1), len(sizes))
+        for k in range(swaps):
+            futures = [
+                fleet.submit(rng.normal(
+                    size=(sizes[i % len(sizes)], windows, comps, wlen)
+                ).astype(np.float32))
+                for i in range(per_swap)
+            ]
+            cand = jax.tree.map(
+                lambda x, _k=k: np.asarray(x) + 1e-4 * (_k + 1), params
+            )
+            pauses.append(fleet.swap_params(cand, stats)["pause_ms"])
+            for f in futures:
+                f.result()
+        swap_hist = bus.merged_histogram(
+            "serving_request_latency_ms"
+        ).delta(steady)
+        fleet.assert_no_compiles()
+        records.append({
+            **base,
+            "metric": "hot-swap pause and in-swap request latency vs "
+                      "steady (donated publish under load)",
+            "arm": "fleet-swap", "unit": "ms",
+            "replicas": n_replicas, "swaps": swaps,
+            "swap_pause_ms_p99": round(
+                sorted(pauses)[max(int(0.99 * len(pauses)) - 1, 0)], 4
+            ),
+            "swap_pause_ms_max": round(max(pauses), 4),
+            "steady_latency_ms_p99": steady.quantile(0.99),
+            "in_swap_latency_ms_p99": swap_hist.quantile(0.99),
+            "compiles_after_warmup": 0,  # assert_no_compiles passed
+        })
+    finally:
+        fleet.close()
+    return records
+
+
 SMALL_DIMS = dict(sites=32, steps=2, batch=4, windows=6, comps=8, wlen=4,
                   enc_out=16, hidden=16, compute_dtype="bfloat16")
 
@@ -1534,6 +1662,26 @@ def main():
         stream_T = (int(sys.argv[sys.argv.index("--stream-t") + 1])
                     if "--stream-t" in sys.argv else 512)
         dims = SMALL_DIMS if "--small" in sys.argv else None
+        if "--replicas" in sys.argv or "--swap" in sys.argv:
+            # fleet arms (r21): `--serve --replicas 1,2,4 --swap 4` — the
+            # ReplicaSet scale-out sweep plus hot-swaps under load
+            # (docs/bench_fleet_r21.jsonl; regen on TPU, same command).
+            # Replicas need distinct devices: size the virtual CPU mesh.
+            replicas_list = tuple(
+                int(r) for r in (
+                    sys.argv[sys.argv.index("--replicas") + 1].split(",")
+                    if "--replicas" in sys.argv else ("1", "2", "4")
+                )
+            )
+            swaps = (int(sys.argv[sys.argv.index("--swap") + 1])
+                     if "--swap" in sys.argv else 4)
+            _ensure_host_devices(max(replicas_list))
+            for rec in measure_fleet(
+                replicas_list=replicas_list, requests=requests,
+                swaps=swaps, dims=dims,
+            ):
+                print(json.dumps(rec), flush=True)
+            return
         for rec in measure_serving(
             requests=requests, dims=dims, stream_T=stream_T,
         ):

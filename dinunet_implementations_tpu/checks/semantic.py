@@ -1615,6 +1615,21 @@ def run_serving_checks() -> list:
         ],
         path_prefix="lowering://serving/",
     )
+    # S003, publish plane (r21): the hot-swap graft must alias EVERY
+    # params and batch-stats leaf input→output — a publish is pure buffer
+    # donation, so any unaliased leaf means the swap copies (and the
+    # "pause is a graft, not a transfer" claim is false)
+    swap_args = engine._live
+    swap_comp = engine._swap_jit.lower(*swap_args).compile()
+    findings += check_donation(
+        swap_comp, swap_args, (0, 1), "trace://serving/swap"
+    )
+    # S001 on the same program: a collective in the swap graft would stall
+    # every replica's publish on cross-device traffic
+    swap_jaxpr, _, _ = epoch_program_artifacts(engine._swap_jit, *swap_args)
+    findings += check_no_collectives(
+        audit_jaxpr(swap_jaxpr).collectives, "trace://serving/swap"
+    )
     return findings
 
 

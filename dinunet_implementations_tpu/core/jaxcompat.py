@@ -65,6 +65,37 @@ def input_formats_of(compiled):
     return compiled.input_layouts
 
 
+#: last jaxlib known to corrupt the heap when a cache-DESERIALIZED
+#: executable coexists with the donated-table streaming step (see
+#: serving/engine.py warmup and stream_cache_safe below)
+_STREAM_CACHE_BAD_THROUGH = (0, 4)
+
+
+def stream_cache_safe(version: str | None = None) -> bool:
+    """Whether the persistent compile cache may stay enabled while warming
+    the DONATED-table streaming executables.
+
+    On jaxlib 0.4.x (observed 0.4.36, CPU) any cache-deserialized executable
+    living in the process corrupts the heap once the streaming step — whose
+    session table is an input-output-aliased donated buffer — runs
+    (segfault; repro in serving/engine.py warmup docstring and the
+    ``test_stream_cache_gate`` probe). The workaround used to bypass the
+    cache for every streaming warmup unconditionally; this gate narrows it
+    to the known-bad jaxlib range so fixed runtimes get the cache-warm
+    startup back. The subprocess regression probe in tests/test_fleet.py
+    re-runs the repro whenever this gate opens — a jaxlib that still has
+    the bug fails the probe loudly instead of corrupting a server."""
+    if version is None:
+        import jaxlib
+
+        version = jaxlib.__version__
+    try:
+        parts = tuple(int(p) for p in version.split(".")[:2])
+    except ValueError:
+        return False  # unparseable version: keep the safe bypass
+    return parts > _STREAM_CACHE_BAD_THROUGH
+
+
 def enable_compile_cache(path: str) -> None:
     """Point jax's persistent compilation cache at ``path`` (opt-in via
     ``TrainConfig.compile_cache_dir`` / CLI ``--compile-cache``).
@@ -101,5 +132,5 @@ def enable_compile_cache(path: str) -> None:
 
 __all__ = [
     "shard_map", "auto_input_format", "input_formats_of",
-    "enable_compile_cache",
+    "enable_compile_cache", "stream_cache_safe",
 ]

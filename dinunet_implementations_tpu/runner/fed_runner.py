@@ -965,6 +965,30 @@ class FedDaemon:
                     traces=dict(self._traces))
         self.flight.note("checkpoint-publish", epoch=self.epochs_run)
         self.bus.counter("serve_checkpoints_total")
+        self._announce_publish()
+
+    def _announce_publish(self) -> None:
+        """Atomically drop ``publish.json`` beside the rotating checkpoint —
+        the train-to-serve CD announcement (serving/publish.py
+        CheckpointWatcher): the content digest lets a watching fleet skip
+        loading the msgpack at all when the weights didn't change (held
+        rounds re-checkpoint the same params)."""
+        from ..trainer.checkpoint import params_digest
+
+        note = {
+            "path": self.ckpt_path,
+            "epoch": self.epochs_run,
+            "digest": params_digest(
+                self.state.params, getattr(self.state, "batch_stats", None)
+            ),
+            "membership_epoch": self.table.epoch,
+        }
+        tmp = self.ckpt_path + ".publish.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(note, fh)
+        os.replace(tmp, os.path.join(
+            os.path.dirname(self.ckpt_path), "publish.json"
+        ))
 
     def _resume(self) -> bool:
         """Restore the service from its last checkpoint: membership table +

@@ -1,4 +1,5 @@
-"""Serving path (r15): AOT-compiled, continuously-batched inference.
+"""Serving path (r15, fleet r21): AOT-compiled, continuously-batched
+inference — now a replicated fleet with train-to-serve CD.
 
 The first surface that ANSWERS a request (ROADMAP item 5): an
 :class:`~.engine.InferenceEngine` loads a trained checkpoint (params +
@@ -8,24 +9,46 @@ continuous microbatcher with max-batch/max-delay admission — plus an O(1)
 per-session streaming lane for causal recurrent heads (device-resident
 session-slot carry table, models/icalstm.py ICALstmStream).
 
+r21 stacks three production planes on that engine:
+
+- :class:`~.fleet.ReplicaSet` — N engine replicas across devices with
+  session-SHARDED affinity routing, membership generations, and a
+  supervisor that restarts crashed replicas (re-homed sessions re-enter
+  through the fresh gate, bit-exact);
+- :mod:`~.publish` — the FedDaemon checkpoint rotation as a publish
+  stream: shadow-lane scoring, zero-recompile donated hot-swaps, and
+  SLO-error-budget auto-rollback;
+- :mod:`~.admission` — deadline/priority/load-shedding admission on the
+  microbatcher with a p99-targeted max-delay autotuner.
+
     python -m dinunet_implementations_tpu.serving \
         --data-path datasets/demo --checkpoint out/.../checkpoint_best.msgpack \
-        --smoke 100 --out-dir out
+        --replicas 2 --smoke 100 --out-dir out
 
-See docs/ARCHITECTURE.md "Serving (r15)".
+See docs/ARCHITECTURE.md "Serving (r15)" and "Serving fleet (r21)".
 """
 
+from .admission import AutotunerDaemon, DelayAutotuner
 from .engine import InferenceEngine, ServingError
+from .fleet import ReplicaSet, home_slot
 from .microbatch import Microbatcher, RequestError, RequestFuture
+from .publish import CheckpointWatcher, PublishController, PublishDaemon
 from .session import SessionError, SessionTable, init_carry_table
 
 __all__ = [
+    "AutotunerDaemon",
+    "CheckpointWatcher",
+    "DelayAutotuner",
     "InferenceEngine",
     "Microbatcher",
+    "PublishController",
+    "PublishDaemon",
+    "ReplicaSet",
     "RequestError",
     "RequestFuture",
     "ServingError",
     "SessionError",
     "SessionTable",
+    "home_slot",
     "init_carry_table",
 ]

@@ -15,6 +15,17 @@ synthetic smoke mix; each line is one op:
     {"op": "infer", "n": 3, "rows": 2}     # 3 requests of 2 samples each
     {"op": "stream", "session": "s0", "windows": 4}
     {"op": "drain"}                        # barrier: wait for all futures
+    {"op": "swap", "checkpoint": "PATH"}   # publish a candidate (CD plane)
+    {"op": "rollback_check"}               # one SLO-burn probation verdict
+    {"op": "kill_replica", "slot": 0}      # fleet fault drill (--replicas>1)
+
+``--replicas N`` (N > 1) serves through a :class:`~.fleet.ReplicaSet`
+instead of a single engine — same script surface, session-sharded routing,
+per-replica telemetry series (label ``replica``) plus the fleet rollup.
+The ``swap`` / ``rollback_check`` ops drive a
+:class:`~.publish.PublishController` against whichever target is live, so
+the CI smoke proves zero-compile hot-swaps and SLO-burn rollback on the
+exact production wiring.
 
 Telemetry (always on here — a serving run with no latency record is not
 evidence): manifest.json + metrics.jsonl (per-dispatch rows + the final
@@ -66,6 +77,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-delay-ms", type=float, default=2.0,
                    help="microbatch admission: max wait before a partial "
                         "bucket dispatches")
+    p.add_argument("--replicas", type=int, default=1, metavar="N",
+                   help="serve through a ReplicaSet of N engine replicas "
+                        "(session-sharded affinity, supervised restarts); "
+                        "1 = single engine (default)")
+    p.add_argument("--max-queue", type=int, default=None, metavar="N",
+                   help="admission: shed new requests once a lane's queue "
+                        "holds N (default unbounded)")
+    p.add_argument("--rollback-burn", type=float, default=1.0,
+                   metavar="BURN",
+                   help="CD plane: SLO error-budget burn above which a "
+                        "rollback_check swaps back (1.0 = the full budget)")
+    p.add_argument("--rollback-window", type=int, default=20, metavar="N",
+                   help="CD plane: minimum post-swap latency samples before "
+                        "a rollback_check returns a verdict")
     p.add_argument("--compile-cache", default=None, metavar="DIR",
                    help="persistent XLA compile cache: warm restarts load "
                         "the bucket executables from disk")
@@ -137,10 +162,13 @@ class _Pool:
         return self.inputs[ix]
 
 
-def run_script(engine, ops: list[dict], pool: _Pool, verbose: bool) -> int:
+def run_script(engine, ops: list[dict], pool: _Pool, verbose: bool,
+               publisher=None) -> int:
     """Execute a request script; returns the number of requests fired.
     Futures are collected and resolved at each drain (and at the end), so a
-    dispatch error surfaces as a CLI failure, not a lost request."""
+    dispatch error surfaces as a CLI failure, not a lost request.
+    ``publisher`` (a :class:`~.publish.PublishController`) enables the
+    ``swap`` / ``rollback_check`` CD ops."""
     futures = []
     stream_pos: dict[str, int] = {}
     fired = 0
@@ -176,6 +204,50 @@ def run_script(engine, ops: list[dict], pool: _Pool, verbose: bool) -> int:
             drain()
         elif kind == "close_session":
             engine.close_session(str(op["session"]))
+        elif kind == "swap":
+            if publisher is None:
+                raise SystemExit("swap op needs the CD plane (main wires it)")
+            from ..trainer.checkpoint import (
+                load_inference_state,
+                params_digest,
+            )
+
+            drain()  # in-flight requests finish on the params they saw
+            params, stats, _ = load_inference_state(str(op["checkpoint"]))
+            row = publisher.publish(
+                params, stats,
+                digest=op.get("digest") or params_digest(params, stats),
+            )
+            if verbose:
+                print(json.dumps(row, default=str))
+        elif kind == "rollback_check":
+            if publisher is None:
+                raise SystemExit(
+                    "rollback_check op needs the CD plane (main wires it)"
+                )
+            drain()
+            row = publisher.check_rollback()
+            if verbose:
+                print(json.dumps(row, default=str))
+        elif kind == "kill_replica":
+            if not hasattr(engine, "kill_replica"):
+                raise SystemExit("kill_replica op needs --replicas > 1")
+            drain()
+            slot = int(op.get("slot", 0))
+            want = engine.restarts + 1
+            engine.kill_replica(slot)
+            if op.get("wait_restart", True):
+                import time as _time
+
+                deadline = _time.monotonic() + 60.0
+                while _time.monotonic() < deadline:
+                    if engine.restarts >= want and engine._replica_alive(slot):
+                        break
+                    _time.sleep(0.02)
+                else:
+                    raise SystemExit(
+                        f"replica {slot} did not restart within 60s"
+                    )
         else:
             raise SystemExit(f"unknown script op {op!r}")
     drain()
@@ -237,6 +309,8 @@ def main(argv: list[str] | None = None) -> int:
     from ..telemetry.bus import global_bus
     from ..telemetry.flight import FlightRecorder
     from .engine import InferenceEngine
+    from .fleet import ReplicaSet
+    from .publish import PublishController
 
     # live observability plane (r16): process bus + flight recorder (dumps
     # the final spans/bus snapshot on SIGTERM or an unhandled exception),
@@ -246,12 +320,23 @@ def main(argv: list[str] | None = None) -> int:
     flight = FlightRecorder(out_dir, bus=bus, tracer=tracer)
     flight.install()  # no PreemptionGuard here: own SIGTERM + excepthook
 
-    engine = InferenceEngine(
-        cfg, checkpoint=ckpt,
+    lane_kwargs = dict(
         row_buckets=[int(b) for b in args.row_buckets.split(",")],
         stream_buckets=[int(b) for b in args.stream_buckets.split(",")],
         stream_chunk=args.stream_chunk, stream_slots=args.stream_slots,
-        max_delay_ms=args.max_delay_ms, tracer=tracer, sink=sink, bus=bus,
+        max_delay_ms=args.max_delay_ms, max_queue=args.max_queue,
+        tracer=tracer, sink=sink, bus=bus,
+    )
+    if args.replicas > 1:
+        engine = ReplicaSet(
+            cfg, replicas=args.replicas, checkpoint=ckpt, **lane_kwargs
+        )
+    else:
+        engine = InferenceEngine(cfg, checkpoint=ckpt, **lane_kwargs)
+    publisher = PublishController(
+        engine, bus=bus, sink=sink, p99_target_ms=args.slo_p99_ms,
+        rollback_burn=args.rollback_burn,
+        min_window_samples=args.rollback_window,
     )
     exporter = None
     if args.statusz_port is not None:
@@ -277,13 +362,15 @@ def main(argv: list[str] | None = None) -> int:
                 "executables": warm,
                 "streaming": engine.streaming,
                 "checkpoint": ckpt,
+                "replicas": args.replicas,
             }))
         if args.script is not None:
             with open(args.script) as fh:
                 ops = [json.loads(ln) for ln in fh if ln.strip()]
         else:
             ops = smoke_script(args.smoke, engine.streaming)
-        run_script(engine, ops, pool, verbose=not args.quiet)
+        run_script(engine, ops, pool, verbose=not args.quiet,
+                   publisher=publisher)
         if args.linger_s:
             import time
 

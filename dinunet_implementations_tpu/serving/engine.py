@@ -62,10 +62,11 @@ class _Req:
     """One queued request (either lane)."""
 
     __slots__ = ("rows", "weights", "future", "session", "slot", "generation",
-                 "fresh", "step_valid", "trace_id", "_submit_t")
+                 "fresh", "step_valid", "trace_id", "_submit_t", "_seq",
+                 "priority", "deadline_ms")
 
     def __init__(self, rows, weights=None, session=None, step_valid=None,
-                 trace_id=None):
+                 trace_id=None, priority: int = 0, deadline_ms=None):
         from ..telemetry.tracer import new_trace_id
         from .microbatch import RequestFuture
 
@@ -75,6 +76,12 @@ class _Req:
         self.step_valid = step_valid
         self.slot = self.generation = 0
         self.fresh = False
+        # admission (r21): higher priority collects first; deadline_ms is
+        # the submit-relative staleness bound past which the request is
+        # SHED instead of dispatched (None = never)
+        self.priority = int(priority)
+        self.deadline_ms = deadline_ms
+        self._seq = 0
         # cross-process trace propagation: a caller-supplied id (a client's
         # request id, a spool event's trace) or a fresh one — it lands in
         # the dispatch row and the serve span, so one request is followable
@@ -97,7 +104,10 @@ class InferenceEngine:
                  stream_chunk: int = DEFAULT_STREAM_CHUNK,
                  stream_slots: int = 32,
                  max_delay_ms: float = 2.0,
+                 max_queue: int | None = None,
                  streaming: bool | None = None,
+                 device=None, bus_labels: dict | None = None,
+                 close_sink: bool = True,
                  tracer=None, sink=None, bus=None):
         import jax
 
@@ -130,8 +140,23 @@ class InferenceEngine:
         self.task = FederatedTask(
             self.model, has_batch_stats=bool(batch_stats)
         )
-        self._params = jax.device_put(params)
-        self._stats = jax.device_put(batch_stats or {})
+        # every device-resident buffer of this engine — params, batch stats,
+        # the streaming carry table, and all AOT executables — pins to ONE
+        # device (``device=None`` keeps jax's default, the single-engine
+        # behavior). A ReplicaSet (serving/fleet.py) hands each replica its
+        # own device, so N replicas are N independent single-device servers:
+        # the request path stays collective-free per replica (S001).
+        self.device = device
+        self._bus_labels = dict(bus_labels or {})
+        self._close_sink = close_sink
+        # params + batch_stats live as ONE tuple bound by a single attribute
+        # store/read (atomic under the GIL): a hot-swap (swap_params) rebinds
+        # the tuple while dispatch threads are mid-flight, and a dispatch
+        # must never pair new params with old stats
+        self._live = (
+            jax.device_put(params, device),
+            jax.device_put(batch_stats or {}, device),
+        )
         self.sample_shape = tuple(self.spec.serving.sample_shape(cfg))
         self.row_buckets = tuple(sorted(set(int(b) for b in row_buckets)))
         self.stream_chunk = int(stream_chunk)
@@ -158,8 +183,17 @@ class InferenceEngine:
         self._latencies: list = []  # (lane, seconds) per request
         self._t0 = time.monotonic()
         self.warmup_seconds = 0.0
-        self.stats = {"requests": 0, "samples": 0, "stream_chunks": 0}
+        self.stats = {
+            "requests": 0, "samples": 0, "stream_chunks": 0, "swaps": 0,
+        }
         self._max_delay_ms = max_delay_ms
+        self._max_queue = max_queue
+        # mirror ring: the last few batched dispatch payloads, kept for
+        # shadow-lane scoring of a publish candidate against REAL recent
+        # traffic (serving/publish.py) — the candidate runs through the same
+        # stored executables these payloads already ran through
+        self._mirror: list = []
+        self._mirror_cap = 4
 
         # -- the two jitted entry points (warmup traces them; the request
         # path only runs their stored AOT executables)
@@ -169,6 +203,17 @@ class InferenceEngine:
             return eval_forward(self.task, params, stats, x, None, w)
 
         self._infer_jit = jax.jit(infer_fn)
+        # the hot-swap graft: an identity over (params, batch_stats) with
+        # BOTH arguments donated — XLA aliases every input leaf straight into
+        # the output (the S003 fleet cell proves it), so installing a
+        # published candidate is a zero-copy buffer donation onto this
+        # engine's device, never a recompile (executables are keyed by shape,
+        # and swap_params refuses shape drift loudly). Compiled AOT at warmup
+        # and counted by the same CompileGuard as the request lanes: the
+        # zero-compile proof extends ACROSS publishes.
+        self._swap_jit = jax.jit(
+            lambda p, s: (p, s), donate_argnums=(0, 1)
+        )
 
         self._stream_jit = None
         self._table = None
@@ -197,11 +242,21 @@ class InferenceEngine:
             )
             self.sessions = SessionTable(stream_slots)
             self._table = jax.device_put(
-                init_carry_table(stream_slots, a.hidden_size)
+                init_carry_table(stream_slots, a.hidden_size), device
             )
             self._stream_jit = jax.jit(
                 self._stream_step, donate_argnums=(2,)
             )
+
+    # the pre-swap names, kept as views of the atomic live tuple (tests,
+    # bench and the semantic cells read them)
+    @property
+    def _params(self):
+        return self._live[0]
+
+    @property
+    def _stats(self):
+        return self._live[1]
 
     # -- traced programs -------------------------------------------------
 
@@ -252,22 +307,27 @@ class InferenceEngine:
         input-output-aliased buffer) corrupts the heap — reproduced by
         building the engine twice against one cache dir and streaming a few
         chunks (segfault); fresh-compiled executables are fine, and so is a
-        cache-restart of the donation-free batched lane alone. So a
-        STREAMING engine bypasses the cache for its whole warmup (paying a
-        fresh compile per start — correctness over restart latency), while
-        a batched-only engine keeps the PR 4 cache's cold/warm win
-        (``bench.py --serve`` measures it on exactly that shape)."""
+        cache-restart of the donation-free batched lane alone. The bypass is
+        gated on the KNOWN-BAD jaxlib range
+        (core/jaxcompat.py ``stream_cache_safe``): on those runtimes a
+        streaming engine pays a fresh compile per start (correctness over
+        restart latency); on fixed runtimes the cache-warm startup comes
+        back, and the tests/test_fleet.py subprocess probe re-runs the repro
+        so a still-broken jaxlib fails loudly. A batched-only engine keeps
+        the PR 4 cache's cold/warm win everywhere (``bench.py --serve``
+        measures it on exactly that shape)."""
         import jax
         import jax.numpy as jnp
 
         from ..checks.sanitize import CompileGuard
+        from ..core.jaxcompat import stream_cache_safe
 
         t0 = time.monotonic()
         times = {}
         cache_prev = jax.config.jax_enable_compilation_cache
         with self.tracer.span("serve-warmup"):
             try:
-                if self.streaming:
+                if self.streaming and not stream_cache_safe():
                     jax.config.update("jax_enable_compilation_cache", False)
                 for b in self.row_buckets:
                     tb = time.monotonic()
@@ -299,6 +359,11 @@ class InferenceEngine:
                         times[f"stream/{b}"] = round(
                             time.monotonic() - tb, 4
                         )
+                tb = time.monotonic()
+                self._exec[("swap", 0)] = self._swap_jit.lower(
+                    *self._live
+                ).compile()
+                times["swap/0"] = round(time.monotonic() - tb, 4)
             finally:
                 jax.config.update(
                     "jax_enable_compilation_cache", cache_prev
@@ -306,9 +371,12 @@ class InferenceEngine:
         self.warmup_seconds = round(time.monotonic() - t0, 4)
         # zero-compile proof: the jitted entries must gain NO cached programs
         # from here on (the request path runs only the stored executables —
-        # any growth means a silent fallback traced)
+        # any growth means a silent fallback traced). swap_fn is in the set
+        # ON PURPOSE: the proof holds ACROSS params hot-swaps, N publishes
+        # included.
         self._guard = CompileGuard(
-            {"infer_fn": self._infer_jit, "stream_fn": self._stream_jit},
+            {"infer_fn": self._infer_jit, "stream_fn": self._stream_jit,
+             "swap_fn": self._swap_jit},
             max_compiles=0, label="serving",
         )
         self._start_lanes()
@@ -320,8 +388,9 @@ class InferenceEngine:
 
         self._infer_lane = Microbatcher(
             self._dispatch_infer, self.row_buckets,
-            max_delay_ms=self._max_delay_ms, name="infer",
-            on_dispatch=self._record_dispatch, bus=self.bus,
+            max_delay_ms=self._max_delay_ms, max_queue=self._max_queue,
+            name="infer", on_dispatch=self._record_dispatch, bus=self.bus,
+            labels=self._bus_labels,
         )
         self._stream_lane = None
         if self.streaming:
@@ -329,8 +398,9 @@ class InferenceEngine:
                 self._dispatch_stream, self.stream_buckets,
                 rows_of=lambda req: 1,
                 conflict_key=lambda req: req.session,
-                max_delay_ms=self._max_delay_ms, name="stream",
-                on_dispatch=self._record_dispatch, bus=self.bus,
+                max_delay_ms=self._max_delay_ms, max_queue=self._max_queue,
+                name="stream", on_dispatch=self._record_dispatch,
+                bus=self.bus, labels=self._bus_labels,
             )
 
     # -- request path (Compiled executables only) ------------------------
@@ -342,6 +412,7 @@ class InferenceEngine:
                 "rows": int(rows), "pad_rows": int(bucket - rows),
                 "queue_depth": int(depth),
                 "trace_ids": [r.trace_id for r in batch],
+                **self._bus_labels,
             })
 
     def _finish(self, reqs, lane: str) -> None:
@@ -353,9 +424,12 @@ class InferenceEngine:
         for r in reqs:
             self.bus.observe(
                 "serving_request_latency_ms", (now - r._submit_t) * 1e3,
-                lane=lane,
+                lane=lane, **self._bus_labels,
             )
-        self.bus.counter("serving_requests_total", len(reqs), lane=lane)
+        self.bus.counter(
+            "serving_requests_total", len(reqs), lane=lane,
+            **self._bus_labels,
+        )
 
     def _dispatch_infer(self, reqs, bucket: int) -> None:
         """Pack collected requests into the bucket's padded batch and run its
@@ -372,11 +446,17 @@ class InferenceEngine:
             w[at:at + n] = 1.0 if r.weights is None else r.weights
             spans.append((r, at, n))
             at += n
+        params, stats = self._live
         with self.tracer.span("serve-infer", bucket=bucket, rows=at,
                               trace_ids=[r.trace_id for r in reqs]):
             probs = np.asarray(self._exec[("infer", bucket)](
-                self._params, self._stats, x, w
+                params, stats, x, w
             ))
+        with self._lock:
+            # mirror the dispatch payload for shadow-lane scoring (a small
+            # ring; the arrays are already padded host copies)
+            self._mirror.append((bucket, x, w))
+            del self._mirror[:-self._mirror_cap]
         for r, lo, n in spans:
             r.future.set_result(probs[lo:lo + n])
         with self._lock:
@@ -406,10 +486,11 @@ class InferenceEngine:
             x[i, :n] = r.rows
             sv[i, :n] = 1.0 if r.step_valid is None else r.step_valid
             valid[i] = 1.0
+        params, stats = self._live
         with self.tracer.span("serve-stream", bucket=bucket, rows=len(reqs),
                               trace_ids=[r.trace_id for r in reqs]):
             probs, self._table = self._exec[("stream", bucket)](
-                self._params, self._stats, self._table,
+                params, stats, self._table,
                 slot_ix, fresh, x, sv, valid,
             )
             probs = np.asarray(probs)
@@ -424,18 +505,26 @@ class InferenceEngine:
             self.stats["stream_chunks"] += len(reqs)
         with self._session_lock:
             occupied, evictions = self.sessions.occupied, self.sessions.evictions
-        self.bus.gauge("serving_sessions_occupied", occupied)
-        self.bus.gauge("serving_session_evictions", evictions)
+        self.bus.gauge(
+            "serving_sessions_occupied", occupied, **self._bus_labels
+        )
+        self.bus.gauge(
+            "serving_session_evictions", evictions, **self._bus_labels
+        )
         self._finish(reqs, "stream")
 
     # -- public API ------------------------------------------------------
 
-    def submit(self, rows, weights=None, trace_id=None):
+    def submit(self, rows, weights=None, trace_id=None, priority: int = 0,
+               deadline_ms=None):
         """Batched inference: ``rows [n, ...sample_shape]`` → future of
         ``probs [n, C]``. ``weights`` masks rows (eval semantics);
         ``trace_id`` propagates a caller's request id into the dispatch
         row + span (auto-minted when absent; readable on the returned
-        future's ``.trace_id``)."""
+        future's ``.trace_id``). ``priority`` (higher first) and
+        ``deadline_ms`` (shed when staler than this at collection — the
+        future then raises :class:`~.microbatch.RequestError`) feed the
+        microbatcher's admission (r21)."""
         self._ensure_warm()
         rows = np.asarray(rows, np.float32)
         if rows.shape[1:] != self.sample_shape:
@@ -443,16 +532,22 @@ class InferenceEngine:
                 f"request rows shaped {rows.shape[1:]} but task "
                 f"{self.cfg.task_id!r} serves {self.sample_shape}"
             )
-        req = _Req(rows, weights=weights, trace_id=trace_id)
+        req = _Req(rows, weights=weights, trace_id=trace_id,
+                   priority=priority, deadline_ms=deadline_ms)
         self._infer_lane.submit(req)
         return req.future
 
-    def stream(self, session_id: str, windows, trace_id=None):
+    def stream(self, session_id: str, windows, trace_id=None,
+               priority: int = 0):
         """Streaming inference: feed ``windows [t, C, W]`` (the session's NEW
         timesteps) and get a future of the classification over everything
         the session has seen. Runs longer than one chunk are split into
         in-order chunk submissions (all sharing one ``trace_id``); the
-        returned future is the LAST chunk's (the full-prefix answer)."""
+        returned future is the LAST chunk's (the full-prefix answer).
+        ``priority`` raises the chunks in the lane's admission order; there
+        is deliberately NO deadline on stream chunks — shedding a middle
+        chunk would silently drop windows from the carry, breaking the
+        chunked == full-replay exactness contract."""
         self._ensure_warm()
         if not self.streaming:
             raise ServingError(
@@ -481,7 +576,7 @@ class InferenceEngine:
         links = []
         for lo in range(0, len(windows), self.stream_chunk):
             req = _Req(windows[lo:lo + self.stream_chunk], session=session_id,
-                       trace_id=trace_id)
+                       trace_id=trace_id, priority=priority)
             self._stream_lane.submit(req)
             links.append(req.future)
         # the chain surfaces ANY chunk's dispatch error — a failed middle
@@ -496,6 +591,129 @@ class InferenceEngine:
     def close_session(self, session_id: str) -> None:
         with self._session_lock:
             self.sessions.close(session_id)
+
+    # -- params hot-swap (train-to-serve CD, serving/publish.py) ---------
+
+    def _swap_shape_mismatch(self, new_params, new_stats) -> list:
+        """Human-readable mismatches between a candidate weight tree and the
+        live one (treedef + per-leaf shape/dtype). Executables are keyed by
+        these shapes, so ANY mismatch means the candidate cannot ride the
+        compiled set — the caller must refuse, never recompile."""
+        import jax
+
+        problems = []
+        for what, new, cur in (
+            ("params", new_params, self._live[0]),
+            ("batch_stats", new_stats, self._live[1]),
+        ):
+            if (jax.tree_util.tree_structure(new)
+                    != jax.tree_util.tree_structure(cur)):
+                problems.append(f"{what}: tree structure differs")
+                continue
+            for n, c in zip(jax.tree.leaves(new), jax.tree.leaves(cur)):
+                if (tuple(n.shape) != tuple(c.shape)
+                        or np.dtype(n.dtype) != np.dtype(c.dtype)):
+                    problems.append(
+                        f"{what}: leaf {tuple(n.shape)}/{n.dtype} vs live "
+                        f"{tuple(c.shape)}/{c.dtype}"
+                    )
+        return problems
+
+    def weights(self) -> tuple:
+        """The live ``(params, batch_stats)`` device arrays. A publish
+        controller retains this tuple before a swap — it is the rollback
+        target (the swap drops the engine's own reference)."""
+        return self._live
+
+    def swap_params(self, params, batch_stats=None) -> dict:
+        """Install new weights with the pre-compiled donated graft: the
+        candidate's buffers are device_put onto this engine's device and
+        DONATED into the swap executable, whose outputs alias them in place
+        (zero copy, zero compile — the warmup CompileGuard keeps counting).
+        The engine takes ownership of the passed arrays if they already live
+        on its device. Shape-keyed: any treedef/shape/dtype drift from the
+        live weights raises :class:`ServingError` — a retrain that changed
+        the architecture needs a new engine, not a swap. Returns
+        ``{"pause_ms": ...}`` (the wall time requests could observe)."""
+        import jax
+
+        self._ensure_warm()
+        new = (
+            jax.device_put(params, self.device),
+            jax.device_put(batch_stats or {}, self.device),
+        )
+        problems = self._swap_shape_mismatch(*new)
+        if problems:
+            raise ServingError(
+                "hot-swap refused — candidate weights do not match the "
+                "compiled executables' shapes (publish a same-architecture "
+                "checkpoint, or stand up a new engine): "
+                + "; ".join(problems)
+            )
+        t0 = time.monotonic()
+        grafted = self._exec[("swap", 0)](*new)
+        jax.block_until_ready(grafted)
+        self._live = tuple(grafted)
+        pause_ms = (time.monotonic() - t0) * 1e3
+        with self._lock:
+            self.stats["swaps"] += 1
+        self.bus.counter("serving_swaps_total", **self._bus_labels)
+        self.bus.observe(
+            "serving_swap_pause_ms", pause_ms, **self._bus_labels
+        )
+        return {"pause_ms": round(pause_ms, 4)}
+
+    def shadow_score(self, params, batch_stats=None) -> dict:
+        """Score a publish candidate against MIRRORED live traffic: replay
+        the last few batched dispatch payloads through the same stored
+        executables with the candidate's weights (donation-free lane — the
+        live state is untouched). Returns finiteness plus the max
+        probability shift vs the live weights; the publish controller
+        rejects non-finite candidates before any swap. Shape drift raises
+        like :meth:`swap_params`."""
+        import jax
+
+        self._ensure_warm()
+        cand = (
+            jax.device_put(params, self.device),
+            jax.device_put(batch_stats or {}, self.device),
+        )
+        problems = self._swap_shape_mismatch(*cand)
+        if problems:
+            raise ServingError(
+                "shadow-score refused — candidate weights do not match the "
+                "compiled executables' shapes: " + "; ".join(problems)
+            )
+        with self._lock:
+            ring = list(self._mirror)
+        if not ring:
+            # no traffic mirrored yet (publish before first dispatch):
+            # score on a zero payload at the smallest bucket — still proves
+            # the candidate produces finite probabilities
+            b = self.row_buckets[0]
+            ring = [(
+                b, np.zeros((b,) + self.sample_shape, np.float32),
+                np.ones((b,), np.float32),
+            )]
+        live = self._live
+        finite = True
+        max_delta = 0.0
+        rows = 0
+        for bucket, x, w in ring:
+            got = np.asarray(self._exec[("infer", bucket)](*cand, x, w))
+            ref = np.asarray(self._exec[("infer", bucket)](*live, x, w))
+            mask = np.asarray(w) > 0
+            rows += int(mask.sum())
+            if not np.isfinite(got[mask]).all():
+                finite = False
+            else:
+                max_delta = max(
+                    max_delta, float(np.abs(got[mask] - ref[mask]).max())
+                )
+        return {
+            "batches": len(ring), "rows": rows, "finite": finite,
+            "max_abs_delta": round(max_delta, 6),
+        }
 
     def _ensure_warm(self) -> None:
         if not self._warm:
@@ -554,9 +772,11 @@ class InferenceEngine:
             "streaming": self.streaming,
             "requests": self.stats["requests"],
             "samples": self.stats["samples"],
+            "swaps": self.stats["swaps"],
             "stream_sessions": occupied,
             "queue_depth": sum(L.depth() for L in lanes),
             "deferrals": sum(L.stats["deferrals"] for L in lanes),
+            "shed": sum(L.stats["shed"] for L in lanes),
             "compiles_after_warmup": sum(
                 self.compiles_after_warmup().values()
             ),
@@ -605,6 +825,9 @@ class InferenceEngine:
                 (L.stats["max_queue_depth"] for L in lanes), default=0
             ),
             "deferrals": sum(L.stats["deferrals"] for L in lanes),
+            "shed": sum(L.stats["shed"] for L in lanes),
+            "swaps": self.stats["swaps"],
+            **self._bus_labels,
             "checkpoint_traces": self.meta.get("traces") or {},
             "warmup_seconds": self.warmup_seconds,
             "buckets": {
@@ -627,7 +850,10 @@ class InferenceEngine:
         summary = self.summary()
         if self.sink is not None:
             self.sink.append(summary)
-            self.sink.close()
+            if self._close_sink:
+                # a fleet shares one sink across replicas and closes it
+                # once itself (close_sink=False per replica)
+                self.sink.close()
         self.assert_no_compiles()
         return summary
 

@@ -12,16 +12,32 @@ bucketing: the compiled-shape set is closed at warmup).
 
 Admission details that matter:
 
-- **FIFO with conflict stash**: requests dispatch in arrival order, except a
+- **Priority lanes over arrival order (r21)**: collection picks the
+  highest-``priority`` pending request first, oldest-first within a
+  priority — with every request at the default priority 0 this is exactly
+  the pre-r21 FIFO. Priorities reorder only what is CONCURRENTLY pending;
+  nothing starves forever because a batch ends at the first request that
+  doesn't fit (see below), bounding how far a big low-priority request can
+  be overtaken.
+- **Deadline shedding (r21)**: a request carrying ``deadline_ms`` that is
+  staler than that at collection time is SHED — its future raises
+  :class:`RequestError` immediately instead of wasting a dispatch slot on
+  an answer the client already gave up on. ``max_queue`` sheds at ADMISSION
+  (submit raises) once the lane's depth hits the bound — backpressure
+  before queueing, not after.
+- **Conflict deferral**: requests dispatch in admission order, except a
   request whose ``conflict_key`` collides with one already collected (two
   chunks of the SAME streaming session — the second must see the first's
-  updated carry) is stashed for the next dispatch, preserving order.
+  updated carry) stays pending for the next dispatch, preserving order.
 - **No oversize silently**: a request bigger than the largest bucket is
   rejected at submit with a clear error — splitting is the caller's policy
   decision (the engine's ``stream()`` splits long window runs into
   chunk-bucket pieces before submitting).
 - The dispatch thread is a **daemon** and closes via sentinel, so a crashed
   caller never wedges interpreter shutdown (the with_retry lesson, r13).
+
+``max_delay_s`` is a plain mutable attribute on purpose: the p99-targeted
+autotuner (serving/admission.py) retunes it live between dispatches.
 """
 
 from __future__ import annotations
@@ -79,8 +95,9 @@ class Microbatcher:
     (optional) serializes requests that must not share a dispatch."""
 
     def __init__(self, dispatch, buckets, *, rows_of=None, conflict_key=None,
-                 max_delay_ms: float = 2.0, name: str = "lane",
-                 on_dispatch=None, bus=None):
+                 max_delay_ms: float = 2.0, max_queue: int | None = None,
+                 name: str = "lane", on_dispatch=None, bus=None,
+                 labels: dict | None = None):
         from ..telemetry.bus import NULL_BUS
 
         if not buckets:
@@ -90,17 +107,25 @@ class Microbatcher:
         self.rows_of = rows_of or (lambda req: len(req.rows))
         self.conflict_key = conflict_key
         self.max_delay_s = max_delay_ms / 1e3
+        self.max_queue = max_queue
         self.name = name
         self.on_dispatch = on_dispatch
         self.bus = bus if bus is not None else NULL_BUS
+        # extra label set on every bus series this lane publishes (a fleet
+        # replica's {"replica": "<slot>"} — per-replica /metrics series)
+        self.labels = dict(labels or {})
         self._q: queue.Queue = queue.Queue()
-        self._stash: list = []  # conflict-deferred, ahead of the queue
+        # admission-ordered requests awaiting collection; owned by the
+        # dispatch thread (submit only touches the queue)
+        self._pending: list = []
+        self._sentinel = False
+        self._seq = 0
         self._closed = False
         self._stats_lock = threading.Lock()
         self.stats = {
             "requests": 0, "dispatches": 0, "rows": 0, "pad_rows": 0,
             "bucket_hits": 0, "rejected": 0, "max_queue_depth": 0,
-            "deferrals": 0,
+            "deferrals": 0, "shed": 0,
         }
         self._thread = threading.Thread(
             target=self._run, name=f"microbatch-{name}", daemon=True
@@ -131,7 +156,19 @@ class Microbatcher:
                 f"{self.name}: request of {rows} rows exceeds the largest "
                 f"bucket ({self.max_rows})"
             )
+        if self.max_queue is not None and self.depth() >= self.max_queue:
+            # load shedding at ADMISSION: past the depth bound the caller
+            # hears "no" immediately instead of queueing into a latency
+            # cliff (the answer would blow its deadline anyway)
+            self._note_shed("queue_full")
+            raise RequestError(
+                f"{self.name}: queue full ({self.max_queue} pending) — "
+                f"request shed at admission"
+            )
         req._submit_t = time.monotonic()
+        with self._stats_lock:
+            self._seq += 1
+            req._seq = self._seq
         self._q.put(req)
         # peak depth must be sampled at ENQUEUE too: sampling only at
         # dispatch time (the pre-r16 behavior) under-reported any burst that
@@ -139,81 +176,160 @@ class Microbatcher:
         self._note_depth()
 
     def depth(self) -> int:
-        """Instantaneous queue depth (queued + stash-deferred requests) —
-        the ONE definition /statusz, drain() and the peak sampler share."""
-        return self._q.qsize() + len(self._stash)
+        """Instantaneous queue depth (queued + collection-pending requests)
+        — the ONE definition /statusz, drain() and the peak sampler share."""
+        return self._q.qsize() + len(self._pending)
 
     def _note_depth(self) -> int:
         depth = self.depth()
         with self._stats_lock:
             if depth > self.stats["max_queue_depth"]:
                 self.stats["max_queue_depth"] = depth
-        self.bus.gauge("serving_queue_depth", depth, lane=self.name)
+        self.bus.gauge(
+            "serving_queue_depth", depth, lane=self.name, **self.labels
+        )
         return depth
 
     # -- dispatch thread -------------------------------------------------
 
-    def _collect(self, first) -> list:
-        """Admission: grow the batch from the queue until the largest bucket
-        is full or the FIRST request's max-delay budget runs out."""
+    @staticmethod
+    def _order(req) -> tuple:
+        """Collection order: highest priority first, then admission order
+        (all-default-priority traffic is exactly the pre-r21 FIFO)."""
+        return (-getattr(req, "priority", 0), getattr(req, "_seq", 0))
+
+    def _fill(self, block: bool) -> None:
+        """Move queued requests into ``_pending`` (optionally blocking for
+        the first); latches ``_sentinel`` when close() is seen."""
+        if block and not self._sentinel:
+            item = self._q.get()
+            if item is None:
+                self._sentinel = True
+            else:
+                self._pending.append(item)
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if item is None:
+                self._sentinel = True
+            else:
+                self._pending.append(item)
+
+    def _shed_expired(self) -> None:
+        """Deadline admission: fail (don't dispatch) any pending request
+        already staler than its own ``deadline_ms``."""
+        now = time.monotonic()
+        keep = []
+        for r in self._pending:
+            d = getattr(r, "deadline_ms", None)
+            if d is not None and now > r._submit_t + d / 1e3:
+                self._note_shed("deadline")
+                r.future.set_exception(RequestError(
+                    f"{self.name}: request shed — waited "
+                    f"{(now - r._submit_t) * 1e3:.1f} ms, past its "
+                    f"{d} ms deadline"
+                ))
+            else:
+                keep.append(r)
+        self._pending = keep
+
+    def _pick(self, keys: set, space: int, counted: set) -> tuple:
+        """``(request, stop)``: pop the best eligible pending request
+        (:meth:`_order`, skipping conflicts). ``stop=True`` when the best
+        eligible does not fit ``space`` — the batch ends there (order
+        fairness: a big request is deferred at most one dispatch, never
+        overtaken indefinitely by smaller later arrivals)."""
+        best_i = None
+        for i, r in enumerate(self._pending):
+            if (self.conflict_key is not None and keys
+                    and self.conflict_key(r) in keys):
+                if r._seq not in counted:
+                    counted.add(r._seq)
+                    self._note_deferral("conflict")
+                continue
+            if best_i is None or (
+                    self._order(r) < self._order(self._pending[best_i])):
+                best_i = i
+        if best_i is None:
+            return None, False
+        r = self._pending[best_i]
+        if self.rows_of(r) > space:
+            if r._seq not in counted:
+                counted.add(r._seq)
+                self._note_deferral("overflow")
+            return None, True
+        return self._pending.pop(best_i), False
+
+    def _collect(self) -> list:
+        """Admission: pick the best pending request, then grow the batch
+        until the largest bucket is full or that FIRST request's max-delay
+        budget runs out (shedding expired requests as they surface)."""
+        counted: set = set()
+        keys: set = set()
+        first, _ = self._pick(keys, self.max_rows, counted)
+        if first is None:
+            return []
         batch = [first]
         rows = self.rows_of(first)
-        keys = {self.conflict_key(first)} if self.conflict_key else set()
+        if self.conflict_key is not None:
+            keys.add(self.conflict_key(first))
         deadline = first._submit_t + self.max_delay_s
         while rows < self.max_rows:
-            remaining = deadline - time.monotonic()
-            nxt = None
-            if self._stash:
-                # stashed requests (conflict- or overflow-deferred) re-enter
-                # ahead of the queue, but only if they don't conflict with
-                # this batch
-                for i, cand in enumerate(self._stash):
-                    if (self.conflict_key is None
-                            or self.conflict_key(cand) not in keys):
-                        nxt = self._stash.pop(i)
-                        break
+            nxt, stop = self._pick(keys, self.max_rows - rows, counted)
+            if stop:
+                break
             if nxt is None:
-                if remaining <= 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._sentinel:
                     break
                 try:
-                    nxt = self._q.get(timeout=remaining)
+                    item = self._q.get(timeout=remaining)
                 except queue.Empty:
                     break
-                if nxt is None:  # close sentinel — finish this batch first
-                    self._q.put(None)
+                if item is None:
+                    self._sentinel = True
                     break
-            if self.conflict_key is not None:
-                k = self.conflict_key(nxt)
-                if k in keys:
-                    self._stash.append(nxt)  # same session: next dispatch
-                    self._note_deferral("conflict")
-                    continue
-                keys.add(k)
-            if rows + self.rows_of(nxt) > self.max_rows:
-                self._stash.append(nxt)  # doesn't fit: keep order, defer
-                self._note_deferral("overflow")
-                break
+                self._pending.append(item)
+                self._fill(block=False)
+                self._shed_expired()
+                continue
             batch.append(nxt)
             rows += self.rows_of(nxt)
+            if self.conflict_key is not None:
+                keys.add(self.conflict_key(nxt))
         return batch
 
     def _note_deferral(self, why: str) -> None:
         with self._stats_lock:
             self.stats["deferrals"] += 1
-        self.bus.counter("serving_deferrals_total", lane=self.name, why=why)
+        self.bus.counter(
+            "serving_deferrals_total", lane=self.name, why=why,
+            **self.labels,
+        )
+
+    def _note_shed(self, why: str) -> None:
+        with self._stats_lock:
+            self.stats["shed"] += 1
+        self.bus.counter(
+            "serving_shed_total", lane=self.name, why=why, **self.labels
+        )
 
     def _run(self) -> None:
         while True:
-            if self._stash:
-                first = self._stash.pop(0)
-            else:
-                first = self._q.get()
-                if first is None:
-                    if self._stash:  # drain conflict-deferred tail
-                        self._q.put(None)
-                        continue
+            if not self._pending:
+                if self._sentinel:
                     return
-            batch = self._collect(first)
+                self._fill(block=True)
+            else:
+                self._fill(block=False)
+            self._shed_expired()
+            if not self._pending:
+                continue
+            batch = self._collect()
+            if not batch:
+                continue
             rows = sum(self.rows_of(r) for r in batch)
             try:
                 bucket = self.bucket_for(rows)
@@ -224,10 +340,12 @@ class Microbatcher:
                 self.stats["rows"] += rows
                 self.stats["pad_rows"] += bucket - rows
                 self.stats["bucket_hits"] += int(rows == bucket)
-                self.bus.counter("serving_dispatches_total", lane=self.name)
+                self.bus.counter(
+                    "serving_dispatches_total", lane=self.name, **self.labels
+                )
                 self.bus.observe(
                     "serving_batch_occupancy_pct", 100.0 * rows / bucket,
-                    lane=self.name,
+                    lane=self.name, **self.labels,
                 )
                 if self.on_dispatch is not None:
                     self.on_dispatch(self.name, batch, bucket, rows, depth)

@@ -221,6 +221,33 @@ class LogHistogram:
         out.merge(self)
         return out
 
+    def delta(self, since: "LogHistogram") -> "LogHistogram":
+        """The WINDOW histogram between a cumulative snapshot ``since``
+        (taken earlier from the same monotone series) and now — elementwise
+        integer subtraction of counts, exact for the same reason merge is.
+        This is what the publish controller's post-swap SLO-burn check and
+        the max-delay autotuner read: burn over the observation window, not
+        the process lifetime. ``min``/``max`` of the window alone are not
+        recoverable from two cumulative snapshots, so the window inherits
+        the full-series envelope — ``max`` can only OVERSTATE the window's
+        true max, which keeps ``quantile()``'s never-understate guarantee
+        (only the overflow bucket reports ``max``)."""
+        self._check_shape(since)
+        out = LogHistogram(self.lo, self.hi, self.per_decade)
+        for i, c in enumerate(self.counts):
+            d = c - since.counts[i]
+            if d < 0:
+                raise HistogramShapeError(
+                    "delta() needs an EARLIER snapshot of the same series; "
+                    f"bucket {i} went backwards ({since.counts[i]} -> {c})"
+                )
+            out.counts[i] = d
+        out.count = self.count - since.count
+        out.sum = self.sum - since.sum
+        if out.count:
+            out.min, out.max = self.min, self.max
+        return out
+
     # -- Prometheus exposition --------------------------------------------
 
     def cumulative(self) -> list:

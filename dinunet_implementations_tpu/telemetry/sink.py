@@ -86,6 +86,18 @@ ROW_REQUIRED = {
         "requests_per_s", "samples_per_s", "pad_waste_pct",
         "bucket_hit_rate", "warmup_seconds", "compiles_after_warmup",
     }),
+    # train-to-serve CD (r21, serving/publish.py): one row per attempted
+    # publish — outcome is "swapped" / "rejected-shadow" / "rejected-stale";
+    # pause_ms is the donated-swap wall time (null when nothing swapped)
+    "publish": frozenset({
+        "kind", "digest", "outcome", "pause_ms", "shadow",
+    }),
+    # ... and one per SLO-burn rollback decision after a swap: burn is the
+    # post-swap window's error-budget burn, rolled_back whether the previous
+    # weights were grafted back
+    "rollback": frozenset({
+        "kind", "digest", "burn", "rolled_back", "window_samples",
+    }),
 }
 
 

@@ -350,6 +350,27 @@ def load_inference_state(path: str):
     )
 
 
+def params_digest(params, batch_stats=None) -> str:
+    """Content digest of a weight pytree (sha256 over leaves in flatten
+    order, shapes/dtypes included so a reshape can't collide) — the publish
+    stream's identity: the daemon stamps it into the publish announcement,
+    the fleet's CheckpointWatcher uses it to skip republishing unchanged
+    weights, and the publish/rollback telemetry rows carry it so a swap is
+    attributable to exact bytes. Works on device arrays and numpy alike."""
+    import hashlib
+
+    import jax
+    import numpy as np
+
+    h = hashlib.sha256()
+    for tree in (params, batch_stats or {}):
+        for leaf in jax.tree.leaves(tree):
+            a = np.asarray(leaf)
+            h.update(str((a.shape, str(a.dtype))).encode())
+            h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()[:16]
+
+
 def checkpoint_meta(path: str) -> dict:
     mpath = path + ".meta.json"
     if os.path.exists(mpath):

@@ -481,9 +481,14 @@ def test_preempted_fit_still_finalizes_artifacts(tmp_path):
     assert fit_span["ok"] is False
 
 
+@pytest.mark.slow
 def test_xprof_window_captures_epoch_range(tmp_path):
     """--xprof-dir: the jax.profiler capture brackets exactly the
-    configured epoch window of a real fit and finalizes its trace file."""
+    configured epoch window of a real fit and finalizes its trace file.
+
+    Slow tier: a full 3-epoch fit under the profiler (~40s on the CPU
+    container) — well past the >~10s line the ``slow`` marker draws.
+    """
     from dinunet_implementations_tpu.telemetry.xprof import trace_files
 
     cfg = TrainConfig(epochs=3, batch_size=8, patience=50,
