@@ -1510,7 +1510,8 @@ def measure_serving(requests: int = 100, dims: dict | None = None,
 
 
 def measure_fleet(replicas_list=(1, 2, 4), requests: int = 120,
-                  swaps: int = 4, dims: dict | None = None):
+                  swaps: int = 4, dims: dict | None = None,
+                  devices=None, topology: dict | None = None):
     """The serving-fleet arms (r21, serving/fleet.py + publish.py):
 
     - ``fleet-scale`` (one record per replica count): the same mixed-bucket
@@ -1525,6 +1526,14 @@ def measure_fleet(replicas_list=(1, 2, 4), requests: int = 120,
       it (``LogHistogram.delta`` between merged-bus snapshots), plus the
       fleet-wide compiles-after-warmup count proving the guard held
       through every publish.
+
+    ``devices``/``topology`` (r22): ``--slices S --pack K`` composes with
+    the fleet arms — the emulated pod is sized to S slice-bands of K
+    devices, replicas are pinned slice-major over the bands (replica i on
+    band i % S, so replicas spread ACROSS slices before doubling up
+    within one), and every record carries the active topology so a
+    reader can tell a 4-replica/1-slice row from a 4-replica/4-slice
+    one.
     """
     import jax
     import numpy as np
@@ -1538,6 +1547,10 @@ def measure_fleet(replicas_list=(1, 2, 4), requests: int = 120,
         "unit": None, "backend": backend,
         "dims": dims or {"windows": windows, "comps": comps, "wlen": wlen,
                          "enc_out": ENC_OUT, "hidden": HIDDEN},
+        "topology": topology or {
+            "slices": 1,
+            "devices": len(devices) if devices else len(jax.devices()),
+        },
     }
     rng = np.random.default_rng(0)
     sizes = (1, 2, 3, 4, 8)
@@ -1560,7 +1573,7 @@ def measure_fleet(replicas_list=(1, 2, 4), requests: int = 120,
         fleet = ReplicaSet(
             cfg, replicas=n_replicas, params=params, batch_stats=stats,
             bus=bus, row_buckets=(1, 2, 4, 8), streaming=False,
-            max_delay_ms=1.0,
+            max_delay_ms=1.0, devices=devices,
         )
         fleet.warmup()
         try:
@@ -1637,6 +1650,216 @@ def measure_fleet(replicas_list=(1, 2, 4), requests: int = 120,
     return records
 
 
+def measure_tenants(tenants: int = 2, pod_slices: int = 2,
+                    epochs: int = 6, gap_s: float = 3.0):
+    """The fleet-scheduler goodput arms (r22, runner/scheduler.py): K
+    identical studies, each with a mid-study quorum gap (every site
+    leaves after a staggered epoch mark and rejoins ``gap_s``
+    wall-seconds later — the cohort-turnover shape real federations
+    idle through), run two ways on the SAME emulated pod:
+
+    - ``tenants-serialized``: one study at a time, each on its own
+      scheduler — the pod idles through every gap (the status-quo cost
+      of running studies back to back);
+    - ``tenants-concurrent``: all K studies on ONE scheduler — weighted
+      fair share packs them onto the pod, a holding tenant's slices are
+      reclaimed via checkpoint-then-yield, and every gap is overlapped
+      by the other tenants' training.
+
+    Records aggregate samples/s, BOTH arms' slice-idle fraction, the
+    preemption pause p99 (exit-clean checkpoint on yield + msgpack
+    reload on resume) and the per-tenant fairness ratio (min/max busy
+    slice-seconds per unit weight) — docs/bench_tenants_r22.jsonl.
+    """
+    import os
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from dinunet_implementations_tpu.core.config import (
+        FSArgs, TrainConfig,
+    )
+    from dinunet_implementations_tpu.data.demo import make_fs_demo_tree
+    from dinunet_implementations_tpu.runner.fed_runner import (
+        discover_site_dirs,
+    )
+    from dinunet_implementations_tpu.runner.scheduler import (
+        FleetScheduler, TenantSpec,
+    )
+    from dinunet_implementations_tpu.telemetry.bus import MetricsBus
+
+    work = tempfile.mkdtemp(prefix="bench_tenants_")
+    n_sites, subjects, feat = 4, 32, 8
+
+    def spec_for(i: int) -> TenantSpec:
+        tree = os.path.join(work, f"tree{i}")
+        if not os.path.isdir(tree):
+            make_fs_demo_tree(tree, n_sites=n_sites, subjects=subjects,
+                              n_features=feat, seed=i)
+        cfg = TrainConfig(
+            task_id="FS-Classification", batch_size=4,
+            staleness_bound=2, num_slices=pod_slices,
+            fs_args=FSArgs(input_size=feat, hidden_sizes=(8,)),
+        )
+        return TenantSpec(
+            tenant=f"study{i}", data_path=tree, config=cfg,
+            capacity=n_sites, inventory_rows=subjects + 16,
+            max_epochs=epochs,
+        )
+
+    def gap_after(i: int) -> int:
+        # staggered gap marks: tenant i holds after a different epoch,
+        # so the concurrent arm's gaps overlap training, not each other
+        return max(1, (epochs // (tenants + 1)) * (i + 1))
+
+    def seed_gap(sched, spec: TenantSpec) -> dict:
+        t = sched.tenants[spec.tenant]
+        dirs = discover_site_dirs(spec.data_path)
+        g = gap_after(int(spec.tenant.removeprefix("study")))
+        for j in range(len(dirs)):
+            path = os.path.join(t.spool_dir, f"gap{j:03d}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump({"event": "leave", "site": f"local{j}",
+                           "after_epoch": g}, fh)
+            os.replace(tmp, path)
+        # rejoin events must carry each site's config overrides
+        # (labels_file / columns, from the tree's inputspec) exactly as
+        # the pre-join admission recorded them — a bare join can't load
+        # the site's covariates
+        return {"tenant": spec.tenant, "t0": None, "rejoined": False,
+                "rejoin": [
+                    (f"local{j}", d,
+                     dict(t.daemon._overrides.get(f"local{j}", {})))
+                    for j, d in enumerate(dirs)
+                ]}
+
+    def drive(sched, gaps: list) -> float:
+        t0 = time.monotonic()
+        deadline = t0 + 600.0
+        while not sched.done() and time.monotonic() < deadline:
+            sched.tick()
+            now = time.monotonic()
+            for gap in gaps:
+                t = sched.tenants[gap["tenant"]]
+                if t.status != "active" or gap["rejoined"]:
+                    continue
+                if gap["t0"] is None and not t.daemon.trainable() \
+                        and t.daemon.epochs_run >= 1:
+                    gap["t0"] = now  # the hold was observed: clock it
+                elif gap["t0"] is not None and now - gap["t0"] >= gap_s:
+                    for j, (site, d, conf) in enumerate(gap["rejoin"]):
+                        path = os.path.join(
+                            t.spool_dir, f"zz_rejoin{j:03d}.json"
+                        )
+                        tmp = path + ".tmp"
+                        with open(tmp, "w") as fh:
+                            json.dump({"event": "join", "site": site,
+                                       "data_dir": d, "config": conf},
+                                      fh)
+                        os.replace(tmp, path)
+                    gap["rejoined"] = True
+        return time.monotonic() - t0
+
+    def samples_per_epoch(t) -> int:
+        rows = t.daemon._rows or 10 ** 9
+        return sum(
+            min(len(v), rows) for v in t.daemon._data.values()
+        )
+
+    base = {
+        "backend": jax.default_backend(), "tenants": tenants,
+        "pod_slices": pod_slices, "epochs_per_study": epochs,
+        "gap_s": gap_s, "unit": "samples/s",
+        "metric": "aggregate training throughput: K gap-interrupted "
+                  "studies serialized vs scheduled-concurrent on one "
+                  "emulated pod",
+    }
+    records = []
+
+    # -- serialized arm: one study at a time, pod idles through gaps
+    ser_wall = ser_busy = ser_samples = 0.0
+    ser_pauses: list = []
+    for i in range(tenants):
+        sched = FleetScheduler(
+            os.path.join(work, f"solo{i}"), pod_slices=pod_slices,
+            bus=MetricsBus(), poll_s=0.02, verbose=False,
+        )
+        spec = spec_for(i)
+        sched.register(spec)
+        gaps = [seed_gap(sched, spec)]
+        wall = drive(sched, gaps)
+        t = sched.tenants[spec.tenant]
+        ser_samples += t.daemon.epochs_run * samples_per_epoch(t)
+        ser_pauses.extend(t.pauses_ms)
+        gp = sched.goodput()
+        ser_wall += wall
+        ser_busy += gp["busy_slice_s"]
+        sched.close()
+    ser_idle = round(1.0 - ser_busy / (pod_slices * ser_wall), 4)
+    records.append({
+        **base, "arm": "tenants-serialized",
+        "wall_s": round(ser_wall, 3),
+        "samples_per_s": round(ser_samples / ser_wall, 2),
+        "slice_idle_fraction": ser_idle,
+        "preempt_pause_ms_p99": (
+            round(float(np.percentile(ser_pauses, 99)), 3)
+            if ser_pauses else 0.0
+        ),
+    })
+
+    # -- concurrent arm: all K studies on ONE scheduler
+    sched = FleetScheduler(
+        os.path.join(work, "packed"), pod_slices=pod_slices,
+        bus=MetricsBus(), poll_s=0.02, verbose=False,
+    )
+    specs = [spec_for(i) for i in range(tenants)]
+    gaps = []
+    for spec in specs:
+        sched.register(spec)
+        gaps.append(seed_gap(sched, spec))
+    conc_wall = drive(sched, gaps)
+    conc_samples = sum(
+        sched.tenants[s.tenant].daemon.epochs_run
+        * samples_per_epoch(sched.tenants[s.tenant])
+        for s in specs
+    )
+    conc_pauses = [
+        p for s in specs for p in sched.tenants[s.tenant].pauses_ms
+    ]
+    gp = sched.goodput()
+    per_tenant = [
+        gp["busy_slice_s_per_tenant"][s.tenant] / max(s.weight, 1e-9)
+        for s in specs
+    ]
+    fairness = (
+        round(min(per_tenant) / max(per_tenant), 4)
+        if min(per_tenant) > 0 else 0.0
+    )
+    sched.close()
+    conc_rate = conc_samples / conc_wall
+    records.append({
+        **base, "arm": "tenants-concurrent",
+        "wall_s": round(conc_wall, 3),
+        "samples_per_s": round(conc_rate, 2),
+        "slice_idle_fraction": round(
+            1.0 - gp["busy_slice_s"] / (pod_slices * conc_wall), 4
+        ),
+        "preempt_pause_ms_p99": (
+            round(float(np.percentile(conc_pauses, 99)), 3)
+            if conc_pauses else 0.0
+        ),
+        "preempt_count": gp["preempt_count"],
+        "fairness_ratio": fairness,
+        "epochs": gp["epochs"],
+        "speedup_vs_serialized": round(
+            conc_rate / (ser_samples / ser_wall), 3
+        ),
+    })
+    return records
+
+
 SMALL_DIMS = dict(sites=32, steps=2, batch=4, windows=6, comps=8, wlen=4,
                   enc_out=16, hidden=16, compute_dtype="bfloat16")
 
@@ -1650,6 +1873,25 @@ def main():
         import os
 
         os.environ["DINUNET_SANITIZE"] = "compile"
+    if "--tenants" in sys.argv:
+        # fleet-scheduler goodput arms (r22, runner/scheduler.py): K
+        # gap-interrupted studies serialized vs scheduled-concurrent on
+        # the same emulated pod (docs/bench_tenants_r22.jsonl; regen on
+        # TPU with the same command, e.g. `--tenants 2`)
+        tenants = int(sys.argv[sys.argv.index("--tenants") + 1])
+        pod_slices = (int(sys.argv[sys.argv.index("--pod-slices") + 1])
+                      if "--pod-slices" in sys.argv else 2)
+        n = (int(sys.argv[sys.argv.index("--epochs") + 1])
+             if "--epochs" in sys.argv else 6)
+        gap_s = (float(sys.argv[sys.argv.index("--gap-s") + 1])
+                 if "--gap-s" in sys.argv else 3.0)
+        _ensure_host_devices(8)
+        for rec in measure_tenants(
+            tenants=tenants, pod_slices=pod_slices, epochs=n,
+            gap_s=gap_s,
+        ):
+            print(json.dumps(rec), flush=True)
+        return
     if "--serve" in sys.argv:
         # serving-path arms (r15, serving/): AOT warmup cold vs
         # compile-cache-warm, mixed-bucket request latency/throughput
@@ -1675,10 +1917,33 @@ def main():
             )
             swaps = (int(sys.argv[sys.argv.index("--swap") + 1])
                      if "--swap" in sys.argv else 4)
-            _ensure_host_devices(max(replicas_list))
+            # --slices/--pack compose with the fleet arms (r22): the
+            # emulated pod is S slice-bands of K devices and replicas
+            # pin slice-major across the bands; every row records the
+            # active topology (previously these flags were silently
+            # ignored in the fleet branch)
+            devices = topology = None
+            if "--slices" in sys.argv:
+                slices = int(sys.argv[sys.argv.index("--slices") + 1])
+                pack = (int(sys.argv[sys.argv.index("--pack") + 1])
+                        if "--pack" in sys.argv else 1)
+                _ensure_host_devices(max(slices * pack,
+                                         max(replicas_list)))
+                import jax
+
+                devs = jax.devices()[:slices * pack]
+                bands = [devs[b * pack:(b + 1) * pack]
+                         for b in range(slices)]
+                devices = [bands[b][j] for j in range(pack)
+                           for b in range(slices)]
+                topology = {"slices": slices, "devices_per_slice": pack,
+                            "placement": "slice-major"}
+            else:
+                _ensure_host_devices(max(replicas_list))
             for rec in measure_fleet(
                 replicas_list=replicas_list, requests=requests,
-                swaps=swaps, dims=dims,
+                swaps=swaps, dims=dims, devices=devices,
+                topology=topology,
             ):
                 print(json.dumps(rec), flush=True)
             return

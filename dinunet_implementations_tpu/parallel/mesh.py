@@ -174,9 +174,22 @@ def site_axis_of(mesh: Mesh):
     """The partition-spec entry for the leading per-site dim on ``mesh``:
     the ``(slice, site)`` pair on sliced meshes (slice-major global order),
     plain ``site`` otherwise. Everything that shards a ``[S, …]`` per-site
-    array goes through this, so the layout convention lives in ONE place."""
+    array goes through this, so the layout convention lives in ONE place.
+
+    Width-1 tiers are dropped from the pair: partitioning over a size-1
+    axis is a no-op, and XLA canonicalizes it out of the sharding it
+    reports on program OUTPUTS. If we committed inputs to the un-dropped
+    spec, epoch 1's emitted state would carry a spec that no longer
+    equals the placed one and epoch 2 would silently retrace (seen on
+    packed sliced meshes, where the site tier collapses to width 1)."""
     if SLICE_AXIS in getattr(mesh, "axis_names", ()):
-        return (SLICE_AXIS, SITE_AXIS)
+        shape = dict(mesh.shape)
+        tiers = tuple(
+            ax for ax in (SLICE_AXIS, SITE_AXIS) if shape.get(ax, 1) > 1
+        )
+        if len(tiers) == 1:
+            return tiers[0]
+        return tiers or None
     return SITE_AXIS
 
 

@@ -115,6 +115,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pinned inventory rows per slot (headroom for "
                         "bigger sites joining later; default: the first "
                         "admitted site's size)")
+    p.add_argument("--schedule", action="store_true",
+                   help="fleet-scheduler mode (r22): pack multiple "
+                        "concurrent studies (tenants) onto the shared "
+                        "slice pool with weighted fair share, "
+                        "checkpoint-then-yield preemption and serving "
+                        "backfill. --data-path is the scheduler ROOT: "
+                        "tenants register via <root>/spool/*.json events "
+                        "and live under <root>/tenants/<id>/ "
+                        "(runner/scheduler.py FleetScheduler)")
+    p.add_argument("--pod-slices", type=int, default=1, metavar="N",
+                   help="scheduler mode: width of the shared slice pool "
+                        "the fair-share loop allocates (default 1)")
+    p.add_argument("--sched-wall-s", type=float, default=None, metavar="S",
+                   help="scheduler mode: stop after S wall-clock seconds "
+                        "(default: run until every tenant is done or a "
+                        "shutdown event/signal arrives)")
+    p.add_argument("--sched-ticks", type=int, default=None, metavar="N",
+                   help="scheduler mode: stop after N scheduling ticks")
     p.add_argument("--statusz-port", type=int, default=None, metavar="PORT",
                    help="daemon mode: serve live observability endpoints on "
                         "127.0.0.1:PORT — /metrics (Prometheus text), "
@@ -341,6 +359,57 @@ def main(argv: list[str] | None = None) -> int:
             attack_plan = parse_attack_plan(args.attacks)
         except (ValueError, OSError, TypeError) as e:
             raise SystemExit(f"--attacks: {e}")
+
+    if args.schedule:
+        if args.serve or args.site is not None or args.folds is not None:
+            raise SystemExit(
+                "--schedule is the fleet-scheduler mode; --serve/--site/"
+                "--folds are single-fit options"
+            )
+        from ..checks.sanitize import SanitizerViolation
+        from .scheduler import FleetScheduler
+
+        sched = FleetScheduler(
+            args.data_path,
+            pod_slices=args.pod_slices,
+            poll_s=args.serve_poll,
+            verbose=verbose,
+        )
+        exporter = None
+        if args.statusz_port is not None:
+            from ..telemetry.exporter import StatusExporter
+
+            exporter = StatusExporter(
+                sched.bus, port=args.statusz_port,
+                health=sched.health_probes(), statusz=sched.status,
+                slo=(
+                    {"histogram": "serve_epoch_ms",
+                     "p99_target_ms": args.slo_p99_ms}
+                    if args.slo_p99_ms is not None else None
+                ),
+            )
+            port = exporter.start()
+            if verbose:
+                print(json.dumps({
+                    "statusz": f"http://127.0.0.1:{port}",
+                    "endpoints": ["/metrics", "/healthz", "/statusz",
+                                  "/tracez"],
+                }))
+        try:
+            summary = sched.run(
+                max_wall_s=args.sched_wall_s, max_ticks=args.sched_ticks,
+            )
+        except SanitizerViolation as v:
+            print(json.dumps({"sanitizer_violation": str(v)}),
+                  file=sys.stderr)
+            return 70
+        finally:
+            if exporter is not None:
+                exporter.stop()
+        from ..telemetry.sink import _finite
+
+        print(json.dumps(_finite(summary), default=str))
+        return 0
 
     if args.serve:
         if args.site is not None or args.folds is not None:

@@ -392,6 +392,7 @@ class FedDaemon:
         verbose: bool = True,
         bus=None,
         flight=None,
+        sink_tags: dict | None = None,
         **overrides,
     ):
         from ..robustness.membership import MembershipTable
@@ -485,6 +486,7 @@ class FedDaemon:
                 ),
                 self.cfg, mesh=self.mesh, fold=0, tracer=self.trainer.tracer,
                 fault_plan=fault_plan, attack_plan=attack_plan,
+                tags=sink_tags,
             )
         resumed = self._resume() if resume else False
         if not resumed and data_path:
@@ -845,6 +847,51 @@ class FedDaemon:
             return 0.0
         return round(max(time.time() - oldest, 0.0), 3)
 
+    # -- scheduler surface (runner/scheduler.py, r22) ----------------------
+
+    def set_slice_grant(self, grant) -> None:
+        """Install the fleet scheduler's ``[num_slices]`` slice-grant mask
+        (1.0 = this service may aggregate on that slice this round-window).
+        The mask folds into the r19 slice-liveness window inside the SAME
+        compiled epoch program — growing, shrinking or zeroing the grant is
+        a traced-input flip plus renormalized aggregation, never a retrace.
+        ``None`` removes scheduler control (full pod, r19 behavior) — but
+        flipping between None and a mask CHANGES the traced program, so a
+        scheduled tenant keeps a mask for its whole life."""
+        self.trainer.slice_grant = (
+            None if grant is None else np.asarray(grant, np.float32)
+        )
+
+    def trainable(self) -> bool:
+        """Would :meth:`train_epoch` train right now (vs HOLD)? The
+        scheduler's runnable predicate: granting slices to a tenant that
+        would only hold wastes the grant — those slices backfill instead."""
+        if self.table.occupied < self.quorum or self.state is None:
+            return False
+        return any(
+            len(self._data[s]) >= self.cfg.batch_size
+            for s in self.table.members()
+        )
+
+    def reload_checkpoint(self) -> bool:
+        """Restore params/engine state from the rotating checkpoint into
+        the EXISTING state template (same shapes, same sharding — the
+        compiled program is untouched). The scheduler's resume half of
+        checkpoint-then-yield: a preempted tenant continues bit-exact from
+        what :meth:`checkpoint` saved, through the real CRC-framed msgpack
+        path. Returns False when there is nothing to restore."""
+        from ..trainer.checkpoint import load_checkpoint
+
+        if self.state is None or not (
+            os.path.exists(self.ckpt_path)
+            or os.path.exists(self.ckpt_path + ".prev")
+        ):
+            return False
+        self.state = self.trainer._place_state(
+            load_checkpoint(self.ckpt_path, self.state)
+        )
+        return True
+
     # -- training ----------------------------------------------------------
 
     def _slot_sites(self) -> list:
@@ -928,6 +975,23 @@ class FedDaemon:
             f"[serve] epoch {self.epochs_run}: train_loss={loss:.4f} "
             f"({self.table.occupied}/{self.capacity} slots)"
         )
+        # ε-budget exhaustion is a CLEAN stop for THIS daemon only: the
+        # ledger (privacy/accounting.py, stepped inside run_epoch) crossing
+        # the budget checkpoints the model and latches the service stop —
+        # under the fleet scheduler each tenant owns its ledger, so one
+        # study exhausting its budget cannot perturb another (isolation
+        # proven bit-exact in tests/test_scheduler.py).
+        budget = float(getattr(self.cfg, "dp_epsilon_budget", 0.0) or 0.0)
+        eps = self.trainer._dp_epsilon
+        if budget > 0 and eps is not None and eps >= budget:
+            self._event("dp-budget", epsilon=eps, budget=budget)
+            self.bus.counter("serve_dp_budget_stops_total")
+            self._log(
+                f"[serve] dp ε-budget exhausted: ε={eps:.3f} ≥ {budget} "
+                f"— checkpointing and stopping"
+            )
+            self.checkpoint()
+            self._stop = True
         return loss
 
     def _note_hold(self, rounds: int) -> None:
@@ -1156,6 +1220,13 @@ class FedDaemon:
             # holds rounds below it) — surfaced so an operator reading
             # /statusz sees WHY rounds are holding under slice faults
             "min_slices": self.cfg.min_slices,
+            # r22 fleet scheduler: the current slice-grant mask (None = the
+            # service owns the whole pod) — /statusz shows WHICH slices the
+            # scheduler has this tenant on right now
+            "slice_grant": (
+                None if self.trainer.slice_grant is None
+                else [float(g) for g in np.asarray(self.trainer.slice_grant)]
+            ),
             "slice_occupancy": self.table.slice_occupancy(self.num_slices),
             "membership_epoch": self.table.epoch,
             "steps": self._steps,

@@ -1,0 +1,840 @@
+"""Fleet scheduler — one pod, many tenants (r22).
+
+A TPU pod running ONE study at a time is mostly idle: quorum holds, spool
+gaps between cohorts, and admission waits all leave slices parked while
+the daemon polls. This module packs multiple concurrent studies
+(*tenants*) plus serving lanes onto the shared slice pool, so the pod's
+slice-seconds go to whoever can use them — without ever violating the
+repo's one-compile-per-fit law.
+
+Design (in the order the pieces compose):
+
+- **Tenant model.** Each tenant is a :class:`TenantSpec` — a
+  FedDaemon-shaped fit (config + data tree + capacity/quorum) plus
+  scheduling attributes (priority band, weight, slice quota). Tenants
+  arrive through the scheduler's own JSON-event spool (``register`` /
+  ``deregister`` / ``shutdown``, same sorted-filename / remove-on-apply /
+  ``.rejected``-quarantine discipline as the membership spool) or via
+  :meth:`FleetScheduler.register`. Every tenant gets its OWN spool,
+  checkpoint dir, telemetry sink (manifest-tagged ``{"tenant": id}``) and
+  ε ledger under ``<root>/tenants/<id>/`` — isolation is directory-deep,
+  not best-effort — while live metrics publish through a
+  :class:`~..telemetry.bus.LabeledBusView` of the ONE pod bus, so a
+  single /statusz exporter serves the whole pod with every series
+  tenant-labeled.
+
+- **Fair share.** :func:`fair_share` allocates integer slices in strictly
+  descending priority bands; within a band, weighted max-min — one slice
+  at a time to the least-served-per-unit-weight tenant, deterministic
+  tiebreak by tenant id. Capped by each tenant's quota and demand
+  (a holding tenant demands 0 — granting slices to a fit that would only
+  hold wastes them). Leftover slices fall through to backfill.
+
+- **Preempt-and-yield.** A grant shrink is checkpoint-then-yield: the
+  tenant's daemon saves its rotating checkpoint (exit-clean, the same
+  artifact SIGTERM preemption writes), then the scheduler flips the
+  tenant's ``[num_slices]`` slice-grant mask — which folds into the r19
+  slice-liveness window INSIDE the already-compiled epoch program, so
+  shrinking 4→2 slices is a traced-input flip plus renormalized
+  aggregation, never a retrace. Resume is the mirror: reload the
+  checkpoint through the real CRC-framed msgpack path into the same
+  state template, regrant the mask. A CompileGuard per tenant asserts
+  ONE epoch compile across any grow/shrink/preempt/restore sequence,
+  and the resumed tenant continues bit-exact (params-digest-provable,
+  tests/test_scheduler.py).
+
+- **Backfill.** Slices no tenant can use this tick (quorum holds, empty
+  pool tail, grants below a tenant's slice-quorum floor) host a
+  :class:`BackfillLane` — a serving ReplicaSet (r21) pinned to the idle
+  band's devices, lazily warmed on first grant and drained through the
+  same yield discipline (a lane never blocks a training grant: it only
+  ever runs on this tick's leftover).
+
+- **Goodput accounting.** The scheduler integrates busy-slice-seconds
+  over wall time and keeps every preemption pause; ``bench.py
+  --tenants N`` uses these to prove scheduled-concurrent packing beats
+  serialized studies on aggregate throughput (docs/bench_tenants_r22
+  .jsonl).
+
+The scheduler never spawns threads for training: one process, one tick
+loop, tenants time-multiplexed deterministically (priority-desc, then
+tenant id) — so runs are reproducible and the one-compile law is
+checkable per tenant.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+
+import numpy as np
+
+from ..core.config import TrainConfig
+from .fed_runner import FedDaemon
+
+#: event kinds the scheduler spool accepts
+SCHED_SPOOL_EVENTS = ("register", "deregister", "shutdown")
+
+
+class SchedulerError(ValueError):
+    """A tenant spec or scheduler-spool event that cannot be honored."""
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantSpec:
+    """One study's admission ticket: the fit shape plus how it shares.
+
+    ``config`` is either a flat override dict (the spool-event form,
+    applied via ``TrainConfig.with_overrides`` exactly like a join
+    event's ``config`` key) or a prebuilt :class:`TrainConfig` (the API
+    form tests and benches use). ``slice_quota`` caps how many pod
+    slices the tenant may hold at once (default: its own mesh width);
+    ``priority`` picks the band (higher preempts lower), ``weight`` the
+    share within the band. ``max_epochs`` ends the study (``None`` =
+    runs until a ``deregister``/``shutdown`` event).
+    """
+
+    tenant: str
+    data_path: str | None = None
+    config: object = None  # flat override dict | TrainConfig | None
+    capacity: int = 4
+    quorum: int = 1
+    priority: float = 1.0
+    weight: float = 1.0
+    slice_quota: int | None = None
+    max_epochs: int | None = None
+    inventory_rows: int | None = None
+    steps: int | None = None
+    resume: bool = False
+    fault_plan: object = None
+    attack_plan: object = None
+
+    @classmethod
+    def from_event(cls, ev: dict) -> "TenantSpec":
+        """Build a spec from a scheduler-spool ``register`` event.
+        Fault/attack plans arrive in the same JSON forms the CLI accepts
+        (``robustness.faults.parse_fault_plan`` / ``parse_attack_plan``).
+        """
+        from ..robustness.attacks import parse_attack_plan
+        from ..robustness.faults import parse_fault_plan
+
+        tenant = str(ev.get("tenant") or "")
+        if not tenant or "/" in tenant or tenant.startswith("."):
+            raise SchedulerError(f"bad tenant id {tenant!r}")
+        faults = ev.get("faults")
+        attacks = ev.get("attacks")
+        return cls(
+            tenant=tenant,
+            data_path=ev.get("data_path"),
+            config=ev.get("config") or {},
+            capacity=int(ev.get("capacity", 4)),
+            quorum=int(ev.get("quorum", 1)),
+            priority=float(ev.get("priority", 1.0)),
+            weight=float(ev.get("weight", 1.0)),
+            slice_quota=(
+                None if ev.get("slice_quota") is None
+                else int(ev["slice_quota"])
+            ),
+            max_epochs=(
+                None if ev.get("max_epochs") is None
+                else int(ev["max_epochs"])
+            ),
+            inventory_rows=(
+                None if ev.get("inventory_rows") is None
+                else int(ev["inventory_rows"])
+            ),
+            steps=None if ev.get("steps") is None else int(ev["steps"]),
+            resume=bool(ev.get("resume", False)),
+            fault_plan=(
+                parse_fault_plan(json.dumps(faults)) if faults else None
+            ),
+            attack_plan=(
+                parse_attack_plan(json.dumps(attacks)) if attacks else None
+            ),
+        )
+
+
+def fair_share(pool: int, requests: list[dict]) -> dict[str, int]:
+    """Integer slice allocation: strictly descending priority bands,
+    weighted max-min inside a band.
+
+    ``requests`` rows carry ``tenant`` (id), ``priority``, ``weight`` and
+    ``demand`` (max useful slices — 0 when the tenant would only hold).
+    Within a band, slices go one at a time to the tenant with the lowest
+    grants-per-unit-weight (deterministic tiebreak: tenant id), stopping
+    at each tenant's demand. A higher band drains the pool before a
+    lower band sees it — that asymmetry IS preemption: when a high-
+    priority tenant arrives, the reallocation shrinks the lower band's
+    grants and the scheduler turns each shrink into checkpoint-then-
+    yield. Whatever no band can use is the backfill residue.
+    """
+    grants = {str(r["tenant"]): 0 for r in requests}
+    remaining = int(pool)
+    for prio in sorted({float(r["priority"]) for r in requests},
+                       reverse=True):
+        band = [
+            r for r in requests
+            if float(r["priority"]) == prio and int(r["demand"]) > 0
+        ]
+        while remaining > 0:
+            open_ = [
+                r for r in band
+                if grants[str(r["tenant"])] < int(r["demand"])
+            ]
+            if not open_:
+                break
+            pick = min(
+                open_,
+                key=lambda r: (
+                    grants[str(r["tenant"])]
+                    / max(float(r.get("weight", 1.0)), 1e-9),
+                    str(r["tenant"]),
+                ),
+            )
+            grants[str(pick["tenant"])] += 1
+            remaining -= 1
+    return grants
+
+
+class Tenant:
+    """One scheduled study: a FedDaemon plus its scheduling state.
+
+    The daemon is built with a per-tenant spool/output/telemetry tree
+    under ``<root>/tenants/<id>/`` and a :class:`LabeledBusView` of the
+    pod bus (every series it publishes carries ``tenant="<id>"``; the
+    fixed label wins, so a tenant cannot publish under another's name).
+    The slice-grant mask is installed as all-zeros BEFORE the first
+    epoch, so the very first compile already takes the mask as a traced
+    input — every later grant flip stays inside that one program
+    (per-tenant CompileGuard, checked at close).
+    """
+
+    def __init__(self, spec: TenantSpec, root: str, bus,
+                 verbose: bool = False):
+        from ..checks.sanitize import CompileGuard
+        from ..telemetry.bus import LabeledBusView
+
+        self.spec = spec
+        base = os.path.join(root, "tenants", spec.tenant)
+        self.spool_dir = os.path.join(base, "spool")
+        self.out_dir = os.path.join(base, "output")
+        self.bus = LabeledBusView(bus, tenant=spec.tenant)
+        if isinstance(spec.config, TrainConfig):
+            cfg, overrides = spec.config, {}
+        else:
+            cfg, overrides = None, dict(spec.config or {})
+        self.daemon = FedDaemon(
+            cfg,
+            capacity=spec.capacity,
+            spool_dir=self.spool_dir,
+            out_dir=self.out_dir,
+            data_path=spec.data_path,
+            quorum=spec.quorum,
+            poll_s=0.0,
+            fault_plan=spec.fault_plan,
+            attack_plan=spec.attack_plan,
+            inventory_rows=spec.inventory_rows,
+            steps=spec.steps,
+            resume=spec.resume,
+            verbose=verbose,
+            bus=self.bus,
+            sink_tags={"tenant": spec.tenant},
+            **overrides,
+        )
+        # the mask must exist from the FIRST trace (None↔mask flips
+        # change the traced program; zeros↔ones flips do not)
+        self.daemon.set_slice_grant(
+            np.zeros(self.daemon.num_slices, np.float32)
+        )
+        self.guard = CompileGuard(
+            {"epoch_fn": self.daemon.trainer.epoch_fn},
+            max_compiles=1, label=f"tenant:{spec.tenant}",
+        )
+        self.granted = 0
+        self.status = "active"  # active | done | stopped
+        self.preempted = False
+        self.preempt_count = 0
+        self.pauses_ms: list[float] = []
+        self.busy_slice_s = 0.0  # granted×trained integral (fairness)
+
+    # -- scheduling predicates --------------------------------------------
+
+    @property
+    def num_slices(self) -> int:
+        return self.daemon.num_slices
+
+    @property
+    def quota(self) -> int:
+        q = self.spec.slice_quota
+        return self.num_slices if q is None else max(int(q), 0)
+
+    @property
+    def finished(self) -> bool:
+        return (
+            self.spec.max_epochs is not None
+            and self.daemon.epochs_run >= self.spec.max_epochs
+        )
+
+    def runnable(self) -> bool:
+        return (
+            self.status == "active"
+            and not self.finished
+            and self.daemon.trainable()
+        )
+
+    def demand(self) -> int:
+        """Max USEFUL slices this tick: 0 while the fit would hold
+        (below quorum / no trainable batch), else quota ∧ mesh width.
+        An unsliced tenant demands one pod slice (time-multiplexing)."""
+        if not self.runnable():
+            return 0
+        return max(min(self.quota, self.num_slices), 1)
+
+    # -- spool / membership ------------------------------------------------
+
+    def pump_spool(self) -> bool:
+        """Drain the tenant's OWN membership spool (joins/leaves/shutdown
+        — the churn surface is unchanged under scheduling)."""
+        changed = self.daemon.ingest()
+        if changed:
+            self.daemon._on_membership_change()
+        if self.daemon._stop and self.status == "active":
+            self.status = "stopped"
+        return changed
+
+    # -- the yield protocol ------------------------------------------------
+
+    def apply_grant(self, n: int) -> float:
+        """Move this tenant to ``n`` granted slices; returns the pause in
+        ms (0.0 when nothing changed).
+
+        Shrink (``n < granted``) is checkpoint-THEN-yield: the rotating
+        checkpoint is written first (exit-clean — the same artifact the
+        SIGTERM path saves), then the mask drops. A shrink to zero marks
+        the tenant preempted. Grow out of preemption reloads that
+        checkpoint through the real msgpack path into the existing state
+        template before the mask rises — the resumed trajectory is
+        bit-exact with a never-preempted run (proven in
+        tests/test_scheduler.py), and neither direction retraces.
+        """
+        n = max(int(n), 0)
+        if n == self.granted:
+            return 0.0
+        t0 = time.perf_counter()
+        phase = "yield" if n < self.granted else "resume"
+        if n < self.granted:
+            self.daemon.checkpoint()
+            if n == 0 and self.status == "active" \
+                    and self.daemon.state is not None:
+                self.preempted = True
+                self.preempt_count += 1
+        elif self.granted == 0 and self.preempted:
+            self.daemon.reload_checkpoint()
+            self.preempted = False
+        mask = np.zeros(self.num_slices, np.float32)
+        mask[:min(n, self.num_slices)] = 1.0
+        self.daemon.set_slice_grant(mask)
+        self.granted = n
+        pause_ms = (time.perf_counter() - t0) * 1e3
+        self.pauses_ms.append(pause_ms)
+        self.bus.observe("sched_preempt_pause_ms", pause_ms, phase=phase)
+        return pause_ms
+
+    # -- training / lifecycle ----------------------------------------------
+
+    def train_epoch(self):
+        return self.daemon.train_epoch()
+
+    def params_digest(self):
+        from ..trainer.checkpoint import params_digest
+
+        if self.daemon.state is None:
+            return None
+        return params_digest(
+            self.daemon.state.params,
+            getattr(self.daemon.state, "batch_stats", None),
+        )
+
+    def status_view(self) -> dict:
+        return {
+            "tenant": self.spec.tenant,
+            "status": self.status,
+            "priority": self.spec.priority,
+            "weight": self.spec.weight,
+            "quota": self.quota,
+            "granted": self.granted,
+            "preempted": self.preempted,
+            "preempt_count": self.preempt_count,
+            "epochs_run": self.daemon.epochs_run,
+            "held_rounds": self.daemon.held_rounds,
+            "trainable": self.daemon.trainable(),
+            "daemon": self.daemon.status(),
+        }
+
+    def close(self) -> dict:
+        summary = self.daemon.close()
+        summary["tenant"] = self.spec.tenant
+        summary["preempt_count"] = self.preempt_count
+        # the one-compile law, per tenant, across every grant flip
+        summary["epoch_compiles"] = self.guard.check(
+            f"tenant {self.spec.tenant!r} close "
+            f"(preemptions={self.preempt_count})"
+        ).get("epoch_fn", 0)
+        return summary
+
+
+class BackfillLane:
+    """A serving lane that soaks up the tick's leftover slices.
+
+    Wraps an r21 :class:`~..serving.fleet.ReplicaSet`, built lazily on
+    the FIRST grant (AOT warmup is the lane's one-time admission cost)
+    and pinned to the idle band's devices. Each ``run_quantum`` submits a
+    bounded burst from ``feed`` — the lane never owns the pod, it rents
+    this tick's residue, and draining it is just not granting the next
+    quantum (the ReplicaSet keeps no training state to checkpoint).
+    """
+
+    def __init__(self, cfg: TrainConfig, feed, *, params=None,
+                 batch_stats=None, checkpoint: str | None = None,
+                 replicas: int = 1, requests_per_quantum: int = 4,
+                 name: str = "backfill", engine_kwargs: dict | None = None):
+        if feed is None:
+            raise SchedulerError(
+                "BackfillLane needs a feed() callable returning one "
+                "request's rows"
+            )
+        self.cfg = cfg
+        self.feed = feed
+        self.params = params
+        self.batch_stats = batch_stats
+        self.checkpoint = checkpoint
+        self.replicas = int(replicas)
+        self.requests_per_quantum = int(requests_per_quantum)
+        self.name = name
+        self.engine_kwargs = dict(engine_kwargs or {})
+        self.requests_served = 0
+        self.samples_served = 0
+        self.quanta = 0
+        self._set = None
+
+    def _ensure(self, bus, devices) -> None:
+        if self._set is not None:
+            return
+        from ..serving.fleet import ReplicaSet
+        from ..telemetry.bus import LabeledBusView
+
+        self._set = ReplicaSet(
+            self.cfg, replicas=self.replicas, params=self.params,
+            batch_stats=self.batch_stats, checkpoint=self.checkpoint,
+            bus=LabeledBusView(bus, lane=self.name) if bus is not None
+            else None,
+            devices=list(devices) if devices else None,
+            **self.engine_kwargs,
+        )
+        self._set.warmup()
+
+    def run_quantum(self, bus=None, devices=None) -> dict:
+        """One bounded serving burst on the granted band; returns
+        ``{"requests": n, "samples": m}``."""
+        self._ensure(bus, devices)
+        bursts = []
+        for _ in range(self.requests_per_quantum):
+            rows = self.feed()
+            bursts.append((self._set.submit(rows), len(rows)))
+        requests = samples = 0
+        for fut, n in bursts:
+            fut.result()
+            requests += 1
+            samples += n
+        self.requests_served += requests
+        self.samples_served += samples
+        self.quanta += 1
+        return {"requests": requests, "samples": samples}
+
+    def status(self) -> dict:
+        return {
+            "name": self.name,
+            "started": self._set is not None,
+            "replicas": self.replicas,
+            "requests_served": self.requests_served,
+            "samples_served": self.samples_served,
+            "quanta": self.quanta,
+            "fleet": None if self._set is None else self._set.status(),
+        }
+
+    def close(self) -> dict:
+        out = {
+            "name": self.name,
+            "requests_served": self.requests_served,
+            "samples_served": self.samples_served,
+            "quanta": self.quanta,
+        }
+        if self._set is not None:
+            self._set.assert_no_compiles()
+            out["fleet"] = self._set.close()
+            self._set = None
+        return out
+
+
+class FleetScheduler:
+    """The pod-level tick loop: drain spools, allocate, yield/resume,
+    train one epoch per granted tenant, backfill the residue, account.
+
+    ``pod_slices`` is the shared pool's width in slices (on the CPU
+    emulation: virtual-device bands). The scheduler is single-threaded
+    and deterministic — tenants train in (priority desc, tenant id)
+    order — so a run is reproducible and each tenant's one-compile
+    guard is meaningful. Goodput integrals (busy-slice-seconds over
+    wall) and every preemption pause are kept for ``bench.py
+    --tenants``; live gauges publish tenant-labeled into the ONE pod
+    bus for the single /statusz exporter.
+    """
+
+    def __init__(self, root: str, pod_slices: int = 1, bus=None,
+                 poll_s: float = 0.05, verbose: bool = True,
+                 backfill: BackfillLane | None = None):
+        from ..telemetry.bus import global_bus
+
+        if pod_slices < 1:
+            raise SchedulerError(
+                f"pod_slices must be >= 1, got {pod_slices}"
+            )
+        self.root = root
+        self.spool_dir = os.path.join(root, "spool")
+        os.makedirs(self.spool_dir, exist_ok=True)
+        self.pod_slices = int(pod_slices)
+        self.bus = bus if bus is not None else global_bus()
+        self.poll_s = poll_s
+        self.verbose = verbose
+        self.backfill = backfill
+        self.tenants: dict[str, Tenant] = {}
+        self._stop = False
+        self._preempted = False
+        self.ticks = 0
+        self._wall_s = 0.0
+        self._busy_slice_s = 0.0
+        # per-slice device bands (emulated pod): backfill pins to the
+        # TAIL band — fair_share packs tenants from the front, so the
+        # residue lives at the tail by construction
+        import jax
+
+        devs = jax.devices()
+        k = max(len(devs) // self.pod_slices, 1)
+        self._slice_devices = [
+            devs[i * k:(i + 1) * k] for i in range(self.pod_slices)
+        ]
+        self.bus.gauge("sched_pod_slices", self.pod_slices)
+
+    def _log(self, msg: str) -> None:
+        if self.verbose:
+            from ..trainer.logs import log_info
+
+            log_info(msg)
+
+    # -- tenant admission --------------------------------------------------
+
+    def register(self, spec: TenantSpec) -> Tenant:
+        if spec.tenant in self.tenants:
+            raise SchedulerError(
+                f"tenant {spec.tenant!r} already registered"
+            )
+        t = Tenant(spec, self.root, self.bus, verbose=self.verbose)
+        self.tenants[spec.tenant] = t
+        self.bus.counter("sched_events_total", kind="register")
+        self.bus.gauge("sched_tenants", len(self.tenants))
+        self._log(
+            f"[sched] register tenant {spec.tenant!r} "
+            f"(priority {spec.priority}, quota {t.quota}, "
+            f"mesh slices {t.num_slices})"
+        )
+        return t
+
+    def deregister(self, tenant: str) -> None:
+        t = self.tenants.get(tenant)
+        if t is None or t.status != "active":
+            return
+        t.status = "stopped"  # before the grant drop (not a preemption)
+        t.apply_grant(0)
+        self.bus.counter("sched_events_total", kind="deregister")
+        self._log(f"[sched] deregister tenant {tenant!r}")
+
+    def ingest(self) -> bool:
+        """Drain the scheduler spool (sorted-filename order, remove on
+        apply, ``.rejected`` quarantine for malformed files) — the same
+        event discipline the membership spool taught operators."""
+        from ..trainer.logs import log_warning
+
+        changed = False
+        for name in sorted(os.listdir(self.spool_dir)):
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(self.spool_dir, name)
+            try:
+                with open(path) as fh:
+                    ev = json.load(fh)
+            except (OSError, json.JSONDecodeError) as e:
+                log_warning(f"[sched] unreadable spool file {path}: {e}")
+                try:
+                    os.replace(path, path + ".rejected")
+                except OSError:
+                    pass
+                continue
+            os.remove(path)
+            if not isinstance(ev, dict):
+                log_warning(f"[sched] spool file {path} is not an object")
+                continue
+            kind = ev.get("event")
+            try:
+                if kind == "register":
+                    self.register(TenantSpec.from_event(ev))
+                    changed = True
+                elif kind == "deregister":
+                    self.deregister(str(ev.get("tenant") or ""))
+                    changed = True
+                elif kind == "shutdown":
+                    self._stop = True
+                    self._log("[sched] shutdown event received")
+                    break
+                else:
+                    log_warning(
+                        f"[sched] unknown spool event {ev!r} — ignored"
+                    )
+                    self.bus.counter("sched_events_total", kind="rejected")
+            except (SchedulerError, ValueError, TypeError) as e:
+                log_warning(f"[sched] bad spool event {ev!r}: {e}")
+                self.bus.counter("sched_events_total", kind="rejected")
+        return changed
+
+    # -- the tick ----------------------------------------------------------
+
+    def _order(self) -> list[Tenant]:
+        return sorted(
+            self.tenants.values(),
+            key=lambda t: (-t.spec.priority, t.spec.tenant),
+        )
+
+    def tick(self, sleep_when_idle: bool = True) -> dict:
+        """One scheduling round: spools → allocation → shrink-before-grow
+        → one epoch per granted tenant → backfill the residue → account.
+
+        Shrink-before-grow matters: a freed slice must exist before it
+        is granted elsewhere, so every yield (with its checkpoint) lands
+        before any resume (with its reload) — the pool is never
+        oversubscribed mid-tick.
+        """
+        t0 = time.perf_counter()
+        changed = self.ingest()
+        for t in self._order():
+            changed |= t.pump_spool()
+            if t.finished and t.status == "active":
+                t.status = "done"  # before the grant drop: a natural
+                t.apply_grant(0)   # finish is not a preemption
+                self._log(
+                    f"[sched] tenant {t.spec.tenant!r} done "
+                    f"({t.daemon.epochs_run} epochs)"
+                )
+                changed = True
+        requests = [
+            {
+                "tenant": t.spec.tenant,
+                "priority": t.spec.priority,
+                "weight": t.spec.weight,
+                "demand": t.demand(),
+            }
+            for t in self._order()
+        ]
+        grants = fair_share(self.pod_slices, requests)
+        # a grant below the tenant's slice-quorum floor would only buy
+        # held rounds inside its compiled program — return it to the
+        # residue instead
+        for t in self._order():
+            g = grants.get(t.spec.tenant, 0)
+            if 0 < g < int(getattr(t.daemon.cfg, "min_slices", 1) or 1):
+                grants[t.spec.tenant] = 0
+        preempt_pause_ms = 0.0
+        for t in self._order():  # shrinks first: free before granting
+            g = grants.get(t.spec.tenant, 0)
+            if g < t.granted:
+                preempt_pause_ms += t.apply_grant(g)
+        for t in self._order():
+            g = grants.get(t.spec.tenant, 0)
+            if g > t.granted:
+                preempt_pause_ms += t.apply_grant(g)
+        trained = 0
+        busy = 0
+        trained_tenants = []
+        for t in self._order():
+            if t.granted > 0 and t.status == "active":
+                loss = t.train_epoch()
+                if loss is not None:
+                    trained += 1
+                    busy += t.granted
+                    trained_tenants.append(t)
+        leftover = self.pod_slices - sum(
+            t.granted for t in self.tenants.values()
+        )
+        served = {"requests": 0, "samples": 0}
+        if self.backfill is not None and leftover > 0:
+            served = self.backfill.run_quantum(
+                bus=self.bus, devices=self._slice_devices[-1],
+            )
+            if served["requests"]:
+                busy += leftover
+        dt = time.perf_counter() - t0
+        idle_tick = (
+            trained == 0 and not served["requests"] and not changed
+        )
+        if sleep_when_idle and idle_tick and not self._stop:
+            time.sleep(self.poll_s)
+            dt += self.poll_s
+        self._wall_s += dt
+        self._busy_slice_s += min(busy, self.pod_slices) * dt
+        for t in trained_tenants:  # fairness ledger: who GOT the pod
+            t.busy_slice_s += t.granted * dt
+        self.ticks += 1
+        for t in self.tenants.values():
+            self.bus.gauge("sched_granted_slices", t.granted,
+                           tenant=t.spec.tenant)
+        self.bus.counter("sched_ticks_total")
+        self.bus.gauge("sched_idle_fraction", self.idle_fraction())
+        self.bus.gauge("sched_backfill_requests",
+                       0 if self.backfill is None
+                       else self.backfill.requests_served)
+        return {
+            "trained": trained,
+            "grants": grants,
+            "busy_slices": busy,
+            "leftover": leftover,
+            "served": served,
+            "changed": changed,
+            "preempt_pause_ms": round(preempt_pause_ms, 3),
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def done(self) -> bool:
+        return bool(self.tenants) and all(
+            t.status in ("done", "stopped") for t in self.tenants.values()
+        )
+
+    def run(self, max_wall_s: float | None = None,
+            max_ticks: int | None = None) -> dict:
+        """Tick until every tenant is done/stopped, a shutdown event or
+        signal arrives, or a bound trips. SIGTERM/SIGINT is the pod's
+        OWN preemption: every tenant checkpoints (exit-clean) and the
+        whole fleet resumes from its tenant trees."""
+        from ..robustness.preemption import PreemptionGuard
+
+        t_start = time.monotonic()
+        with PreemptionGuard() as guard:
+            while not self._stop:
+                self.tick()
+                if guard.requested is not None:
+                    self._preempted = True
+                    for t in self._order():
+                        t.apply_grant(0)
+                    self._log(
+                        "[sched] preemption signal — all tenants "
+                        "checkpointed and yielded"
+                    )
+                    break
+                if self.done():
+                    break
+                if max_ticks is not None and self.ticks >= max_ticks:
+                    break
+                if max_wall_s is not None \
+                        and time.monotonic() - t_start >= max_wall_s:
+                    break
+        return self.close()
+
+    def idle_fraction(self) -> float:
+        denom = self.pod_slices * self._wall_s
+        if denom <= 0:
+            return 0.0
+        return round(1.0 - self._busy_slice_s / denom, 6)
+
+    def goodput(self) -> dict:
+        """The packing proof's raw material: integrated busy/idle slice
+        time, preemption pauses, and per-tenant progress."""
+        pauses = [
+            p for t in self.tenants.values() for p in t.pauses_ms
+        ]
+        return {
+            "pod_slices": self.pod_slices,
+            "wall_s": round(self._wall_s, 4),
+            "busy_slice_s": round(self._busy_slice_s, 4),
+            "slice_idle_fraction": self.idle_fraction(),
+            "ticks": self.ticks,
+            "preempt_count": sum(
+                t.preempt_count for t in self.tenants.values()
+            ),
+            "preempt_pause_ms_p50": (
+                round(float(np.percentile(pauses, 50)), 3) if pauses
+                else 0.0
+            ),
+            "preempt_pause_ms_p99": (
+                round(float(np.percentile(pauses, 99)), 3) if pauses
+                else 0.0
+            ),
+            "epochs": {
+                t.spec.tenant: t.daemon.epochs_run
+                for t in self.tenants.values()
+            },
+            "busy_slice_s_per_tenant": {
+                t.spec.tenant: round(t.busy_slice_s, 4)
+                for t in self.tenants.values()
+            },
+            "backfill": (
+                None if self.backfill is None else {
+                    "requests": self.backfill.requests_served,
+                    "samples": self.backfill.samples_served,
+                }
+            ),
+        }
+
+    # -- live observability ------------------------------------------------
+
+    def status(self) -> dict:
+        """The pod /statusz payload: scheduler state plus EVERY tenant's
+        own daemon status, tenant-labeled — one exporter, many fits."""
+        return {
+            "mode": "scheduler",
+            "pod_slices": self.pod_slices,
+            "ticks": self.ticks,
+            "preempted": self._preempted,
+            "goodput": self.goodput(),
+            "spool_dir": self.spool_dir,
+            "tenants": {
+                name: t.status_view()
+                for name, t in sorted(self.tenants.items())
+            },
+            "backfill": (
+                None if self.backfill is None else self.backfill.status()
+            ),
+        }
+
+    def health_probes(self) -> dict:
+        probes = {"spool": lambda: os.path.isdir(self.spool_dir)}
+        for name, t in self.tenants.items():
+            probes[f"tenant_{name}"] = (
+                lambda t=t: t.status in ("active", "done", "stopped")
+            )
+        return probes
+
+    def close(self) -> dict:
+        """Checkpoint + close every tenant (each asserts its own
+        one-compile guard), close the backfill lane, return the fleet
+        summary."""
+        summaries = {}
+        for name, t in sorted(self.tenants.items()):
+            summaries[name] = t.close()
+        out = {
+            "tenants": summaries,
+            "goodput": self.goodput(),
+            "preempted": self._preempted,
+        }
+        if self.backfill is not None:
+            out["backfill"] = self.backfill.close()
+        return out

@@ -65,7 +65,8 @@ class ReplicaSet:
     def __init__(self, cfg: TrainConfig, *, replicas: int = 2,
                  checkpoint: str | None = None, params=None,
                  batch_stats=None, supervise_interval_s: float = 0.2,
-                 tracer=None, sink=None, bus=None, **engine_kwargs):
+                 tracer=None, sink=None, bus=None, devices=None,
+                 **engine_kwargs):
         import jax
 
         from ..telemetry.bus import NULL_BUS
@@ -87,7 +88,11 @@ class ReplicaSet:
         # serves the published weights, not the boot checkpoint.
         self._host_weights = (params, batch_stats or {})
         self._engine_kwargs = dict(engine_kwargs)
-        self._devices = jax.devices()
+        # device pinning (r22): the fleet scheduler backfills idle slices
+        # with serving replicas by handing the set the slice band's devices;
+        # default (None) keeps the r21 behavior — replicas round-robin over
+        # every visible device
+        self._devices = list(devices) if devices else jax.devices()
         self.capacity = int(replicas)
         self.table = MembershipTable(capacity=self.capacity)
         self._engines: list = [None] * self.capacity
@@ -421,6 +426,9 @@ class ReplicaSet:
                 "task_id": self.cfg.task_id,
                 "warm": self._warm,
                 "replicas": self.capacity,
+                # the device band the replicas round-robin over (r22: the
+                # scheduler pins backfill lanes to idle slices' devices)
+                "devices": [str(d) for d in self._devices],
                 "replicas_live": self.table.occupied,
                 "membership": self.table.to_json(),
                 "routed_sessions": len(self._routes),

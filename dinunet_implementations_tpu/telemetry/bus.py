@@ -159,6 +159,68 @@ class MetricsBus:
             self._hists.clear()
 
 
+class LabeledBusView:
+    """A :class:`MetricsBus` facade that stamps fixed labels (e.g.
+    ``tenant="studyA"``) onto every published series.
+
+    The fleet scheduler (runner/scheduler.py, r22) hands each tenant's
+    daemon a view of the ONE pod-wide bus: all tenants publish into the
+    same registry — one snapshot, one /metrics exporter for the whole pod —
+    but every series a tenant emits carries its identity, so
+    ``serve_epoch{tenant="a"}`` and ``serve_epoch{tenant="b"}`` never
+    collide. The fixed labels WIN over caller kwargs on collision: a
+    tenant's code cannot (accidentally or otherwise) publish under another
+    tenant's label. Reads (snapshot, histograms) delegate unfiltered to the
+    underlying bus — a view is a publishing scope, not a privacy boundary;
+    label-scoped reads use the label kwargs as usual.
+    """
+
+    def __init__(self, bus: MetricsBus, **labels):
+        self._bus = bus
+        self._labels = dict(labels)
+
+    @property
+    def enabled(self) -> bool:
+        return self._bus.enabled
+
+    @property
+    def labels(self) -> dict:
+        return dict(self._labels)
+
+    # -- publishing (label-stamped) ---------------------------------------
+
+    def counter(self, name: str, n=1, **labels) -> None:
+        self._bus.counter(name, n, **{**labels, **self._labels})  # jaxlint: disable=R007
+
+    def gauge(self, name: str, value, **labels) -> None:
+        self._bus.gauge(name, value, **{**labels, **self._labels})  # jaxlint: disable=R007
+
+    def clear_gauge(self, name: str, **labels) -> None:
+        self._bus.clear_gauge(name, **{**labels, **self._labels})  # jaxlint: disable=R007
+
+    def observe(self, name: str, value, *, lo: float = DEFAULT_LO,
+                hi: float = DEFAULT_HI,
+                per_decade: int = DEFAULT_PER_DECADE, **labels) -> None:
+        self._bus.observe(
+            name, value, lo=lo, hi=hi,  # jaxlint: disable=R007
+            per_decade=per_decade, **{**labels, **self._labels},
+        )
+
+    # -- reading (delegated; label kwargs stamp like publishes) ------------
+
+    def histogram(self, name: str, **labels):
+        return self._bus.histogram(name, **{**labels, **self._labels})
+
+    def merged_histogram(self, name: str):
+        return self._bus.merged_histogram(name)
+
+    def snapshot(self) -> dict:
+        return self._bus.snapshot()
+
+    def reset(self) -> None:
+        self._bus.reset()
+
+
 #: shared disabled instance — thread it where live metrics are off
 NULL_BUS = MetricsBus(enabled=False)
 

@@ -46,10 +46,14 @@ TRACE_CHROME_FILE = "trace.chrome.json"
 #: DP/secure-agg/personalization knobs: a DP run's artifact carries the
 #: exact mechanism parameters its ε claim depends on (null when the whole
 #: privacy plane is off).
+#: tags (r22) is the fleet-scheduler identity contract: a tenant's
+#: artifact carries {"tenant": "<id>"} so a pod packing many studies
+#: yields per-study artifacts that self-identify (null for solo fits).
 MANIFEST_REQUIRED = frozenset({
     "schema_version", "config_hash", "task_id", "agg_engine", "num_sites",
     "pipeline", "fold", "jax_version", "jaxlib_version", "backend", "mesh",
     "package_version", "git_rev", "fault_plan", "attack_plan", "privacy",
+    "tags",
 })
 
 #: required metrics.jsonl keys by row kind
@@ -176,7 +180,7 @@ def privacy_manifest(cfg) -> dict | None:
 
 
 def build_manifest(cfg, mesh=None, fold: int = 0, fault_plan=None,
-                   attack_plan=None) -> dict:
+                   attack_plan=None, tags: dict | None = None) -> dict:
     import jax
     import jaxlib
 
@@ -205,6 +209,10 @@ def build_manifest(cfg, mesh=None, fold: int = 0, fault_plan=None,
         # the active privacy-plane knobs, verbatim (r20; null = plane off):
         # DP runs are reproducible from the artifact alone
         "privacy": privacy_manifest(cfg),
+        # scheduler identity tags (r22; null = solo fit): which tenant of a
+        # packed pod this artifact belongs to — the per-tenant isolation
+        # story is auditable from the artifacts alone
+        "tags": dict(tags) if tags else None,
         "config": cfg.to_dict(),
     }
 
@@ -224,11 +232,11 @@ class FitTelemetry:
     @classmethod
     def open(cls, dirpath: str, cfg, mesh=None, fold: int = 0,
              tracer: SpanTracer | None = None, fault_plan=None,
-             attack_plan=None) -> "FitTelemetry":
+             attack_plan=None, tags: dict | None = None) -> "FitTelemetry":
         sink = cls(dirpath, tracer or SpanTracer())
         manifest = build_manifest(
             cfg, mesh=mesh, fold=fold, fault_plan=fault_plan,
-            attack_plan=attack_plan,
+            attack_plan=attack_plan, tags=tags,
         )
         with open(os.path.join(dirpath, MANIFEST_FILE), "w") as fh:
             json.dump(manifest, fh, indent=2, default=str)

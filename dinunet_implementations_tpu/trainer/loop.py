@@ -222,6 +222,14 @@ class FederatedTrainer:
         # pinned inventory row budget ([S, N_max, ...] grid height), same
         # retrace-proofing for the device-resident inventory upload
         self.fixed_inventory_rows = None
+        # [num_slices] scheduler grant mask (runner/scheduler.py, r22): a
+        # slice the fleet scheduler has not granted to this fit never
+        # arrives — folded into the r19 slice-liveness window exactly like
+        # membership_mask folds into site liveness. Setting it forces the
+        # slice-liveness input to be FED even without a FaultPlan, so one
+        # compiled program covers every grow/shrink/preempt/restore grant
+        # flip (CompileGuard-assertable). None = no scheduler, r19 behavior.
+        self.slice_grant = None
 
     def _coordinator(self) -> bool:
         """Multi-host runs: only process 0 writes logs/checkpoints (every
@@ -395,19 +403,32 @@ class FederatedTrainer:
         rendered into the mask only on single-process emulation — under the
         supervised multi-process runner they are REAL process deaths
         (runner/dcn_worker.py), and masking them too would keep a restarted
-        slice dead forever."""
+        slice dead forever. A scheduler slice grant (``slice_grant``, r22)
+        multiplies in — an ungranted slice looks exactly like a dead one
+        (renormalized aggregation, min_slices quorum), and forces the mask
+        into existence so grant flips share ONE compiled form with fault
+        windows."""
         from ..parallel.mesh import slice_count
 
         n_sl = slice_count(self.mesh)
-        if n_sl <= 1 or self.fault_plan is None:
+        if n_sl <= 1 or (self.fault_plan is None and self.slice_grant is None):
             return None
-        from ..parallel.distributed import spans_processes
-        from ..robustness.faults import slice_fault_window
+        win = None
+        if self.fault_plan is not None:
+            from ..parallel.distributed import spans_processes
+            from ..robustness.faults import slice_fault_window
 
-        return slice_fault_window(
-            self.fault_plan, n_sl, round0, rounds,
-            include_kills=not spans_processes(self.mesh),
-        )
+            win = slice_fault_window(
+                self.fault_plan, n_sl, round0, rounds,
+                include_kills=not spans_processes(self.mesh),
+            )
+        if self.slice_grant is not None:
+            grant = np.asarray(self.slice_grant, np.float32)[:n_sl, None]
+            if win is None:
+                win = np.broadcast_to(grant, (n_sl, rounds)).copy()
+            else:
+                win = win * grant
+        return win
 
     def _publish_slice_liveness(self, slice_live) -> None:
         """Per-slice liveness gauges for the live bus (r19): how many of
@@ -511,7 +532,8 @@ class FederatedTrainer:
         # same global round counter as the site mask
         slice_live = self._slice_window(
             int(state.round), fb.steps // max(self.cfg.local_iterations, 1)
-        ) if self.fault_plan is not None else None
+        ) if (self.fault_plan is not None
+              or self.slice_grant is not None) else None
         batch = self._put_batch(fb)
         live_dev = self._put_live(live)
         attack_dev = self._put_live(attack)

@@ -1,0 +1,90 @@
+"""Composition scenario (r22): every hostile subsystem at once, ONE fit.
+
+The repo proves its planes one at a time — faults (r14), attacks +
+robust aggregation (r17), DP-SGD (r15/r20), site packing (r12), the
+sliced DCN topology (r18/r19). This test turns them ALL on in a single
+fit and gates the combination on the oracles those rounds established:
+
+- packed (K=2) == unpacked (K=1): losses, final params, per-site health
+  counters, and the spent ε are identical across pack factors — no plane
+  re-keys on the physical topology;
+- the chaos actually happened: the NaN-poisoned site is quarantined, the
+  dropped site skipped rounds, ε is finite and positive;
+- ``DINUNET_SANITIZE=compile`` wraps both arms — the composed program
+  still compiles exactly ONCE per fit (a violation raises);
+- each arm's telemetry passes ``report --validate``.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from dinunet_implementations_tpu.core.config import FSArgs, TrainConfig
+from dinunet_implementations_tpu.data.demo import make_fs_demo_tree
+from dinunet_implementations_tpu.robustness.attacks import AttackPlan
+from dinunet_implementations_tpu.robustness.faults import FaultPlan
+from dinunet_implementations_tpu.runner import FedRunner
+from dinunet_implementations_tpu.telemetry import report
+
+
+def _run_arm(tmp_path, tree, k):
+    """One composed fit at pack factor ``k``: 4 virtual sites on 2 DCN
+    slices, a site dropped mid-window, a NaN-poisoned site (sticky
+    quarantine), a slice outage, a permanent sign-flipper plus a scaled
+    burst under trimmed-mean aggregation, and DP-SGD with a live ledger."""
+    cfg = TrainConfig(
+        task_id="FS-Classification", epochs=2, patience=10, batch_size=4,
+        seed=7, telemetry="on", donate_epoch_state=False,
+        num_slices=2, staleness_bound=2, sites_per_device=k,
+        robust_agg="trimmed_mean", quarantine_rounds=1,
+        dp_clip=1.0, dp_noise_multiplier=0.5,
+        fs_args=FSArgs(input_size=8, hidden_sizes=(8,)),
+    )
+    out = str(tmp_path / f"out_k{k}")
+    runner = FedRunner(
+        cfg, data_path=tree, out_dir=out,
+        fault_plan=FaultPlan(
+            drop=((3, 2, 4),),        # site 3 offline rounds 2-4
+            nan_at=((1, 0),),         # site 0 poisoned at round 1
+            slice_drop_at=((1, 5, 6),),  # slice 1 outage rounds 5-6
+        ),
+        attack_plan=AttackPlan(
+            sign_flip=((2, 0, -1),),  # site 2 hostile forever
+            scale=((1, 5, 8),), scale_factor=4.0,
+        ),
+    )
+    return runner.run(verbose=False)[0], out
+
+
+def test_composed_fit_packed_matches_unpacked(tmp_path, monkeypatch):
+    monkeypatch.setenv("DINUNET_SANITIZE", "compile")
+    tree = make_fs_demo_tree(str(tmp_path / "tree"), n_sites=4,
+                             subjects=32, n_features=8, seed=0)
+    r2, out2 = _run_arm(tmp_path, tree, 2)
+    r1, out1 = _run_arm(tmp_path, tree, 1)
+    # the packing equivalence policy (test_packing.py) survives the full
+    # composition: same trajectory, same final weights
+    np.testing.assert_allclose(
+        r2["epoch_losses"], r1["epoch_losses"], atol=1e-6
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6
+        ),
+        r2["state"].params, r1["state"].params,
+    )
+    # the chaos planes actually fired, and identically in both packings
+    health2, health1 = r2["site_health"], r1["site_health"]
+    assert health2["site_quarantined"] == health1["site_quarantined"]
+    assert sum(health2["site_quarantined"]) >= 1  # the poisoned site
+    assert health2["site_skipped_rounds"] == health1["site_skipped_rounds"]
+    assert sum(health2["site_skipped_rounds"]) >= 1  # the dropped site
+    # the ε ledger is packing-agnostic (counter keyed on GLOBAL site ids)
+    assert r2["dp_epsilon"] is not None and r2["dp_epsilon"] > 0
+    assert r2["dp_epsilon"] == pytest.approx(r1["dp_epsilon"], rel=1e-12)
+    # each arm's telemetry is schema-valid end to end
+    for out in (out2, out1):
+        tdir = os.path.join(out, "telemetry", "fold_0")
+        assert report.main([tdir, "--validate"]) == 0

@@ -1,0 +1,386 @@
+"""Fleet scheduler (r22): one pod, many tenants.
+
+The load-bearing claims, as tests:
+
+- ``fair_share`` is a law, not a heuristic: strictly descending priority
+  bands, weighted max-min within a band, demand caps, deterministic
+  tiebreak — the same inputs always produce the same grants;
+- the scheduler spool speaks the membership-spool dialect (sorted
+  filenames, remove-on-apply, ``.rejected`` quarantine) and a malformed
+  register cannot take the pod down;
+- preempt-and-yield is checkpoint-then-yield and resume is BIT-EXACT: a
+  tenant preempted by a higher-priority arrival finishes with the SAME
+  params digest as a never-preempted reference run, and its per-tenant
+  CompileGuard counts ONE epoch compile across the whole
+  grant/yield/resume sequence;
+- tenants are isolated directory-deep: tenant A exhausting its DP
+  ε-budget (clean checkpointed stop) and quarantining a poisoned site
+  leaves tenant B's trajectory bit-identical to B's solo run;
+- ONE exporter serves the pod: /statusz nests every tenant's daemon view
+  and /metrics carries tenant-labeled series from the shared bus;
+- per-tenant telemetry sinks carry the ``{"tenant": id}`` manifest tag
+  and pass ``report --validate`` independently;
+- a BackfillLane soaks up leftover slices with a serving ReplicaSet and
+  closes with zero post-warmup compiles.
+"""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dinunet_implementations_tpu.core.config import FSArgs, TrainConfig
+from dinunet_implementations_tpu.data.demo import make_fs_demo_tree
+from dinunet_implementations_tpu.robustness.faults import FaultPlan
+from dinunet_implementations_tpu.runner.scheduler import (
+    BackfillLane,
+    FleetScheduler,
+    SchedulerError,
+    TenantSpec,
+    fair_share,
+)
+from dinunet_implementations_tpu.telemetry.bus import MetricsBus
+from dinunet_implementations_tpu.telemetry.exporter import StatusExporter
+
+
+# ---------------------------------------------------------------------------
+# fixtures (tiny CPU corners; conftest forces 8 virtual devices)
+# ---------------------------------------------------------------------------
+
+
+def _cfg(**kw):
+    base = dict(
+        task_id="FS-Classification", batch_size=4, staleness_bound=2,
+        num_slices=2, fs_args=FSArgs(input_size=8, hidden_sizes=(8,)),
+        # donation off: the global XLA compile cache + donated buffers
+        # corruption corner (serving/engine.py warmup note) — these tests
+        # re-fit identical tiny programs, the exact cache-hit recipe
+        donate_epoch_state=False,
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def trees(tmp_path_factory):
+    root = tmp_path_factory.mktemp("sched_trees")
+    return [
+        make_fs_demo_tree(str(root / f"tree{i}"), n_sites=4, subjects=32,
+                          n_features=8, seed=i)
+        for i in range(2)
+    ]
+
+
+def _spec(tenant, tree, **kw):
+    base = dict(tenant=tenant, data_path=tree, config=_cfg(), capacity=4,
+                inventory_rows=48, quorum=1)
+    base.update(kw)
+    return TenantSpec(**base)
+
+
+def _run_to_done(sched, max_ticks=60):
+    for _ in range(max_ticks):
+        sched.tick(sleep_when_idle=False)
+        if sched.done():
+            return
+    raise AssertionError("scheduler did not converge")
+
+
+# ---------------------------------------------------------------------------
+# fair_share: the allocation law
+# ---------------------------------------------------------------------------
+
+
+def test_fair_share_priority_bands_drain_first():
+    req = [
+        {"tenant": "lo", "priority": 1.0, "weight": 1.0, "demand": 4},
+        {"tenant": "hi", "priority": 2.0, "weight": 1.0, "demand": 3},
+    ]
+    # the higher band takes all it can use before the lower band sees
+    # the pool — that asymmetry IS preemption
+    assert fair_share(4, req) == {"hi": 3, "lo": 1}
+    assert fair_share(2, req) == {"hi": 2, "lo": 0}
+
+
+def test_fair_share_weighted_max_min_within_band():
+    req = [
+        {"tenant": "a", "priority": 1.0, "weight": 2.0, "demand": 8},
+        {"tenant": "b", "priority": 1.0, "weight": 1.0, "demand": 8},
+    ]
+    # 2:1 weights → 2:1 grants (max-min on grants-per-unit-weight)
+    assert fair_share(6, req) == {"a": 4, "b": 2}
+
+
+def test_fair_share_demand_caps_and_residue():
+    req = [
+        {"tenant": "a", "priority": 1.0, "weight": 1.0, "demand": 1},
+        {"tenant": "hold", "priority": 1.0, "weight": 1.0, "demand": 0},
+    ]
+    # a holding tenant (demand 0) gets nothing; the unallocatable
+    # residue (3 slices here) is the backfill's rent
+    assert fair_share(4, req) == {"a": 1, "hold": 0}
+
+
+def test_fair_share_deterministic_tiebreak_by_tenant_id():
+    rows = [
+        {"tenant": t, "priority": 1.0, "weight": 1.0, "demand": 4}
+        for t in ("c", "a", "b")
+    ]
+    assert fair_share(1, rows) == {"a": 1, "b": 0, "c": 0}
+    assert fair_share(1, list(reversed(rows))) == {"a": 1, "b": 0, "c": 0}
+
+
+# ---------------------------------------------------------------------------
+# scheduler spool: the admission wire
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_spool_register_shutdown_and_quarantine(tmp_path, trees):
+    root = str(tmp_path / "pod")
+    bus = MetricsBus()
+    sched = FleetScheduler(root, pod_slices=2, bus=bus, poll_s=0.0,
+                           verbose=False)
+    # the JSON register form an operator (or GUI) writes — flat config
+    # overrides, exactly like a membership join's "config" key
+    ev = {
+        "event": "register", "tenant": "study0", "data_path": trees[0],
+        "capacity": 4, "inventory_rows": 48, "max_epochs": 1,
+        "config": {
+            "task_id": "FS-Classification", "batch_size": 4,
+            "staleness_bound": 2, "num_slices": 2,
+            "donate_epoch_state": False,
+            "fs_args": {"input_size": 8, "hidden_sizes": [8]},
+        },
+    }
+    with open(os.path.join(sched.spool_dir, "ev000.json"), "w") as fh:
+        json.dump(ev, fh)
+    with open(os.path.join(sched.spool_dir, "ev001.json"), "w") as fh:
+        fh.write("{not json")  # malformed → .rejected quarantine
+    with open(os.path.join(sched.spool_dir, "ev002.json"), "w") as fh:
+        json.dump({"event": "register", "tenant": "../evil"}, fh)
+    sched.tick(sleep_when_idle=False)
+    assert "study0" in sched.tenants
+    assert "../evil" not in sched.tenants
+    assert os.path.exists(
+        os.path.join(sched.spool_dir, "ev001.json.rejected")
+    )
+    assert not os.path.exists(os.path.join(sched.spool_dir, "ev000.json"))
+    snap = bus.snapshot()
+    assert snap["counters"]['sched_events_total{kind="register"}'] == 1
+    assert snap["counters"]['sched_events_total{kind="rejected"}'] >= 1
+    # duplicate registration is an explicit refusal, not a silent replace
+    with pytest.raises(SchedulerError):
+        sched.register(_spec("study0", trees[0]))
+    _run_to_done(sched)
+    assert sched.tenants["study0"].status == "done"
+    assert sched.tenants["study0"].daemon.epochs_run == 1
+    # deregister on a finished tenant is a no-op; shutdown latches stop
+    with open(os.path.join(sched.spool_dir, "zz_down.json"), "w") as fh:
+        json.dump({"event": "shutdown"}, fh)
+    sched.ingest()
+    assert sched._stop
+    out = sched.close()
+    assert out["tenants"]["study0"]["epoch_compiles"] == 1
+
+
+# ---------------------------------------------------------------------------
+# preempt-and-yield: the drill the ISSUE names
+# ---------------------------------------------------------------------------
+
+
+def test_preempt_resume_bit_exact_one_compile(tmp_path, trees):
+    """A tenant preempted by a higher-priority arrival (checkpoint-then-
+    yield, mask flip) resumes and finishes with the SAME params digest as
+    a never-preempted reference run — and its CompileGuard counts ONE
+    epoch compile across grant, yield, reload, and regrant."""
+    ref = FleetScheduler(str(tmp_path / "ref"), pod_slices=2,
+                         bus=MetricsBus(), poll_s=0.0, verbose=False)
+    ra = ref.register(_spec("a", trees[0], max_epochs=4))
+    _run_to_done(ref)
+    ref_digest = ra.params_digest()
+    ref_out = ref.close()
+    assert ref_out["tenants"]["a"]["epoch_compiles"] == 1
+    assert ref_out["goodput"]["preempt_count"] == 0
+
+    sched = FleetScheduler(str(tmp_path / "pod"), pod_slices=2,
+                           bus=MetricsBus(), poll_s=0.0, verbose=False)
+    a = sched.register(_spec("a", trees[0], max_epochs=4, priority=1.0))
+    sched.tick(sleep_when_idle=False)
+    sched.tick(sleep_when_idle=False)
+    assert a.daemon.epochs_run == 2 and a.granted == 2
+    # a higher-priority tenant claims the whole pod mid-study
+    b = sched.register(_spec("b", trees[1], max_epochs=2, priority=2.0))
+    r = sched.tick(sleep_when_idle=False)
+    assert r["grants"] == {"b": 2, "a": 0}
+    assert a.preempted and a.preempt_count == 1 and a.granted == 0
+    assert a.daemon.epochs_run == 2  # frozen while yielded
+    assert r["preempt_pause_ms"] > 0  # the checkpoint IS the pause
+    _run_to_done(sched)
+    assert b.status == "done" and b.daemon.epochs_run == 2
+    assert a.status == "done" and a.daemon.epochs_run == 4
+    assert not a.preempted  # resumed through the reload path
+    assert a.params_digest() == ref_digest  # bit-exact resume
+    out = sched.close()
+    # ONE compile per tenant across the whole preemption drill — the
+    # mask flip stayed inside the compiled program
+    assert out["tenants"]["a"]["epoch_compiles"] == 1
+    assert out["tenants"]["b"]["epoch_compiles"] == 1
+    assert out["goodput"]["preempt_count"] == 1
+    assert out["goodput"]["preempt_pause_ms_p99"] > 0
+
+
+# ---------------------------------------------------------------------------
+# tenant isolation: ε-budget stop + quarantine cannot cross tenants
+# ---------------------------------------------------------------------------
+
+
+def test_epsilon_budget_stop_and_quarantine_are_isolated(tmp_path, trees):
+    """Tenant A trains under DP with a tiny ε-budget (exhausts after one
+    epoch → clean checkpointed stop) AND a NaN-poisoned site (quarantine
+    latch). Tenant B, sharing the pod, must finish bit-identical to its
+    own solo run — budgets, ledgers, and quarantine state are per-tenant."""
+    solo = FleetScheduler(str(tmp_path / "solo"), pod_slices=2,
+                          bus=MetricsBus(), poll_s=0.0, verbose=False)
+    sb = solo.register(_spec("b", trees[1], max_epochs=3, slice_quota=1))
+    _run_to_done(solo)
+    solo_digest = sb.params_digest()
+    solo.close()
+
+    bus = MetricsBus()
+    sched = FleetScheduler(str(tmp_path / "pod"), pod_slices=2, bus=bus,
+                           poll_s=0.0, verbose=False)
+    a = sched.register(_spec(
+        "a", trees[0], max_epochs=6, slice_quota=1,
+        config=_cfg(dp_clip=1.0, dp_noise_multiplier=0.8,
+                    dp_epsilon_budget=1e-3, quarantine_rounds=1),
+        fault_plan=FaultPlan(nan_at=((1, 0),)),
+    ))
+    b = sched.register(_spec("b", trees[1], max_epochs=3, slice_quota=1))
+    _run_to_done(sched)
+    # A: ε-budget exhaustion is a clean per-tenant stop, not a crash
+    assert a.status == "stopped"
+    assert a.daemon.epochs_run < 6
+    assert a.daemon.trainer._dp_epsilon is not None
+    assert a.daemon.trainer._dp_epsilon >= 1e-3
+    # A's poisoned site is quarantined in A's OWN health state...
+    assert np.asarray(a.daemon.state.health["quarantined"]).max() > 0
+    # ...and B never saw any of it: bit-exact with the solo run
+    assert np.asarray(b.daemon.state.health["quarantined"]).max() == 0
+    assert b.daemon.trainer._dp_epsilon is None  # no DP leakage either
+    assert b.status == "done" and b.daemon.epochs_run == 3
+    assert b.params_digest() == solo_digest
+    snap = bus.snapshot()
+    # the budget stop is attributable on the pod bus, tenant-labeled
+    assert snap["counters"][
+        'serve_dp_budget_stops_total{tenant="a"}'
+    ] == 1
+    out = sched.close()
+    assert out["tenants"]["a"]["epoch_compiles"] == 1
+    assert out["tenants"]["b"]["epoch_compiles"] == 1
+
+
+# ---------------------------------------------------------------------------
+# one exporter, many fits: /statusz + /metrics + per-tenant sinks
+# ---------------------------------------------------------------------------
+
+
+def test_statusz_and_telemetry_sinks_are_tenant_scoped(tmp_path, trees):
+    from dinunet_implementations_tpu.telemetry import report
+
+    bus = MetricsBus()
+    root = str(tmp_path / "pod")
+    sched = FleetScheduler(root, pod_slices=2, bus=bus, poll_s=0.0,
+                           verbose=False)
+    for i, name in enumerate(("alpha", "beta")):
+        sched.register(_spec(
+            name, trees[i], max_epochs=2, slice_quota=1,
+            config=_cfg(telemetry="on"),
+        ))
+    _run_to_done(sched)
+    ex = StatusExporter(bus, port=0, health=sched.health_probes(),
+                        statusz=sched.status)
+    with ex:
+        url = f"http://127.0.0.1:{ex.port}"
+        with urllib.request.urlopen(f"{url}/statusz", timeout=5) as r:
+            payload = json.loads(r.read())
+        assert payload["status"]["mode"] == "scheduler"
+        tv = payload["status"]["tenants"]
+        assert set(tv) == {"alpha", "beta"}
+        assert tv["alpha"]["epochs_run"] == 2
+        assert tv["alpha"]["daemon"]["slice_grant"] is not None
+        with urllib.request.urlopen(f"{url}/metrics", timeout=5) as r:
+            text = r.read().decode()
+        # the shared bus carries every series tenant-labeled
+        assert 'tenant="alpha"' in text and 'tenant="beta"' in text
+        with urllib.request.urlopen(f"{url}/healthz", timeout=5) as r:
+            health = json.loads(r.read())
+        assert health["status"] == "ok"
+        assert health["subsystems"]["tenant_alpha"]["ready"]
+    out = sched.close()
+    # per-tenant sinks: manifest-tagged, each passes report --validate
+    for name in ("alpha", "beta"):
+        tdir = os.path.join(root, "tenants", name, "output", "telemetry",
+                            "serve")
+        man = json.load(open(os.path.join(tdir, "manifest.json")))
+        assert man["tags"] == {"tenant": name}
+        assert report.main([tdir, "--validate"]) == 0
+    assert all(
+        v["epoch_compiles"] == 1 for v in out["tenants"].values()
+    )
+
+
+# ---------------------------------------------------------------------------
+# backfill: the residue serves
+# ---------------------------------------------------------------------------
+
+
+def test_backfill_lane_serves_leftover_and_never_compiles(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from dinunet_implementations_tpu.runner.registry import get_task
+    from dinunet_implementations_tpu.trainer.steps import FederatedTask
+
+    cfg = TrainConfig(
+        task_id="FS-Classification", batch_size=4, seed=3,
+    ).with_overrides({"fs_args": {"input_size": 6, "hidden_sizes": [8]}})
+    task = FederatedTask(get_task(cfg.task_id).build_model(cfg))
+    params, stats = task.init_variables(
+        jax.random.PRNGKey(0), jnp.ones((4, 6))
+    )
+    rng = np.random.default_rng(0)
+
+    def feed():
+        return rng.normal(size=(2, 6)).astype(np.float32)
+
+    lane = BackfillLane(
+        cfg, feed, params=params, batch_stats=stats, replicas=1,
+        requests_per_quantum=3,
+        engine_kwargs=dict(row_buckets=(1, 2, 4), max_delay_ms=1.0,
+                           supervise_interval_s=0.05),
+    )
+    bus = MetricsBus()
+    sched = FleetScheduler(str(tmp_path / "pod"), pod_slices=2, bus=bus,
+                           poll_s=0.0, verbose=False, backfill=lane)
+    # an empty pod: the whole pool is residue, the lane rents all of it
+    r = sched.tick(sleep_when_idle=False)
+    assert r["leftover"] == 2
+    assert r["served"]["requests"] == 3
+    r = sched.tick(sleep_when_idle=False)
+    assert r["served"]["samples"] == 6
+    snap = bus.snapshot()
+    assert snap["gauges"]["sched_backfill_requests"] == 6.0
+    # lane series are lane-labeled on the same pod bus
+    assert any('lane="backfill"' in k for k in snap["gauges"])
+    out = sched.close()  # asserts zero post-warmup lane compiles
+    assert out["backfill"]["requests_served"] == 6
+    assert out["backfill"]["samples_served"] == 12
+    st = lane.status()
+    assert st["started"] is False  # closed lanes release their fleet
+
+
+def test_backfill_lane_requires_a_feed():
+    with pytest.raises(SchedulerError):
+        BackfillLane(TrainConfig(), None)
