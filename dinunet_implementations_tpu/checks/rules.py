@@ -24,6 +24,8 @@ PRINT_ALLOWED_FILES = {
     "analysis.py",  # notebook-parity report CLI (prints summary_markdown)
     "checks/__main__.py",  # this analyzer's own CLI
     "telemetry/report.py",  # telemetry run-summary CLI (tables on stdout)
+    "telemetry/assemble.py",  # pod trace assembly CLI (r23 source summary)
+    "telemetry/postmortem.py",  # incident timeline CLI (r23)
     "serving/__main__.py",  # serving CLI: summary/latency JSON on stdout
     # multi-host worker CLI (r18): the UNSUPPORTED capability-probe line on
     # stdout IS the product — the launcher greps it next to rc 66

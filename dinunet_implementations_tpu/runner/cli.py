@@ -377,11 +377,21 @@ def main(argv: list[str] | None = None) -> int:
         )
         exporter = None
         if args.statusz_port is not None:
+            from ..telemetry.collector import PodCollector
             from ..telemetry.exporter import StatusExporter
 
+            # pod-scope plane (r23): the scheduler's own bus plus any
+            # worker exporters advertising themselves via heartbeats
+            # under the schedule root, merged behind ONE /statusz
+            collector = PodCollector(
+                args.data_path,
+                local_bus=sched.bus,
+                local_labels={"process": "scheduler"},
+                status_extra=sched.status,
+            )
             exporter = StatusExporter(
-                sched.bus, port=args.statusz_port,
-                health=sched.health_probes(), statusz=sched.status,
+                collector, port=args.statusz_port,
+                health=sched.health_probes(), statusz=collector.status,
                 slo=(
                     {"histogram": "serve_epoch_ms",
                      "p99_target_ms": args.slo_p99_ms}

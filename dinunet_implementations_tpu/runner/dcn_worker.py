@@ -146,6 +146,22 @@ def _parse(argv):
                         "its workers)")
     p.add_argument("--restart-generation", type=int, default=1,
                    help=argparse.SUPPRESS)  # supervisor-internal
+    p.add_argument("--statusz-port", type=int, default=None, metavar="PORT",
+                   help="supervisor: serve the FEDERATED pod-level "
+                        "/metrics + /statusz here (the PodCollector "
+                        "scrapes every worker's heartbeat-advertised "
+                        "statusz port and exact-merges the buses; r23). "
+                        "Workers always auto-pick their own port and "
+                        "advertise it via the heartbeat")
+    p.add_argument("--slo-p99-ms", type=float, default=2000.0,
+                   metavar="MS",
+                   help="supervisor: p99 target for the pod /statusz SLO "
+                        "burn over the fleet-merged epoch_ms histogram")
+    p.add_argument("--pod-trace", default=None, metavar="ID",
+                   help="pod-wide trace id stamped on every dcn-epoch "
+                        "span (the supervisor mints one and passes it to "
+                        "all workers, so telemetry.assemble can follow "
+                        "one run across processes)")
     p.add_argument("--set", dest="overrides", action="append", default=[],
                    metavar="KEY=VALUE",
                    help="raw TrainConfig overrides (JSON-parsed values)")
@@ -220,6 +236,7 @@ def _supervise(args) -> int:
 
     from ..telemetry.bus import global_bus
     from ..telemetry.flight import FlightRecorder
+    from ..telemetry.tracer import new_trace_id
     from .supervisor import (
         SliceSupervisor,
         consensus_round,
@@ -230,6 +247,11 @@ def _supervise(args) -> int:
     os.makedirs(out_dir, exist_ok=True)
     flight = FlightRecorder(out_dir, bus=global_bus())
     flight.install()  # crash dumps; SIGTERM chained (no guard owns it here)
+    # one pod-wide trace id for the whole supervised run: every worker
+    # (every generation — a restarted fleet continues the SAME story)
+    # stamps it on its dcn-epoch spans, so telemetry.assemble can follow
+    # the run across process boundaries
+    pod_trace = args.pod_trace or new_trace_id()
     launch = {"generation": 0, "port": None}
 
     def spawn(rank: int, generation: int):
@@ -250,6 +272,7 @@ def _supervise(args) -> int:
             "--devices-per-process", str(args.devices_per_process),
             "--heartbeat-s", str(args.heartbeat_s),
             "--restart-generation", str(generation),
+            "--pod-trace", pod_trace,
             "--slice-ckpt",
             "--out-dir", out_dir,
         ]
@@ -283,10 +306,21 @@ def _supervise(args) -> int:
         """Pick the newest round all SURVIVING slices' sidecars agree on
         and install it as the fleet resume point, unless the shared fold
         checkpoint already sits at that epoch (keeping its richer fit
-        meta — loss history, early-stop bookkeeping — when it does)."""
+        meta — loss history, early-stop bookkeeping — when it does). The
+        decision is PERSISTED under <out>/consensus/ (r23): a flight note
+        alone may never reach disk if the supervisor dies before its next
+        dump, and the postmortem timeline must name the round chosen."""
+        import time as _time
+
+        from ..telemetry.postmortem import CONSENSUS_DIR
         from ..trainer.checkpoint import CorruptCheckpointError, load_meta
         from ..trainer.logs import fold_dir
+        from .supervisor import _atomic_json
 
+        decision_path = os.path.join(
+            out_dir, CONSENSUS_DIR, f"decision_gen{generation}.json"
+        )
+        os.makedirs(os.path.dirname(decision_path), exist_ok=True)
         dirs = {
             sl: slice_ckpt_dir(out_dir, sl)
             for sl in range(max(args.slices, 1)) if sl != dead_slice
@@ -297,6 +331,10 @@ def _supervise(args) -> int:
         })
         if agreed is None:
             flight.note("consensus-none", generation=generation)
+            _atomic_json(decision_path, {
+                "time_unix": _time.time(), "generation": generation,
+                "dead_slice": dead_slice, "round": None,
+            })
             return  # fleet resumes from the shared fold checkpoint as-is
         rnd, sha, path = agreed
         epoch = load_meta(path).get("epoch")
@@ -318,6 +356,11 @@ def _supervise(args) -> int:
             shutil.copyfile(path, resume)
         flight.note("consensus-install", round=rnd, epoch=epoch,
                     sha=sha[:12], replaced=fold_epoch != epoch)
+        _atomic_json(decision_path, {
+            "time_unix": _time.time(), "generation": generation,
+            "dead_slice": dead_slice, "round": rnd, "epoch": epoch,
+            "sha": sha, "replaced": fold_epoch != epoch,
+        })
 
     sup = SliceSupervisor(
         spawn,
@@ -331,8 +374,62 @@ def _supervise(args) -> int:
         on_consensus=install_consensus,
         passthrough_rcs=(UNSUPPORTED_RC,),
     )
+    exporter = None
+    if args.statusz_port is not None:
+        # the pod observability plane (r23): one /statusz + /metrics for
+        # the whole fleet — the PodCollector discovers every worker from
+        # its heartbeat-advertised port and exact-merges the buses, and
+        # the UNCHANGED StatusExporter serves the merged view (the
+        # collector duck-types the bus read API)
+        from ..telemetry.collector import PodCollector
+        from ..telemetry.exporter import StatusExporter
+
+        collector = PodCollector(
+            out_dir, local_bus=global_bus(),
+            local_labels={"process": "supervisor"},
+            status_extra=lambda: {
+                "mode": "supervisor",
+                "generation": sup.generation,
+                "restarts": sup.restarts,
+                "pod_trace": pod_trace,
+            },
+        )
+        exporter = StatusExporter(
+            collector, port=args.statusz_port, flight=flight,
+            statusz=collector.status,
+            slo={"histogram": "epoch_ms",
+                 "p99_target_ms": args.slo_p99_ms},
+        )
+        port = exporter.start()
+        print(f"[supervise] pod statusz http://127.0.0.1:{port}/statusz "
+              f"(federated /metrics, SLO over merged epoch_ms)",
+              flush=True)
     rc = sup.run()
     flight.note("supervisor-exit", rc=rc, restarts=sup.restarts)
+    if exporter is not None:
+        exporter.stop()
+    # the supervisor's ring (launches, deaths, consensus, restarts) must
+    # reach disk even on a CLEAN exit — it is postmortem evidence, and the
+    # per-death dumps only cover the unhappy path
+    flight.dump(f"supervisor-exit:rc={rc}")
+    try:
+        # best-effort pod trace assembly: workers wrote per-process
+        # trace_p<rank>_gen<g>.jsonl files; merge them into one Perfetto
+        # timeline now so the artifact exists without a second command
+        from ..telemetry.assemble import (
+            POD_TRACE_DIR,
+            POD_TRACE_FILE,
+            assemble,
+        )
+
+        if os.path.isdir(os.path.join(out_dir, POD_TRACE_DIR)):
+            assemble(out_dir, os.path.join(
+                out_dir, POD_TRACE_DIR, POD_TRACE_FILE
+            ))
+    except (OSError, ValueError, TypeError, KeyError) as e:
+        # unreadable/torn trace files or a full disk — the assembly is a
+        # convenience artifact and must not mask the run's rc
+        flight.note("pod-trace-assembly-failed", error=repr(e))
     return rc
 
 
@@ -445,6 +542,54 @@ def main(argv=None) -> int:
     # (b) rotates this slice's consensus sidecar, and (c) fires the
     # kill_slice_at self-SIGKILL chaos arm (first generation only).
     final = {"state": None, "trainer": None, "epoch": 0, "round": 0}
+
+    # the pod observability plane (r23): every slice lead serves its OWN
+    # /statusz (auto-picked port) and advertises it in the heartbeat, so
+    # the supervisor's PodCollector can discover + scrape + merge the
+    # fleet's buses with zero configuration. started_unix rides the
+    # statusz payload too — the collector cross-checks it against the
+    # heartbeat to reject recycled pids.
+    exporter = None
+    if heartbeat is not None:
+        from dinunet_implementations_tpu.telemetry.bus import global_bus
+        from dinunet_implementations_tpu.telemetry.exporter import (
+            StatusExporter,
+        )
+
+        exporter = StatusExporter(
+            global_bus(), flight=flight,
+            statusz=lambda: {
+                "mode": "dcn_worker",
+                "process_id": args.process_id,
+                "slice": slice_id,
+                "generation": args.restart_generation,
+                "started_unix": heartbeat.started_unix,
+                "epoch": final["epoch"],
+                "round": final["round"],
+            },
+        )
+        heartbeat.beat(
+            statusz_port=exporter.start(), process=args.process_id,
+        )
+
+    def _write_pod_trace() -> None:
+        """Flush this process's spans to <out>/pod_trace/ so the
+        cross-process assembler (telemetry/assemble.py) can merge them —
+        the per-fit sink is coordinator-only, and the pod view needs
+        EVERY process's timeline."""
+        tr = final["trainer"]
+        if (args.out_dir and args.pod_trace and tr is not None
+                and tr.tracer.enabled):
+            from dinunet_implementations_tpu.telemetry.assemble import (
+                POD_TRACE_DIR,
+            )
+
+            tr.tracer.write_jsonl(os.path.join(
+                args.out_dir, POD_TRACE_DIR,
+                f"trace_p{args.process_id}"
+                f"_gen{args.restart_generation}.jsonl",
+            ))
+
     _orig_run_epoch = loop_mod.FederatedTrainer.run_epoch
     kill_round = (
         fault_plan.kill_round_for_slice(slice_id)
@@ -461,7 +606,17 @@ def main(argv=None) -> int:
         round_before = (
             final["round"] if final["epoch"] else int(state.round)
         )
-        out = _orig_run_epoch(self, state, *a, **k)
+        if args.pod_trace:
+            # the pod-wide trace id on every epoch span: the assembled
+            # Perfetto timeline follows it across process boundaries
+            with self.tracer.span(
+                "dcn-epoch", trace=args.pod_trace, slice=slice_id,
+                process=args.process_id,
+                generation=args.restart_generation,
+            ):
+                out = _orig_run_epoch(self, state, *a, **k)
+        else:
+            out = _orig_run_epoch(self, state, *a, **k)
         final["state"], final["trainer"] = out[0], self
         # the GLOBAL fit epoch (run_epoch's third positional arg) — a
         # restarted generation resumes at epoch k+1, and the sidecar meta
@@ -519,8 +674,11 @@ def main(argv=None) -> int:
             flight.dump(
                 f"signal:{p.signum}" if p.signum else "kill_at_round"
             )
+        _write_pod_trace()  # a drained survivor's spans are pod evidence
         if heartbeat is not None:
             heartbeat.stop()
+        if exporter is not None:
+            exporter.stop()
         distributed_shutdown()
         return p.exit_code
     except Exception as e:  # noqa: BLE001 — capability probe, see below
@@ -576,8 +734,11 @@ def main(argv=None) -> int:
         with open(args.report, "w") as fh:
             json.dump(report, fh)
 
+    _write_pod_trace()
     if heartbeat is not None:
         heartbeat.stop()
+    if exporter is not None:
+        exporter.stop()
     # clean teardown: leave the runtime re-entrant (the coordinated barrier
     # in shutdown also surfaces a wedged peer as a nonzero exit, instead of
     # letting a caller's timeout mask it)

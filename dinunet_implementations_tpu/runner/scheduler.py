@@ -77,6 +77,10 @@ from .fed_runner import FedDaemon
 #: event kinds the scheduler spool accepts
 SCHED_SPOOL_EVENTS = ("register", "deregister", "shutdown")
 
+#: append-only grant-decision log under the scheduler root — postmortem
+#: input (telemetry/postmortem.py reads the same name)
+GRANTS_FILE = "grants.jsonl"
+
 
 class SchedulerError(ValueError):
     """A tenant spec or scheduler-spool event that cannot be honored."""
@@ -512,6 +516,7 @@ class FleetScheduler:
         self.tenants: dict[str, Tenant] = {}
         self._stop = False
         self._preempted = False
+        self._last_grants: dict | None = None
         self.ticks = 0
         self._wall_s = 0.0
         self._busy_slice_s = 0.0
@@ -607,6 +612,23 @@ class FleetScheduler:
                 self.bus.counter("sched_events_total", kind="rejected")
         return changed
 
+    def _log_grants(self, grants: dict, preempt_pause_ms: float) -> None:
+        """Append one grant decision to ``<root>/grants.jsonl`` — the
+        postmortem plane (telemetry/postmortem.py) replays this log to
+        show who held the pod around an incident. Written only when the
+        allocation CHANGES, so the log is a decision history, not a
+        per-tick heartbeat."""
+        try:
+            with open(os.path.join(self.root, GRANTS_FILE), "a") as fh:
+                fh.write(json.dumps({
+                    "time_unix": time.time(),
+                    "tick": self.ticks,
+                    "grants": grants,
+                    "preempt_pause_ms": round(preempt_pause_ms, 3),
+                }) + "\n")
+        except OSError:
+            pass  # a full disk must not take the scheduler down
+
     # -- the tick ----------------------------------------------------------
 
     def _order(self) -> list[Tenant]:
@@ -662,6 +684,9 @@ class FleetScheduler:
             g = grants.get(t.spec.tenant, 0)
             if g > t.granted:
                 preempt_pause_ms += t.apply_grant(g)
+        if grants != self._last_grants:
+            self._log_grants(grants, preempt_pause_ms)
+            self._last_grants = dict(grants)
         trained = 0
         busy = 0
         trained_tenants = []

@@ -86,26 +86,41 @@ class Heartbeat:
     beating; THAT failure mode is recovered through the peer's observable
     exit + the supervisor's drain, and the heartbeat is the backstop for
     deaths with no exit to observe. One writer per slice (the slice-lead
-    rank, runner/dcn_worker.py) keeps the file's semantics crisp."""
+    rank, runner/dcn_worker.py) keeps the file's semantics crisp.
+
+    Since r23 each pulse also carries the pod-observability discovery
+    fields: ``started_unix`` (construction wall time — a recycled pid
+    cannot impersonate the worker that wrote the file),
+    ``perf``/``time_unix`` sampled back-to-back (the per-process
+    monotonic→wall offset the trace assembler aligns clocks with), and —
+    once the worker advertises it via ``beat(statusz_port=...)`` — the
+    process's live /statusz port, so the PodCollector
+    (telemetry/collector.py) scrapes the fleet with zero extra config."""
 
     def __init__(self, path: str, slice_id: int, interval_s: float = 2.0):
         self.path = path
         self.slice_id = slice_id
         self.interval_s = interval_s
+        self.started_unix = time.time()
         self._extra: dict = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
 
     def beat(self, **extra) -> None:
-        """Write one pulse now; ``extra`` (epoch/round progress) persists
-        into subsequent background pulses."""
+        """Write one pulse now; ``extra`` (epoch/round progress, the
+        advertised statusz port) persists into subsequent background
+        pulses."""
         if extra:
             self._extra.update(extra)
         try:
             _atomic_json(self.path, {
                 "pid": os.getpid(),
                 "slice": self.slice_id,
+                "started_unix": self.started_unix,
+                # perf and time_unix sampled adjacently: their difference
+                # IS this process's monotonic→wall clock offset
+                "perf": time.perf_counter(),
                 "time_unix": time.time(),
                 **self._extra,
             })

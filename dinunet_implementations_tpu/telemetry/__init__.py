@@ -43,6 +43,22 @@ about a RUNNING process:
   spans/events that dumps ``flight_<pid>.json`` (with a final bus snapshot)
   on unhandled exception or SIGTERM.
 
+The POD plane (r23) — the live plane is per-process; a supervised
+multislice run is many processes. These merge them:
+
+- :mod:`.collector` — the PodCollector: discovers workers from their
+  heartbeat-advertised ``/statusz`` ports, scrapes and merges their bus
+  snapshots (counters summed, gauges/histograms stamped
+  ``{process,slice}``), and duck-types the bus read API so one
+  StatusExporter serves pod scope unchanged.
+- :mod:`.assemble` — ``python -m …telemetry.assemble <pod-dir>`` merges
+  per-process trace.jsonl files into ONE clock-aligned Perfetto timeline
+  (heartbeat-exchanged monotonic→wall offsets).
+- :mod:`.postmortem` — ``python -m …telemetry.postmortem <pod-dir>``
+  reconstructs an ordered incident timeline from flight dumps, heartbeat
+  history, the slice-liveness spool, consensus decisions, and scheduler
+  grant logs; ``--validate`` asserts the story is complete.
+
 Distinct from ``DINUNET_SANITIZE`` (checks/sanitize.py): the sanitizer is a
 debug mode that FAILS a run violating invariants; telemetry OBSERVES healthy
 runs and writes artifacts. They compose — the sanitizer's compile counter is
@@ -72,6 +88,10 @@ __all__ = [
     "validate_metrics_rows",
     "XprofWindow",
     "summarize_device_ops",
+    "PodCollector",
+    "LabelCollisionError",
+    "merge_snapshots",
+    "stamp_snapshot",
 ]
 
 
@@ -100,4 +120,9 @@ def __getattr__(name):
         from .flight import FlightRecorder
 
         return FlightRecorder
+    if name in ("PodCollector", "LabelCollisionError", "merge_snapshots",
+                "stamp_snapshot"):
+        from . import collector
+
+        return getattr(collector, name)
     raise AttributeError(name)

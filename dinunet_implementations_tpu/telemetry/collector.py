@@ -1,0 +1,396 @@
+"""Pod-scope metrics federation (r23): discover, scrape, exact-merge.
+
+PRs 13–19 turned one process into a pod, but every worker still runs its
+own MetricsBus behind its own ``/statusz`` — a fleet question ("what is the
+pod-wide epoch p99?") meant N scrapes and hand-merging. This module closes
+that gap with three pure pieces and one collector:
+
+- **Discovery** (:func:`discover_targets`) — scrape targets come from the
+  r19 heartbeat files (``<out>/heartbeats/slice_<i>.json``): each worker's
+  slice lead advertises its auto-picked ``/statusz`` port in its own
+  heartbeat (``Heartbeat.beat(statusz_port=...)``), so federation needs
+  ZERO extra configuration. A target is valid only when its pid is alive
+  AND its scraped ``/statusz`` pid matches the heartbeat's (with
+  ``started_unix`` agreement guarding against pid reuse).
+- **Label stamping** (:func:`stamp_snapshot`) — a scraped snapshot's gauge
+  and histogram series get the target's identity stamped in
+  (``{process=,slice=}``; tenant/replica labels published by the worker
+  itself pass through untouched). Stamping a label that the series already
+  carries with a DIFFERENT value raises :class:`LabelCollisionError` — a
+  worker cannot impersonate another's identity, accidentally or otherwise.
+- **Merging** (:func:`merge_snapshots`) — counters with equal keys SUM
+  (pod totals), gauges UNION (an equal-key/unequal-value collision is an
+  error, which is what makes the merge commutative), histograms merge via
+  the :class:`~.hist.LogHistogram` exact elementwise merge — so the pod
+  rollup's quantiles are IDENTICAL whatever the merge tree, the property
+  the r16 histograms were built for.
+- :class:`PodCollector` — glues the three together and duck-types the
+  MetricsBus read API (``snapshot()`` / ``merged_histogram()``), so the
+  EXISTING :class:`~.exporter.StatusExporter` serves the federated pod
+  ``/statusz`` + ``/metrics`` (and the fleet-wide SLO burn) unchanged —
+  one exporter implementation for process scope and pod scope.
+
+Deliberately stdlib-only (urllib for the scrapes): the supervisor that
+hosts the pod exporter must not pull jax in.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+from .bus import series_key
+from .hist import LogHistogram
+
+#: labels the collector owns; a scraped series carrying one of these with a
+#: conflicting value is an identity spoof, not data
+RESERVED_LABELS = ("process", "slice")
+
+
+class LabelCollisionError(ValueError):
+    """Two series (or a series and a stamp) claim the same identity with
+    different values — merging would silently corrupt attribution."""
+
+
+# ---------------------------------------------------------------------------
+# series-key parsing (inverse of bus.series_key)
+# ---------------------------------------------------------------------------
+
+
+def _unescape_label(value: str) -> str:
+    out = []
+    i = 0
+    while i < len(value):
+        c = value[i]
+        if c == "\\" and i + 1 < len(value):
+            nxt = value[i + 1]
+            out.append("\n" if nxt == "n" else nxt)
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def parse_series(key: str) -> tuple[str, dict]:
+    """A rendered bus series key back into ``(name, labels)`` — the exact
+    inverse of :func:`~.bus.series_key` (round-trip tested), so stamping
+    can compose new labels with whatever the publisher already set."""
+    brace = key.find("{")
+    if brace < 0:
+        return key, {}
+    name, blob = key[:brace], key[brace + 1:-1]
+    labels: dict = {}
+    i = 0
+    while i < len(blob):
+        eq = blob.find('="', i)
+        if eq < 0:
+            break
+        k = blob[i:eq]
+        j = eq + 2
+        val = []
+        while j < len(blob):
+            c = blob[j]
+            if c == "\\" and j + 1 < len(blob):
+                val.append(blob[j:j + 2])
+                j += 2
+                continue
+            if c == '"':
+                break
+            val.append(c)
+            j += 1
+        labels[k] = _unescape_label("".join(val))
+        i = j + 2  # past the closing quote and the comma
+    return name, labels
+
+
+def _stamp_key(key: str, labels: dict) -> str:
+    name, existing = parse_series(key)
+    for k, v in labels.items():
+        if k in existing and existing[k] != str(v):
+            raise LabelCollisionError(
+                f"series {key!r} already carries {k}={existing[k]!r}; "
+                f"refusing to restamp as {v!r}"
+            )
+    return series_key(name, {**existing, **{
+        k: v for k, v in labels.items() if k not in existing
+    }})
+
+
+def stamp_snapshot(snap: dict, **labels) -> dict:
+    """A bus snapshot with ``labels`` stamped onto every GAUGE and
+    HISTOGRAM series key (module docstring: counters stay unstamped — they
+    sum into pod totals; per-process counter attribution is the stamped
+    gauges' job). Raises :class:`LabelCollisionError` when a series
+    already carries one of the labels with a different value."""
+    return {
+        "counters": dict(snap.get("counters", {})),
+        "gauges": {
+            _stamp_key(k, labels): v
+            for k, v in snap.get("gauges", {}).items()
+        },
+        "histograms": {
+            _stamp_key(k, labels): dict(v)
+            for k, v in snap.get("histograms", {}).items()
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# the exact merge
+# ---------------------------------------------------------------------------
+
+
+def merge_snapshots(a: dict, b: dict) -> dict:
+    """Merge two bus snapshots: counters summed, gauges unioned (an
+    equal-key collision with unequal values raises — that is what keeps
+    the merge commutative), histograms exact-merged elementwise (shape
+    mismatches raise :class:`~.hist.HistogramShapeError`). Associative and
+    commutative on integer-count state, so any merge tree over any number
+    of scrapes lands on the same pod rollup."""
+    counters = dict(a.get("counters", {}))
+    for k, v in b.get("counters", {}).items():
+        counters[k] = counters.get(k, 0) + v
+    gauges = dict(a.get("gauges", {}))
+    for k, v in b.get("gauges", {}).items():
+        if k in gauges and gauges[k] != v:
+            raise LabelCollisionError(
+                f"gauge {k!r} published by two processes with different "
+                f"values ({gauges[k]!r} vs {v!r}) — stamp process labels "
+                f"before merging"
+            )
+        gauges[k] = v
+    hists = {k: dict(v) for k, v in a.get("histograms", {}).items()}
+    for k, hd in b.get("histograms", {}).items():
+        if k in hists:
+            merged = LogHistogram.from_dict(hists[k])
+            merged.merge(LogHistogram.from_dict(hd))
+            hists[k] = merged.to_dict()
+        else:
+            hists[k] = dict(hd)
+    return {"counters": counters, "gauges": gauges, "histograms": hists}
+
+
+def merged_histogram_of(snapshot: dict, name: str) -> LogHistogram | None:
+    """All label variants of ``name`` in a snapshot merged into one
+    histogram — :meth:`~.bus.MetricsBus.merged_histogram` over a plain
+    snapshot dict (the collector's SLO-burn read path)."""
+    parts = [
+        LogHistogram.from_dict(hd)
+        for key, hd in snapshot.get("histograms", {}).items()
+        if key == name or key.startswith(name + "{")
+    ]
+    if not parts:
+        return None
+    out = LogHistogram(parts[0].lo, parts[0].hi, parts[0].per_decade)
+    for h in parts:
+        out.merge(h)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# discovery + scraping
+# ---------------------------------------------------------------------------
+
+HEARTBEAT_DIR = "heartbeats"  # mirrors runner/supervisor.py (stdlib-only
+#                               here: importing the runner would pull jax)
+
+#: started_unix disagreement past this between heartbeat and /statusz is a
+#: recycled pid wearing a dead worker's heartbeat, not clock jitter
+START_TIME_SLOP_S = 60.0
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except (PermissionError, OSError):
+        return True  # exists, just not ours to signal
+    return True
+
+
+def read_heartbeats(out_dir: str) -> list[dict]:
+    """Every parseable heartbeat pulse under ``<out_dir>/heartbeats/``."""
+    hb_dir = os.path.join(out_dir, HEARTBEAT_DIR)
+    try:
+        names = sorted(n for n in os.listdir(hb_dir) if n.endswith(".json"))
+    except OSError:
+        return []
+    out = []
+    for n in names:
+        try:
+            with open(os.path.join(hb_dir, n)) as fh:
+                out.append(json.load(fh))
+        except (OSError, json.JSONDecodeError, ValueError):
+            continue
+    return out
+
+
+def discover_targets(out_dir: str) -> list[dict]:
+    """Scrape targets from the heartbeat files: pulses that advertise a
+    ``statusz_port`` and whose pid is still alive. Validation against the
+    scraped endpoint's own pid/started_unix happens at scrape time."""
+    targets = []
+    for hb in read_heartbeats(out_dir):
+        port = hb.get("statusz_port")
+        pid = hb.get("pid")
+        if not port or not isinstance(pid, int):
+            continue
+        if not _pid_alive(pid):
+            continue
+        targets.append(hb)
+    return targets
+
+
+def scrape_statusz(port: int, timeout_s: float = 2.0,
+                   host: str = "127.0.0.1") -> dict:
+    """One ``GET /statusz`` — the full JSON payload (bus snapshot under
+    ``"metrics"``, caller status under ``"status"``)."""
+    with urllib.request.urlopen(
+        f"http://{host}:{port}/statusz", timeout=timeout_s
+    ) as resp:
+        return json.loads(resp.read().decode())
+
+
+class PodCollector:
+    """Federate the pod's per-process buses behind the MetricsBus read API.
+
+    Each read (``snapshot()`` / ``merged_histogram()``) runs
+    discover → scrape → stamp → merge over the heartbeat-advertised
+    targets plus the optional ``local_bus`` (the supervisor's own bus,
+    stamped with ``local_labels``), then caches the result for
+    ``cache_s`` — so one ``/statusz`` request's SLO burn and snapshot see
+    the SAME scrape (the exporter reads both), and a scrape storm cannot
+    amplify against the workers. Unreachable or invalid targets are
+    skipped and surfaced in :meth:`status`, never fatal: the pod view
+    degrades to the reachable subset, exactly like a real fleet scrape.
+    """
+
+    def __init__(self, out_dir: str, *, local_bus=None,
+                 local_labels: dict | None = None, timeout_s: float = 2.0,
+                 cache_s: float = 0.5, status_extra=None):
+        self.out_dir = out_dir
+        self.local_bus = local_bus
+        self.local_labels = dict(local_labels or {})
+        self.timeout_s = timeout_s
+        self.cache_s = cache_s
+        self.status_extra = status_extra
+        self._lock = threading.Lock()
+        self._cached: dict | None = None
+        self._cached_at = 0.0
+
+    # -- one federation pass ----------------------------------------------
+
+    def _target_labels(self, hb: dict) -> dict:
+        labels = {}
+        if hb.get("process") is not None:
+            labels["process"] = str(hb["process"])
+        elif hb.get("pid") is not None:
+            labels["process"] = f"pid{hb['pid']}"
+        if hb.get("slice") is not None:
+            labels["slice"] = str(hb["slice"])
+        return labels
+
+    def _validate(self, hb: dict, payload: dict) -> str | None:
+        """None when the scraped endpoint IS the heartbeat's writer, else
+        the rejection reason."""
+        if payload.get("pid") != hb.get("pid"):
+            return (f"pid mismatch: heartbeat {hb.get('pid')} vs "
+                    f"statusz {payload.get('pid')}")
+        hb_start = hb.get("started_unix")
+        st_start = (payload.get("status") or {}).get("started_unix")
+        if (isinstance(hb_start, (int, float))
+                and isinstance(st_start, (int, float))
+                and abs(hb_start - st_start) > START_TIME_SLOP_S):
+            return (f"start-time mismatch: heartbeat {hb_start:.0f} vs "
+                    f"statusz {st_start:.0f} (recycled pid?)")
+        return None
+
+    def collect(self) -> dict:
+        """Discover + scrape + merge now (no cache): ``{"snapshot",
+        "targets", "errors"}``."""
+        merged = {"counters": {}, "gauges": {}, "histograms": {}}
+        targets, errors = [], []
+        for hb in discover_targets(self.out_dir):
+            where = (f"slice {hb.get('slice')} pid {hb.get('pid')} "
+                     f"port {hb.get('statusz_port')}")
+            try:
+                payload = scrape_statusz(
+                    int(hb["statusz_port"]), timeout_s=self.timeout_s
+                )
+            except (OSError, ValueError, json.JSONDecodeError) as e:
+                errors.append(f"{where}: scrape failed ({e})")
+                continue
+            bad = self._validate(hb, payload)
+            if bad is not None:
+                errors.append(f"{where}: {bad}")
+                continue
+            try:
+                stamped = stamp_snapshot(
+                    payload.get("metrics") or {}, **self._target_labels(hb)
+                )
+                merged = merge_snapshots(merged, stamped)
+            except (LabelCollisionError, ValueError) as e:
+                errors.append(f"{where}: {e}")
+                continue
+            targets.append({
+                "pid": hb.get("pid"),
+                "slice": hb.get("slice"),
+                "process": hb.get("process"),
+                "statusz_port": hb.get("statusz_port"),
+                "epoch": hb.get("epoch"),
+                "round": hb.get("round"),
+                "heartbeat_unix": hb.get("time_unix"),
+                "status": payload.get("status"),
+            })
+        if self.local_bus is not None:
+            merged = merge_snapshots(merged, stamp_snapshot(
+                self.local_bus.snapshot(), **self.local_labels
+            ))
+        # the collector's own vitals ride the merged snapshot, so the pod
+        # /metrics exposition reports its coverage alongside the data
+        merged["gauges"][series_key(
+            "pod_scrape_targets", {}
+        )] = len(targets)
+        merged["gauges"][series_key(
+            "pod_scrape_errors", {}
+        )] = len(errors)
+        return {"snapshot": merged, "targets": targets, "errors": errors}
+
+    def _collected(self) -> dict:
+        with self._lock:
+            now = time.monotonic()
+            if (self._cached is None
+                    or now - self._cached_at > self.cache_s):
+                self._cached = self.collect()
+                self._cached_at = now
+            return self._cached
+
+    # -- the MetricsBus read API (what StatusExporter consumes) ------------
+
+    def snapshot(self) -> dict:
+        return self._collected()["snapshot"]
+
+    def merged_histogram(self, name: str) -> LogHistogram | None:
+        return merged_histogram_of(self._collected()["snapshot"], name)
+
+    def status(self) -> dict:
+        """The pod ``/statusz`` caller-status payload: reachable targets,
+        scrape errors, plus whatever ``status_extra`` contributes (the
+        scheduler's tenant table, the supervisor's generation)."""
+        got = self._collected()
+        out = {
+            "mode": "pod",
+            "targets": got["targets"],
+            "scrape_errors": got["errors"],
+        }
+        if self.status_extra is not None:
+            try:
+                out.update(self.status_extra() or {})
+            except Exception as e:  # a broken extra IS the finding
+                out["status_extra_error"] = str(e)
+        return out

@@ -1,10 +1,14 @@
 """Run-summary CLI over telemetry artifacts.
 
-    python -m dinunet_implementations_tpu.telemetry.report <dir> [--validate]
+    python -m dinunet_implementations_tpu.telemetry.report <dir> [<dir> ...] \\
+        [--validate]
 
-``<dir>`` is a per-fit telemetry directory (``.../telemetry/fold_0``) or a
-run-level ``telemetry/`` root (every ``fold_*`` child is summarized).
-Renders, per fit:
+Each ``<dir>`` is a per-fit telemetry directory (``.../telemetry/fold_0``)
+or a run-level ``telemetry/`` root (every ``fold_*`` child is summarized).
+Multiple dirs render in order; when the fits span more than one dir — the
+fleet-scheduler case, one spool root per tenant — a per-tenant rollup
+table closes the report (tenant from the r22 manifest tags). Renders, per
+fit:
 
 - the manifest header (engine, task, mesh, versions, git rev);
 - a phase time table from ``trace.jsonl`` (count / total / mean / max per
@@ -213,6 +217,61 @@ def render_fit(dirpath: str) -> None:
         print(f"-- trace: load {trace} in Perfetto (ui.perfetto.dev)")
 
 
+def tenant_rollup(dirs: list[str]) -> list[dict]:
+    """Per-tenant aggregate over many fit dirs — the multi-tenant report
+    (r23). Tenancy comes from the manifest's r22 ``tags.tenant`` (the
+    scheduler stamps each tenant's sink); untagged fits roll up under
+    ``-``. Unreadable artifacts degrade to zeros rather than aborting the
+    report — a rollup over a live fleet must tolerate a tenant mid-write."""
+    acc: dict[str, dict] = {}
+    for d in dirs:
+        try:
+            with open(os.path.join(d, MANIFEST_FILE)) as fh:
+                manifest = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            manifest = {}
+        try:
+            rows = load_metrics(os.path.join(d, METRICS_FILE))
+        except (OSError, json.JSONDecodeError):
+            rows = []
+        tenant = str((manifest.get("tags") or {}).get("tenant") or "-")
+        epochs = [r for r in rows if r.get("kind") == "epoch"]
+        summary = next(
+            (r for r in rows if r.get("kind") == "summary"), {}
+        )
+        serve = next(
+            (r for r in rows if r.get("kind") == "serve_summary"), {}
+        )
+        r = acc.setdefault(tenant, {
+            "tenant": tenant, "fits": 0, "epochs": 0, "compiles": 0,
+            "transfer_bytes": 0, "serve_requests": 0, "engines": set(),
+        })
+        r["fits"] += 1
+        r["epochs"] += len(epochs)
+        r["compiles"] += int(summary.get("epoch_compiles") or 0)
+        r["transfer_bytes"] += sum(
+            int(e.get("transfer_bytes") or 0) for e in epochs
+        )
+        r["serve_requests"] += int(serve.get("requests") or 0)
+        if manifest.get("agg_engine"):
+            r["engines"].add(str(manifest["agg_engine"]))
+    return sorted(acc.values(), key=lambda r: r["tenant"])
+
+
+def render_rollup(rows: list[dict]) -> None:
+    print("== per-tenant rollup")
+    print(f"{'tenant':<16}{'fits':>6}{'epochs':>8}{'compiles':>10}"
+          f"{'xfer MiB':>10}{'serve req':>11}  engines")
+    for r in rows:
+        print(
+            f"{r['tenant']:<16}{r['fits']:>6}{r['epochs']:>8}"
+            f"{r['compiles']:>10}"
+            f"{r['transfer_bytes'] / 2**20:>10.2f}"
+            f"{r['serve_requests']:>11}  "
+            f"{','.join(sorted(r['engines'])) or '-'}"
+        )
+
+
 def validate_fit(dirpath: str) -> list[str]:
     problems = []
     mpath = os.path.join(dirpath, MANIFEST_FILE)
@@ -245,14 +304,15 @@ def main(argv: list[str] | None = None) -> int:
         description="Render (or --validate) a run summary from telemetry "
                     "artifacts (manifest.json / metrics.jsonl / trace.*).",
     )
-    p.add_argument("path", help="a per-fit telemetry dir (.../telemetry/"
-                                "fold_0) or a telemetry/ root with fold_* "
-                                "children")
+    p.add_argument("paths", nargs="+",
+                   help="per-fit telemetry dirs (.../telemetry/fold_0) "
+                        "and/or telemetry/ roots with fold_* children; "
+                        "several dirs get a per-tenant rollup table")
     p.add_argument("--validate", action="store_true",
                    help="check artifacts against the schema contract "
                         "instead of rendering; exit 1 on any problem")
     args = p.parse_args(argv)
-    dirs = fit_dirs(args.path)
+    dirs = [d for path in args.paths for d in fit_dirs(path)]
     if args.validate:
         problems = [p for d in dirs for p in validate_fit(d)]
         for prob in problems:
@@ -262,6 +322,8 @@ def main(argv: list[str] | None = None) -> int:
         return 1 if problems else 0
     for d in dirs:
         render_fit(d)
+    if len(args.paths) > 1:
+        render_rollup(tenant_rollup(dirs))
     return 0
 
 

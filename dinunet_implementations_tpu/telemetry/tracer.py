@@ -72,7 +72,22 @@ class SpanTracer:
         self._events: list[dict] = []
         self._listeners: list = []
         self._local = threading.local()
+        # perf and unix birth times sampled back-to-back: every event ts is
+        # relative to _t0 (monotonic), and the clock_sync row write_jsonl
+        # emits lets the pod trace assembler (telemetry/assemble.py) map it
+        # onto the wall clock shared across processes
         self._t0 = time.perf_counter()
+        self._t0_unix = time.time()
+
+    def clock_sync(self) -> dict:
+        """The per-process clock anchor: this tracer's birth on both the
+        monotonic (``t0_perf``) and wall (``t0_unix``) clocks, plus the
+        pid. An event's wall time is ``t0_unix + ts/1e6`` — or, preferring
+        the heartbeat-exchanged offset, ``offset + t0_perf + ts/1e6``."""
+        return {
+            "ph": "M", "name": "clock_sync", "pid": os.getpid(),
+            "t0_perf": self._t0, "t0_unix": self._t0_unix,
+        }
 
     # -- recording --------------------------------------------------------
 
@@ -184,6 +199,11 @@ class SpanTracer:
     def write_jsonl(self, path: str) -> str:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         with open(path, "w") as fh:
+            # first row: the clock anchor, so a bare trace.jsonl is
+            # assemblable into a cross-process timeline even without the
+            # heartbeat offsets (consumers filter on ph, so the metadata
+            # row is invisible to the phase tables)
+            fh.write(json.dumps(self.clock_sync()) + "\n")
             for ev in self.events():
                 fh.write(json.dumps(ev) + "\n")
         return path

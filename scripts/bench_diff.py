@@ -1,0 +1,168 @@
+"""Compare two bench.py jsonl artifacts arm-by-arm.
+
+    python scripts/bench_diff.py BASELINE.jsonl CANDIDATE.jsonl \\
+        [--stat median|value|min] [--max-regress PCT] [--min-pairs N]
+
+bench.py emits one JSON record per configuration; this tool pairs records
+across the two files by identity — the ``arm`` name for A/B artifacts
+(bench_rankdad_ab_*.jsonl), else the configuration key (metric, engine,
+sites, pack_factor, slices, backend, unit) for sweep artifacts — and
+prints, per pair, the baseline and candidate throughput (median of
+observations by default), the spread of each, and the % delta. Unpaired
+records on either side are listed, never silently dropped.
+
+Exit codes (the CI contract):
+
+- ``--min-pairs N``: exit 1 if fewer than N records paired up — the
+  STRUCTURAL gate (a bench emitting a renamed or missing configuration
+  fails even when every surviving number looks fine).
+- ``--max-regress PCT``: exit 1 if any pair's throughput fell more than
+  PCT percent below baseline. Leave it off when the two artifacts come
+  from different machines (CI runners vs the committed artifact's host):
+  cross-host absolute numbers are not comparable, pairing is.
+
+Stdlib-only; non-JSON lines (bench's human-readable banners) are skipped.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+#: identity fields that name a sweep configuration when no ``arm`` is set
+IDENTITY_FIELDS = (
+    "metric", "engine", "sites", "pack_factor", "slices", "backend", "unit",
+)
+
+#: per-record throughput block bench.py emits
+RATE_KEY = "samples_per_sec"
+
+
+def load_records(path: str) -> list[dict]:
+    """JSON records from one bench artifact; non-JSON lines and records
+    without a throughput block are skipped (bench interleaves banners)."""
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and isinstance(rec.get(RATE_KEY), dict):
+                out.append(rec)
+    return out
+
+
+def pair_key(rec: dict):
+    """A record's identity: the A/B ``arm`` name when present, else the
+    sweep-configuration tuple."""
+    if rec.get("arm") is not None:
+        return ("arm", str(rec["arm"]))
+    return tuple(
+        (f, rec.get(f)) for f in IDENTITY_FIELDS if rec.get(f) is not None
+    )
+
+
+def _key_str(key) -> str:
+    if isinstance(key, tuple) and key and key[0] == "arm":
+        return f"arm={key[1]}"
+    return " ".join(
+        f"{f}={v}" for f, v in key
+        if f not in ("metric", "unit")
+    ) or str(key)
+
+
+def pair_records(
+    base: list[dict], cand: list[dict],
+) -> tuple[list[tuple], list, list]:
+    """``(pairs, unpaired_base_keys, unpaired_cand_keys)``. Duplicate keys
+    within one file keep the LAST record (bench re-runs append)."""
+    b = {pair_key(r): r for r in base}
+    c = {pair_key(r): r for r in cand}
+    pairs = [(k, b[k], c[k]) for k in b if k in c]
+    return (
+        pairs,
+        sorted(_key_str(k) for k in b if k not in c),
+        sorted(_key_str(k) for k in c if k not in b),
+    )
+
+
+def diff_rows(pairs: list[tuple], stat: str) -> list[dict]:
+    rows = []
+    for key, b, c in pairs:
+        bv = float(b[RATE_KEY].get(stat, b[RATE_KEY].get("value", 0.0)))
+        cv = float(c[RATE_KEY].get(stat, c[RATE_KEY].get("value", 0.0)))
+        rows.append({
+            "key": _key_str(key),
+            "base": bv,
+            "cand": cv,
+            "base_spread": float(b[RATE_KEY].get("spread") or 0.0),
+            "cand_spread": float(c[RATE_KEY].get("spread") or 0.0),
+            "delta_pct": (cv - bv) / bv * 100.0 if bv else float("nan"),
+        })
+    return rows
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python scripts/bench_diff.py",
+        description="Pair and diff two bench.py jsonl artifacts "
+                    "(same-arm / same-configuration records).",
+    )
+    p.add_argument("baseline", help="committed artifact (docs/bench_*.jsonl)")
+    p.add_argument("candidate", help="fresh bench output to compare")
+    p.add_argument("--stat", default="median",
+                   choices=("median", "value", "min"),
+                   help="which throughput statistic to compare "
+                        "(default median of observations)")
+    p.add_argument("--max-regress", type=float, default=None, metavar="PCT",
+                   help="exit 1 if any pair regressed more than PCT%% "
+                        "(only meaningful for same-host artifacts)")
+    p.add_argument("--min-pairs", type=int, default=1, metavar="N",
+                   help="exit 1 unless at least N records paired (default 1)")
+    args = p.parse_args(argv)
+
+    base = load_records(args.baseline)
+    cand = load_records(args.candidate)
+    pairs, only_base, only_cand = pair_records(base, cand)
+    rows = diff_rows(pairs, args.stat)
+
+    print(f"bench_diff: {len(base)} baseline / {len(cand)} candidate "
+          f"records, {len(rows)} paired ({args.stat})")
+    if rows:
+        width = max(len(r["key"]) for r in rows)
+        print(f"{'configuration':<{width}}  {'base':>12}  {'cand':>12}"
+              f"  {'delta %':>9}  spread b/c")
+        for r in rows:
+            print(
+                f"{r['key']:<{width}}  {r['base']:>12.2f}  "
+                f"{r['cand']:>12.2f}  {r['delta_pct']:>+9.2f}  "
+                f"{r['base_spread']:.1f}/{r['cand_spread']:.1f}"
+            )
+    for k in only_base:
+        print(f"  baseline-only: {k}")
+    for k in only_cand:
+        print(f"  candidate-only: {k}")
+
+    rc = 0
+    if len(rows) < args.min_pairs:
+        print(f"bench_diff: only {len(rows)} pair(s), need "
+              f">= {args.min_pairs}", file=sys.stderr)
+        rc = 1
+    if args.max_regress is not None:
+        bad = [r for r in rows if r["delta_pct"] < -args.max_regress]
+        for r in bad:
+            print(f"bench_diff: {r['key']} regressed "
+                  f"{r['delta_pct']:+.2f}% (limit -{args.max_regress}%)",
+                  file=sys.stderr)
+        if bad:
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

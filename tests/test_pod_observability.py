@@ -1,0 +1,543 @@
+"""Pod observability plane tests (r23): metrics federation
+(telemetry/collector.py), cross-process trace assembly
+(telemetry/assemble.py), postmortem reconstruction
+(telemetry/postmortem.py), the multi-dir report rollup, and
+scripts/bench_diff.py — all stdlib-side, fast enough for tier-1 (the full
+supervised 2-process drill with real sockets included; the jax.distributed
+chaos smoke stays behind tests/test_distributed.py's slow marker)."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from dinunet_implementations_tpu.runner.supervisor import (
+    Heartbeat,
+    SliceSupervisor,
+    heartbeat_path,
+    mark_slice_alive,
+    mark_slice_dead,
+)
+from dinunet_implementations_tpu.telemetry import assemble, postmortem, report
+from dinunet_implementations_tpu.telemetry.bus import MetricsBus, series_key
+from dinunet_implementations_tpu.telemetry.collector import (
+    LabelCollisionError,
+    PodCollector,
+    discover_targets,
+    merge_snapshots,
+    merged_histogram_of,
+    parse_series,
+    stamp_snapshot,
+)
+from dinunet_implementations_tpu.telemetry.exporter import StatusExporter
+from dinunet_implementations_tpu.telemetry.flight import FlightRecorder
+from dinunet_implementations_tpu.telemetry.tracer import SpanTracer
+
+from test_supervisor import _stub_spawn
+
+
+# ---------------------------------------------------------------------------
+# series-key parsing and label stamping
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name,labels", [
+    ("plain", {}),
+    ("epoch_ms", {"tenant": "studyA", "slice": "0"}),
+    ("weird", {"q": 'va"lue', "b": "back\\slash", "n": "new\nline"}),
+    ("commas", {"a": "x,y", "z": 'trail,"'}),
+])
+def test_parse_series_inverts_series_key(name, labels):
+    key = series_key(name, labels)
+    assert parse_series(key) == (name, labels)
+
+
+def test_stamp_snapshot_stamps_gauges_and_hists_not_counters():
+    bus = MetricsBus()
+    bus.counter("reqs_total", 3)
+    bus.gauge("epoch", 7, tenant="a")
+    bus.observe("epoch_ms", 12.0)
+    out = stamp_snapshot(bus.snapshot(), process="0", slice="1")
+    assert out["counters"] == {"reqs_total": 3}
+    assert set(out["gauges"]) == {
+        'epoch{process="0",slice="1",tenant="a"}',
+    }
+    assert set(out["histograms"]) == {'epoch_ms{process="0",slice="1"}'}
+
+
+def test_stamp_rejects_identity_spoof_but_passes_equal_values():
+    snap = {"counters": {}, "histograms": {},
+            "gauges": {'g{process="w0"}': 1.0}}
+    with pytest.raises(LabelCollisionError):
+        stamp_snapshot(snap, process="w1")
+    # restamping the SAME identity is a no-op, not a collision
+    out = stamp_snapshot(snap, process="w0")
+    assert out["gauges"] == {'g{process="w0"}': 1.0}
+
+
+# ---------------------------------------------------------------------------
+# the exact merge — on REAL scraped snapshots
+# ---------------------------------------------------------------------------
+
+
+def _scrape(port: int) -> dict:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/statusz", timeout=5
+    ) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _worker_bus(seed: int) -> MetricsBus:
+    bus = MetricsBus()
+    bus.counter("epochs_total", 2 + seed)
+    bus.gauge("round", 10 * seed)
+    for i in range(4 + seed):
+        bus.observe("epoch_ms", 5.0 * (i + 1) * (seed + 1))
+    return bus
+
+
+def test_merge_commutative_and_tree_invariant_on_scraped_snapshots():
+    buses = [_worker_bus(s) for s in range(3)]
+    exporters = [StatusExporter(b) for b in buses]
+    try:
+        ports = [e.start() for e in exporters]
+        snaps = [
+            stamp_snapshot(_scrape(p)["metrics"],
+                           process=str(i), slice=str(i))
+            for i, p in enumerate(ports)
+        ]
+    finally:
+        for e in exporters:
+            e.stop()
+    a, b, c = snaps
+    assert merge_snapshots(a, b) == merge_snapshots(b, a)
+    left = merge_snapshots(merge_snapshots(a, b), c)
+    right = merge_snapshots(a, merge_snapshots(b, c))
+    assert left == right
+    # counters summed; the pod histogram holds every worker's samples
+    assert left["counters"]["epochs_total"] == sum(
+        s["counters"]["epochs_total"] for s in snaps
+    )
+    pod = merged_histogram_of(left, "epoch_ms")
+    assert pod.count == sum(
+        merged_histogram_of(s, "epoch_ms").count for s in snaps
+    )
+
+
+def test_merge_rejects_unstamped_gauge_collision():
+    a = {"counters": {}, "histograms": {}, "gauges": {"round": 4}}
+    b = {"counters": {}, "histograms": {}, "gauges": {"round": 9}}
+    with pytest.raises(LabelCollisionError):
+        merge_snapshots(a, b)
+    # equal values union cleanly (idempotent re-scrape)
+    assert merge_snapshots(a, dict(a))["gauges"] == {"round": 4}
+
+
+# ---------------------------------------------------------------------------
+# discovery: heartbeats advertise the scrape plane
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_carries_discovery_and_clock_fields(tmp_path):
+    path = heartbeat_path(str(tmp_path), 0)
+    hb = Heartbeat(path, 0)
+    hb.beat(statusz_port=12345, process=0)
+    with open(path) as fh:
+        pulse = json.load(fh)
+    assert pulse["statusz_port"] == 12345 and pulse["process"] == 0
+    assert pulse["started_unix"] == hb.started_unix
+    # perf/time_unix sampled adjacently: their difference must equal this
+    # process's monotonic->wall offset to within scheduling noise
+    offset = pulse["time_unix"] - pulse["perf"]
+    assert abs(offset - (time.time() - time.perf_counter())) < 1.0
+    targets = discover_targets(str(tmp_path))
+    assert len(targets) == 1 and targets[0]["pid"] == os.getpid()
+
+
+def test_discovery_skips_dead_pids_and_portless_pulses(tmp_path):
+    dead = subprocess.Popen([sys.executable, "-c", "pass"])
+    dead.wait()
+    hb_dir = tmp_path / "heartbeats"
+    hb_dir.mkdir()
+    (hb_dir / "slice_0.json").write_text(json.dumps({
+        "pid": dead.pid, "slice": 0, "statusz_port": 1,
+        "time_unix": time.time(),
+    }))
+    (hb_dir / "slice_1.json").write_text(json.dumps({
+        "pid": os.getpid(), "slice": 1, "time_unix": time.time(),
+    }))  # alive but advertises no port
+    assert discover_targets(str(tmp_path)) == []
+
+
+# ---------------------------------------------------------------------------
+# the PodCollector end to end (real heartbeats, real HTTP)
+# ---------------------------------------------------------------------------
+
+
+def test_pod_collector_federates_workers_behind_one_statusz(tmp_path):
+    buses = [_worker_bus(0), _worker_bus(1)]
+    exporters = [
+        StatusExporter(b, statusz=lambda t0=time.time(): {
+            "started_unix": t0,
+        })
+        for b in buses
+    ]
+    pod_exporter = None
+    try:
+        for i, e in enumerate(exporters):
+            port = e.start()
+            Heartbeat(heartbeat_path(str(tmp_path), i), i).beat(
+                statusz_port=port, process=i,
+            )
+        local = MetricsBus()
+        local.counter("supervisor_polls_total", 5)
+        collector = PodCollector(
+            str(tmp_path), local_bus=local,
+            local_labels={"process": "supervisor"}, cache_s=0.0,
+        )
+        snap = collector.snapshot()
+        # per-slice series exist AND the pod rollup equals their sum
+        for i in range(2):
+            key = series_key("epoch_ms", {"process": str(i),
+                                          "slice": str(i)})
+            assert key in snap["histograms"]
+        pod_hist = collector.merged_histogram("epoch_ms")
+        assert pod_hist.count == sum(
+            b.merged_histogram("epoch_ms").count for b in buses
+        )
+        assert snap["counters"]["epochs_total"] == sum(
+            b.snapshot()["counters"]["epochs_total"] for b in buses
+        )
+        assert snap["counters"]["supervisor_polls_total"] == 5
+        assert snap["gauges"][series_key("pod_scrape_targets", {})] == 2
+        assert snap["gauges"][series_key("pod_scrape_errors", {})] == 0
+        status = collector.status()
+        assert status["mode"] == "pod" and len(status["targets"]) == 2
+
+        # the same exporter implementation serves POD scope: /statusz SLO
+        # samples must equal the sum of the per-worker scrapes (one cached
+        # collect backs both reads in a single request)
+        pod_exporter = StatusExporter(
+            collector, statusz=collector.status,
+            slo={"histogram": "epoch_ms", "p99_target_ms": 1e6},
+        )
+        payload = _scrape(pod_exporter.start())
+        assert payload["slo"]["samples"] == pod_hist.count
+        assert payload["status"]["mode"] == "pod"
+        assert series_key(
+            "epoch_ms", {"process": "0", "slice": "0"}
+        ) in payload["metrics"]["histograms"]
+    finally:
+        for e in exporters:
+            e.stop()
+        if pod_exporter is not None:
+            pod_exporter.stop()
+    # workers gone: the pod view degrades to the reachable subset
+    collector.cache_s = 0.0
+    collector._cached = None
+    got = collector.collect()
+    assert got["targets"] == [] and len(got["errors"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# clock alignment + trace assembly
+# ---------------------------------------------------------------------------
+
+
+def test_align_prefers_heartbeat_offset_over_clock_sync():
+    clock = {"t0_perf": 100.0, "t0_unix": 5000.0}
+    # heartbeat-measured offset wins (fresh), clock_sync is the fallback
+    assert assemble.align_unix_us(2e6, clock, offset=900.0) == (
+        (900.0 + 100.0) * 1e6 + 2e6
+    )
+    assert assemble.align_unix_us(2e6, clock) == 5000.0 * 1e6 + 2e6
+
+
+def test_tracer_clock_sync_row_feeds_the_assembler(tmp_path):
+    tr = SpanTracer()
+    with tr.span("fit-epoch", trace="t1"):
+        pass
+    path = str(tmp_path / "trace.jsonl")
+    tr.write_jsonl(path)
+    clock, events = assemble.load_trace(path)
+    assert clock["pid"] == os.getpid()
+    assert isinstance(clock["t0_perf"], float)
+    assert isinstance(clock["t0_unix"], float)
+    assert any(e.get("trace") == "t1" for e in events)
+
+
+def _fake_trace(path, pid, t0_unix, trace_id, name="dcn-epoch"):
+    rows = [
+        {"ph": "M", "name": "clock_sync", "pid": pid,
+         "t0_perf": 50.0 + pid, "t0_unix": t0_unix},
+        {"ph": "X", "name": name, "ts": 1000.0, "dur": 500.0,
+         "trace": trace_id, "tid": 0},
+        {"ph": "i", "name": "pulse", "ts": 2000.0, "trace": trace_id},
+    ]
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as fh:
+        for r in rows:
+            fh.write(json.dumps(r) + "\n")
+
+
+def test_assemble_merges_processes_onto_one_timeline(tmp_path):
+    pod = str(tmp_path)
+    _fake_trace(os.path.join(pod, "pod_trace", "trace_p0.jsonl"),
+                pid=111, t0_unix=1000.0, trace_id="abc")
+    _fake_trace(os.path.join(pod, "pod_trace", "trace_p1.jsonl"),
+                pid=222, t0_unix=2000.0, trace_id="abc")
+    # pid 111 has a heartbeat: offset = time_unix - perf = 1500 - 50, so
+    # its wall zero is offset + t0_perf = 1450 + 161... exercised below
+    hb_dir = os.path.join(pod, "heartbeats")
+    os.makedirs(hb_dir)
+    with open(os.path.join(hb_dir, "slice_0.json"), "w") as fh:
+        json.dump({"pid": 111, "slice": 0, "perf": 50.0,
+                   "time_unix": 1500.0}, fh)
+    out = os.path.join(pod, "pod_trace", "pod.chrome.json")
+    payload = assemble.assemble(pod, out)
+    assert os.path.exists(out)
+    srcs = {s["pid"]: s for s in payload["metadata"]["sources"]}
+    assert srcs[111]["aligned_by"] == "heartbeat"
+    assert srcs[222]["aligned_by"] == "clock_sync"
+    shared = assemble.processes_by_trace(payload)
+    assert shared["abc"] == {111, 222}
+    ts = [e["ts"] for e in payload["traceEvents"] if "ts" in e]
+    assert min(ts) == 0.0 and all(t >= 0.0 for t in ts)
+    # the CLI gate passes: a trace id spans two processes
+    assert assemble.main([pod, "--require-cross-process"]) == 0
+
+
+def test_assemble_cli_fails_without_cross_process_visibility(tmp_path):
+    pod = str(tmp_path)
+    _fake_trace(os.path.join(pod, "pod_trace", "trace_p0.jsonl"),
+                pid=111, t0_unix=1000.0, trace_id="only-one")
+    assert assemble.main([pod, "--require-cross-process"]) == 1
+    assert assemble.main([pod]) == 0
+
+
+# ---------------------------------------------------------------------------
+# postmortem reconstruction
+# ---------------------------------------------------------------------------
+
+
+def _fabricated_incident(tmp_path) -> str:
+    pod = str(tmp_path)
+    liveness = os.path.join(pod, "slice_liveness")
+    mark_slice_dead(liveness, 1, "exit rc=-9 (signal 9)",
+                    heartbeat_age=0.4, generation=1)
+    mark_slice_alive(liveness, 1, 2)
+    os.makedirs(os.path.join(pod, "consensus"))
+    with open(os.path.join(pod, "consensus",
+                           "decision_gen1.json"), "w") as fh:
+        json.dump({"time_unix": time.time(), "generation": 1,
+                   "dead_slice": 1, "round": 14, "epoch": 7,
+                   "sha": "abc123", "replaced": True}, fh)
+    with open(os.path.join(pod, "grants.jsonl"), "w") as fh:
+        fh.write(json.dumps({"time_unix": time.time(), "tick": 3,
+                             "grants": {"a": 2}, "preempt_pause_ms": 0.0})
+                 + "\n")
+    flight = FlightRecorder(pod)
+    flight.note("slice-death", slice=1, generation=1)
+    flight.dump("slice-death:slice=1")
+    Heartbeat(heartbeat_path(pod, 0), 0).beat(epoch=7, round=14)
+    return pod
+
+
+def test_postmortem_orders_all_sources_and_names_the_incident(tmp_path):
+    pod = _fabricated_incident(tmp_path)
+    rows = postmortem.build_timeline(pod)
+    assert [r["t_unix"] for r in rows] == sorted(
+        r["t_unix"] for r in rows
+    )
+    assert {"liveness", "consensus", "scheduler", "heartbeat",
+            f"flight:{os.getpid()}"} <= {r["source"] for r in rows}
+    inc = postmortem.incident_summary(rows)
+    assert inc["killed_slice"] == 1
+    assert inc["consensus_round"] == 14
+    assert inc["restart_generation"] == 2
+    assert postmortem.validate_timeline(rows) == []
+    json_out = str(tmp_path / "pm.json")
+    assert postmortem.main([pod, "--validate", "--json", json_out]) == 0
+    with open(json_out) as fh:
+        dumped = json.load(fh)
+    assert dumped["incident"]["killed_slice"] == 1
+
+
+def test_postmortem_validate_fails_on_unfinished_story(tmp_path):
+    # a death with no revival and no give-up cannot be narrated
+    mark_slice_dead(os.path.join(str(tmp_path), "slice_liveness"),
+                    1, "exit rc=-9 (signal 9)", generation=1)
+    assert postmortem.main([str(tmp_path), "--validate"]) == 1
+    assert postmortem.main([str(tmp_path)]) == 0  # rendering never gates
+
+
+def test_postmortem_validates_the_supervised_sigkill_drill(tmp_path):
+    """The acceptance drill at tier-1 scale: a real SliceSupervisor run
+    over stub workers where slice 1 SIGKILLs itself mid-epoch, flight-
+    recorded for real — the postmortem must reconstruct killed slice,
+    consensus round and restart generation from the directory alone."""
+    flight = FlightRecorder(str(tmp_path))
+
+    def on_consensus(generation, dead_slice):
+        # persist the decision like dcn_worker's install_consensus does
+        os.makedirs(os.path.join(str(tmp_path), "consensus"),
+                    exist_ok=True)
+        with open(os.path.join(
+            str(tmp_path), "consensus", f"decision_gen{generation}.json"
+        ), "w") as fh:
+            json.dump({"time_unix": time.time(),
+                       "generation": generation,
+                       "dead_slice": dead_slice, "round": 6,
+                       "epoch": 3, "sha": "drill", "replaced": True}, fh)
+
+    sup = SliceSupervisor(
+        _stub_spawn(tmp_path, die_rank=1), num_processes=2,
+        out_dir=str(tmp_path), heartbeat_timeout_s=10.0, max_restarts=2,
+        poll_s=0.1, grace_s=5.0, flight=flight, on_consensus=on_consensus,
+    )
+    assert sup.run() == 0
+    flight.dump("supervisor-exit:rc=0")
+    assert postmortem.main([str(tmp_path), "--validate"]) == 0
+    inc = postmortem.incident_summary(
+        postmortem.build_timeline(str(tmp_path))
+    )
+    assert inc["killed_slice"] == 1
+    assert "signal 9" in inc["death_reason"]
+    assert inc["consensus_round"] == 6
+    assert inc["restart_generation"] == 2
+
+
+# ---------------------------------------------------------------------------
+# report: multi-dir invocation + per-tenant rollup
+# ---------------------------------------------------------------------------
+
+
+def _fit_dir(tmp_path, name, tenant):
+    d = tmp_path / name
+    d.mkdir()
+    (d / "manifest.json").write_text(json.dumps({
+        "task_id": name, "agg_engine": "dSGD", "num_sites": 4,
+        "tags": {"tenant": tenant} if tenant else None,
+    }))
+    rows = [
+        {"kind": "epoch", "epoch": 0, "rounds": 2, "transfer_bytes": 256,
+         "site_grad_sq_last": [], "site_grad_sq_sum": [],
+         "site_residual_sq_sum": []},
+        {"kind": "summary", "epoch_compiles": 1},
+    ]
+    (d / "metrics.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in rows)
+    )
+    return str(d)
+
+
+def test_report_multi_dir_renders_per_tenant_rollup(tmp_path, capsys):
+    d1 = _fit_dir(tmp_path, "fold_0", "studyA")
+    d2 = _fit_dir(tmp_path, "fold_1", "studyA")
+    d3 = _fit_dir(tmp_path, "fold_2", "studyB")
+    assert report.main([d1, d2, d3]) == 0
+    out = capsys.readouterr().out
+    assert "per-tenant rollup" in out
+    rollup = report.tenant_rollup([d1, d2, d3])
+    by_tenant = {r["tenant"]: r for r in rollup}
+    assert by_tenant["studyA"]["fits"] == 2
+    assert by_tenant["studyA"]["epochs"] == 2
+    assert by_tenant["studyA"]["transfer_bytes"] == 512
+    assert by_tenant["studyB"]["fits"] == 1
+    # single-dir invocations keep the old terse output (no rollup)
+    report.main([d1])
+    assert "per-tenant rollup" not in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# scripts/bench_diff.py
+# ---------------------------------------------------------------------------
+
+
+def _bench_diff_mod():
+    path = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "scripts", "bench_diff.py")
+    spec = importlib.util.spec_from_file_location("bench_diff", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _bench_line(rate, arm=None, **identity):
+    rec = {"metric": "samples/sec", "unit": "samples/sec",
+           "samples_per_sec": {"value": rate, "median": rate,
+                               "min": rate * 0.9, "observations": 3,
+                               "spread": rate * 0.1},
+           **identity}
+    if arm is not None:
+        rec["arm"] = arm
+    return json.dumps(rec) + "\n"
+
+
+def test_bench_diff_pairs_by_arm_and_identity(tmp_path, capsys):
+    bd = _bench_diff_mod()
+    base = tmp_path / "base.jsonl"
+    cand = tmp_path / "cand.jsonl"
+    base.write_text(
+        "bench: warming up\n"  # human banner lines must be skipped
+        + _bench_line(100.0, arm="dsgd")
+        + _bench_line(50.0, engine="rankDAD", sites=8, pack_factor=1)
+        + _bench_line(70.0, engine="rankDAD", sites=32, pack_factor=4)
+    )
+    cand.write_text(
+        _bench_line(110.0, arm="dsgd")
+        + _bench_line(40.0, engine="rankDAD", sites=8, pack_factor=1)
+        + _bench_line(70.0, engine="powerSGD", sites=32, pack_factor=4)
+    )
+    assert bd.main([str(base), str(cand), "--min-pairs", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "2 paired" in out
+    assert "+10.00" in out and "-20.00" in out
+    assert "baseline-only: engine=rankDAD sites=32" in out
+    assert "candidate-only: engine=powerSGD sites=32" in out
+    # the structural gate: too few pairs fails
+    assert bd.main([str(base), str(cand), "--min-pairs", "3"]) == 1
+    # the regression gate: -20% on the rankDAD pair trips a 10% limit
+    assert bd.main([str(base), str(cand), "--max-regress", "10"]) == 1
+    assert bd.main([str(base), str(cand), "--max-regress", "25"]) == 0
+
+
+def test_bench_diff_stat_selection(tmp_path):
+    bd = _bench_diff_mod()
+    rec = {"metric": "m", "unit": "u", "arm": "a",
+           "samples_per_sec": {"value": 90.0, "median": 100.0,
+                               "min": 80.0, "spread": 5.0}}
+    base = tmp_path / "b.jsonl"
+    cand = tmp_path / "c.jsonl"
+    base.write_text(json.dumps(rec) + "\n")
+    cand.write_text(json.dumps(rec) + "\n")
+    pairs, _, _ = bd.pair_records(
+        bd.load_records(str(base)), bd.load_records(str(cand))
+    )
+    assert bd.diff_rows(pairs, "median")[0]["base"] == 100.0
+    assert bd.diff_rows(pairs, "value")[0]["base"] == 90.0
+    assert bd.diff_rows(pairs, "min")[0]["base"] == 80.0
+
+
+# ---------------------------------------------------------------------------
+# the scheduler grant log feeds the postmortem plane
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_grant_log_format(tmp_path):
+    from dinunet_implementations_tpu.runner.scheduler import FleetScheduler
+
+    sched = object.__new__(FleetScheduler)
+    sched.root = str(tmp_path)
+    sched.ticks = 7
+    sched._log_grants({"a": 2, "b": 1}, 12.5)
+    rows = postmortem._grant_rows(str(tmp_path))
+    assert len(rows) == 1
+    assert rows[0]["event"] == "grants" and rows[0]["tick"] == 7
+    assert rows[0]["grants"] == {"a": 2, "b": 1}
+    assert rows[0]["preempt_pause_ms"] == 12.5
